@@ -1,0 +1,39 @@
+"""Test harness: simulate an 8-device mesh on CPU.
+
+The reference has no CI-able tests (its examples need real multi-GPU SLURM —
+SURVEY.md §4).  We do better natively: force 8 virtual CPU devices before JAX
+initializes, so every sharding/collective path runs as a real 8-way SPMD
+program in CI without hardware.
+"""
+
+import os
+
+# Must run before jax is imported anywhere in the test process.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-registers the TPU backend via
+# jax.config.update("jax_platforms", "axon,cpu"), which overrides the env var
+# — override it back before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from torchdistpackage_tpu.dist import tpc  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_tpc():
+    yield
+    tpc.reset()
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs[:8]

@@ -1,0 +1,20 @@
+"""torchdistpackage_tpu — a TPU-native (JAX/XLA/pjit/shard_map/Pallas)
+distributed-training toolkit with the capabilities of
+KimmiShi/TorchDistPackage, re-designed TPU-first.
+
+À-la-carte components (mirroring the reference's design goal, Intro.md:6-11):
+mesh topology (``tpc``), data parallelism, ZeRO optimizer sharding, tensor +
+sequence parallelism, 1F1B-style pipeline parallelism, MoE expert parallelism,
+sharded EMA, and profiling/debug/benchmark tools — expressed as device meshes,
+sharding rules and XLA collectives over ICI/DCN.
+"""
+
+from .dist import (
+    ParallelContext,
+    setup_distributed,
+    test_comm,
+    tpc,
+    is_using_pp,
+)
+
+__version__ = "0.1.0"

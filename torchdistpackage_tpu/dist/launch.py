@@ -1,0 +1,96 @@
+"""Multi-host bootstrap — analogue of ``setup_distributed``
+(``torchdistpackage/dist/launch_from_slurm.py:16-62``).
+
+The reference reads SLURM (or torchrun) env vars, resolves the master address
+via ``scontrol`` and calls ``dist.init_process_group``.  On TPU the rendezvous
+is ``jax.distributed.initialize``; on Cloud TPU pods it normally needs *no*
+arguments (the TPU runtime supplies topology), while SLURM CPU/GPU clusters
+need explicit coordinator/process info — we support both, plus a no-op
+single-process path so the same script runs anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+from typing import Optional
+
+import jax
+
+_INITIALIZED = False
+
+
+def find_free_port() -> int:
+    """Pick an OS-assigned free port (launch_from_slurm.py:8-13 analogue)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _slurm_master_addr(nodelist: str) -> str:
+    # The reference shells out to ``scontrol show hostname`` and takes the
+    # first host (launch_from_slurm.py:34-35); same here, with a fallback for
+    # simple "host1,host2" lists when scontrol is absent.
+    try:
+        out = subprocess.run(
+            ["scontrol", "show", "hostname", nodelist],
+            capture_output=True, text=True, check=True,
+        ).stdout
+        return out.split()[0]
+    except (OSError, subprocess.CalledProcessError):
+        # Expand a compressed list like "node[01-08],other" to its first host
+        # ("node01") when scontrol is unavailable.
+        first = nodelist.split(",")[0]
+        if "[" in first:
+            prefix, rng = first.split("[", 1)
+            start = rng.rstrip("]").split("-")[0].split(",")[0]
+            return prefix + start
+        return first
+
+
+def setup_distributed(port: Optional[int] = None) -> None:
+    """Initialize the JAX distributed runtime from the environment.
+
+    Resolution order (mirrors launch_from_slurm.py:29-55):
+
+    1. SLURM: ``SLURM_PROCID`` / ``SLURM_NTASKS`` / ``SLURM_NODELIST``.
+    2. torchrun-style: ``RANK`` / ``WORLD_SIZE`` / ``MASTER_ADDR`` / ``MASTER_PORT``.
+    3. Cloud TPU pod: ``jax.distributed.initialize()`` with no args if the TPU
+       runtime env is present (``TPU_WORKER_HOSTNAMES`` etc.).
+    4. Single process: no-op.
+
+    Safe to call twice (idempotent), unlike the reference.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+
+    env = os.environ
+    if "SLURM_PROCID" in env and int(env.get("SLURM_NTASKS", "1")) > 1:
+        rank = int(env["SLURM_PROCID"])
+        world = int(env["SLURM_NTASKS"])
+        addr = _slurm_master_addr(env["SLURM_NODELIST"])
+        port = port or int(env.get("MASTER_PORT", "12345"))
+        jax.distributed.initialize(
+            coordinator_address=f"{addr}:{port}",
+            num_processes=world,
+            process_id=rank,
+        )
+    elif "RANK" in env and int(env.get("WORLD_SIZE", "1")) > 1:
+        rank = int(env["RANK"])
+        world = int(env["WORLD_SIZE"])
+        addr = env.get("MASTER_ADDR", "127.0.0.1")
+        port = port or int(env.get("MASTER_PORT", "12345"))
+        jax.distributed.initialize(
+            coordinator_address=f"{addr}:{port}",
+            num_processes=world,
+            process_id=rank,
+        )
+    elif (
+        len(env.get("TPU_WORKER_HOSTNAMES", "").split(",")) > 1
+        or "MEGASCALE_COORDINATOR_ADDRESS" in env
+    ):
+        jax.distributed.initialize()
+    # else: single-process — nothing to do.
+    _INITIALIZED = True
