@@ -1,0 +1,24 @@
+from torchdistpackage_tpu.dist import tpc
+from torchdistpackage_tpu.dist.comm_bench import bench_collective
+from torchdistpackage_tpu.dist.comm_bench import test_collection as sweep_collectives
+
+
+def test_bench_all_ops(devices8):
+    tpc.setup_process_groups([("data", 4), ("tensor", 2)], devices=devices8)
+    rows = sweep_collectives("data", sizes=(1 << 16,), verbose=False)
+    assert len(rows) == 5
+    for row in rows:
+        assert row["time_s"] > 0
+        assert row["algbw_GBps"] > 0
+        assert row["busbw_GBps"] > 0
+        assert row["axis_size"] == 4
+
+
+def test_busbw_factors(devices8):
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    r = bench_collective("all_reduce", "data", nbytes=1 << 16, iters=2)
+    assert abs(r["busbw_GBps"] / r["algbw_GBps"] - 2 * 7 / 8) < 1e-9
+    r = bench_collective("all_gather", "data", nbytes=1 << 16, iters=2)
+    assert abs(r["busbw_GBps"] / r["algbw_GBps"] - 7 / 8) < 1e-9
+    r = bench_collective("ppermute", "data", nbytes=1 << 16, iters=2)
+    assert abs(r["busbw_GBps"] / r["algbw_GBps"] - 1.0) < 1e-9

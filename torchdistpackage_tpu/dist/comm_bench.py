@@ -1,0 +1,138 @@
+"""Collective bandwidth benchmark — analogue of the reference's
+``torchdistpackage/dist/py_comm_test.py`` (84 LoC).
+
+The reference times NCCL all_reduce / all_gather / reduce_scatter /
+all_to_all and reports algorithm- and bus-bandwidth with the nccl-tests
+correction factors (py_comm_test.py:10-17,49-51).  Here the same harness runs
+jitted XLA collectives over any named mesh axis, so the numbers measure
+ICI/DCN (or the CPU-sim fabric in tests).  Bus-bandwidth factors follow the
+same convention:
+
+- all_reduce:      busbw = algbw * 2 * (n-1)/n
+- all_gather:      busbw = algbw * (n-1)/n
+- reduce_scatter:  busbw = algbw * (n-1)/n
+- all_to_all:      busbw = algbw * (n-1)/n
+- ppermute (ring p2p): busbw = algbw (each link carries the payload once)
+
+algbw = bytes / time, where bytes is the *full* (global) payload size, as in
+nccl-tests.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .topology import tpc
+
+_BUSBW_FACTOR = {
+    "all_reduce": lambda n: 2 * (n - 1) / n,
+    "all_gather": lambda n: (n - 1) / n,
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "all_to_all": lambda n: (n - 1) / n,
+    "ppermute": lambda n: 1.0,
+}
+
+
+def _timeit(fn, arg, warmup: int = 2, iters: int = 10) -> float:
+    """Median wall time of ``fn(arg)`` with device sync, seconds."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(arg))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(arg))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def bench_collective(
+    op: str,
+    axis: str,
+    nbytes: int = 1 << 24,
+    mesh: Optional[Mesh] = None,
+    dtype=jnp.bfloat16,
+    warmup: int = 2,
+    iters: int = 10,
+) -> Dict[str, float]:
+    """Time one collective over ``axis`` and return timing + bandwidth stats.
+
+    ``nbytes`` is the global payload size (like the reference's tensor size,
+    py_comm_test.py:22-30).  Returns ``{size_bytes, time_s, algbw_GBps,
+    busbw_GBps}``.
+    """
+    if mesh is None:
+        mesh = tpc.get_view()
+    n = mesh.shape[axis]
+    elem = jnp.dtype(dtype).itemsize
+    count = max(n, nbytes // elem // n * n)  # divisible by axis size
+
+    if op == "all_reduce":
+        body = lambda x: jax.lax.psum(x, axis)
+        in_spec, out_spec = P(), P()
+        shape = (count,)
+    elif op == "all_gather":
+        body = lambda x: jax.lax.all_gather(x, axis, tiled=True)
+        in_spec, out_spec = P(axis), P(axis)
+        shape = (count,)
+    elif op == "reduce_scatter":
+        body = lambda x: jax.lax.psum_scatter(x, axis, tiled=True)
+        in_spec, out_spec = P(), P(axis)
+        shape = (count,)
+    elif op == "all_to_all":
+        body = lambda x: jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=0, tiled=True)
+        in_spec, out_spec = P(axis), P(axis)
+        shape = (count // n, n)
+    elif op == "ppermute":
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        body = lambda x: jax.lax.ppermute(x, axis, perm)
+        in_spec, out_spec = P(axis), P(axis)
+        shape = (count,)
+    else:
+        raise ValueError(f"unknown collective {op!r}")
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec))
+    x = jnp.ones(shape, dtype=dtype)
+    t = _timeit(fn, x, warmup=warmup, iters=iters)
+    size = x.size * elem
+    algbw = size / t / 1e9
+    return {
+        "op": op,
+        "axis": axis,
+        "axis_size": n,
+        "size_bytes": size,
+        "time_s": t,
+        "algbw_GBps": algbw,
+        "busbw_GBps": algbw * _BUSBW_FACTOR[op](n),
+    }
+
+
+def test_collection(
+    axis: str,
+    sizes: Sequence[int] = (1 << 20, 1 << 24),
+    ops: Sequence[str] = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all", "ppermute"),
+    mesh: Optional[Mesh] = None,
+    verbose: bool = True,
+) -> List[Dict[str, float]]:
+    """Sweep collectives x sizes over an axis — analogue of
+    ``test_collection`` (py_comm_test.py:20-57)."""
+    rows = []
+    for op in ops:
+        for nbytes in sizes:
+            row = bench_collective(op, axis, nbytes=nbytes, mesh=mesh)
+            rows.append(row)
+            if verbose and jax.process_index() == 0:
+                print(
+                    f"{op:>14} axis={axis}({row['axis_size']}) "
+                    f"{row['size_bytes']/2**20:8.1f} MiB  "
+                    f"{row['time_s']*1e3:8.3f} ms  "
+                    f"alg {row['algbw_GBps']:7.2f} GB/s  "
+                    f"bus {row['busbw_GBps']:7.2f} GB/s"
+                )
+    return rows

@@ -327,6 +327,11 @@ class ParallelContext:
         analogue for multi-host code (checkpoint naming etc.)."""
         mesh = self._axis_mesh(mode)
         local = [d for d in mesh.devices.flat if d.process_index == jax.process_index()]
+        if not local:
+            raise RuntimeError(
+                f"process {jax.process_index()} has no local device in the mesh; "
+                "process_axis_index is only meaningful on participating hosts"
+            )
         arr = mesh.devices
         pos = np.argwhere(arr == local[0])[0]
         return int(pos[list(mesh.axis_names).index(mode)])

@@ -1,0 +1,2 @@
+from .data_parallel import DataParallel, reduce_gradients
+from . import tensor_parallel

@@ -1,0 +1,20 @@
+from .tp_utils import (
+    gather_from_sp,
+    get_tp_axis,
+    reduce_from_tp,
+    scatter_to_sp,
+    set_tp_axis,
+    split_to_sp,
+)
+from .layers import (
+    TransformerConfig,
+    attention_partial,
+    block_forward,
+    block_param_specs,
+    init_block_params,
+    init_transformer_params,
+    layer_norm,
+    mlp_partial,
+    transformer_forward,
+    transformer_param_specs,
+)

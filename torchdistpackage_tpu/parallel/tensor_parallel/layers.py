@@ -1,0 +1,229 @@
+"""TP/SP transformer layers — analogue of the reference's
+``tensor_parallel/mlp.py`` (77 LoC), ``attn.py`` (98 LoC) and
+``transformer.py`` (99 LoC).
+
+Design: **one implementation, serial and parallel.**  Parameters are plain
+dict pytrees holding *global* arrays; tensor parallelism is expressed purely
+as a ``PartitionSpec`` tree (:func:`transformer_param_specs`).  The forward
+functions below run either
+
+- serially (``axis=None``) on full weights, or
+- inside ``shard_map`` over the TP axis, where each device sees its local
+  weight shard and the functions insert the Megatron collectives:
+  column-parallel QKV/W1 need no forward comm (tp_utils.py:176-216 semantics),
+  row-parallel WO/W2 reduce via ``psum`` — or ``psum_scatter`` straight into
+  sequence-parallel layout (tp_utils.py:218-248) — and SP block boundaries
+  all-gather/reduce-scatter along the sequence dim (transformer.py:48-72).
+
+Because the global param arrays are identical in both modes, the reference's
+``init_weight_from_full*`` weight-slicing helpers (tp_utils.py:203,
+transformer.py:74-85) are unnecessary: sharding *is* the slicing.  Head-safe
+QKV sharding (attn.py:64) falls out of storing QKV stacked as ``(3, D, D)``
+and sharding the last dim, so each shard owns whole heads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .tp_utils import gather_from_sp, reduce_from_tp, scatter_to_sp, split_to_sp
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    dim: int
+    nheads: int
+    nlayers: int = 2
+    ffn_mult: int = 4
+    causal: bool = True
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.dim % self.nheads == 0
+        return self.dim // self.nheads
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.dim * self.ffn_mult
+
+
+# ------------------------------------------------------------------ primitives
+
+
+def layer_norm(x: jnp.ndarray, p: Dict[str, jnp.ndarray], eps: float = 1e-5) -> jnp.ndarray:
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def attention_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
+    """Core attention on the *local* heads; returns the (partial) output
+    projection WITHOUT the TP reduction or output bias — the caller closes the
+    row-parallel region.  Mirrors ``TpAttention`` (attn.py:53-91) where each
+    rank computes ``num_heads // tp_size`` heads.
+
+    x: [B, S, D] (full sequence).  p['wqkv']: [3, D, H_loc * hd]."""
+    B, S, D = x.shape
+    hd = cfg.head_dim
+    h_loc = p["wqkv"].shape[-1] // hd
+
+    qkv = jnp.einsum("bsd,tdh->tbsh", x, p["wqkv"]) + p["bqkv"][:, None, None, :]
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    q = q.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)  # [B,h,S,hd]
+    k = k.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
+
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+    if cfg.causal:
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = out.transpose(0, 2, 1, 3).reshape(B, S, h_loc * hd)
+    return out @ p["wo"]  # [B,S,D] — partial sum across TP shards
+
+
+def mlp_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
+    """Col -> gelu -> Row without the closing reduction/bias (``TpMlp``,
+    mlp.py:64-66)."""
+    h = jax.nn.gelu(x @ p["w1"] + p["b1"])
+    return h @ p["w2"]  # partial
+
+
+def _close_row_parallel(
+    y: jnp.ndarray, bias: jnp.ndarray, axis: Optional[str], sp: bool
+) -> jnp.ndarray:
+    """Finish a row-parallel layer: reduce partial sums over TP (into SP
+    layout if requested) and add the output bias exactly once."""
+    if axis is not None:
+        y = scatter_to_sp(y, axis) if sp else reduce_from_tp(y, axis)
+    return y + bias
+
+
+# ---------------------------------------------------------------------- blocks
+
+
+def block_forward(
+    p: Dict[str, PyTree],
+    x: jnp.ndarray,
+    cfg: TransformerConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+) -> jnp.ndarray:
+    """Pre-LN transformer block (``ParallelBlock``, transformer.py:48-72):
+    LN kept replicated and applied on the sequence shard; SP enters/leaves at
+    the attention/MLP boundaries.
+
+    x: [B, S_local, D] when ``sp`` else [B, S, D]."""
+    h = layer_norm(x, p["ln1"])
+    full = gather_from_sp(h, axis) if (axis and sp) else h
+    y = attention_partial(p["attn"], full, cfg)
+    y = _close_row_parallel(y, p["attn"]["bo"], axis, sp)
+    x = x + y
+
+    h = layer_norm(x, p["ln2"])
+    full = gather_from_sp(h, axis) if (axis and sp) else h
+    z = mlp_partial(p["mlp"], full)
+    z = _close_row_parallel(z, p["mlp"]["b2"], axis, sp)
+    return x + z
+
+
+def transformer_forward(
+    params: Dict[str, PyTree],
+    x: jnp.ndarray,
+    cfg: TransformerConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    gather_output: bool = True,
+) -> jnp.ndarray:
+    """Block stack with SP split/gather at the ends (``Transformer``,
+    transformer.py:88-100).  x: [B, S, D] full activation in.
+
+    With ``sp`` and ``gather_output=False`` the output stays sequence-sharded
+    ([B, S/tp, D] per shard) — pair it with an ``out_specs`` of
+    ``P(None, axis, None)`` so shard_map reassembles the full array without
+    spending the final all-gather the reference performs
+    (transformer.py:98-99); XLA's output layout does the job for free."""
+    if axis and sp:
+        x = split_to_sp(x, axis)
+    for bp in params["blocks"]:
+        x = block_forward(bp, x, cfg, axis=axis, sp=sp)
+    x = layer_norm(x, params["ln_f"])
+    if axis and sp and gather_output:
+        x = gather_from_sp(x, axis)
+    return x
+
+
+# ------------------------------------------------------------------------ init
+
+
+def init_block_params(key, cfg: TransformerConfig) -> Dict[str, PyTree]:
+    kq, ko, k1, k2 = jax.random.split(key, 4)
+    D, F = cfg.dim, cfg.ffn_dim
+    s = 1.0 / math.sqrt(D)
+    dt = cfg.dtype
+    return {
+        "ln1": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
+        "attn": {
+            "wqkv": (jax.random.normal(kq, (3, D, D)) * s).astype(dt),
+            "bqkv": jnp.zeros((3, D), dt),
+            "wo": (jax.random.normal(ko, (D, D)) * s).astype(dt),
+            "bo": jnp.zeros((D,), dt),
+        },
+        "ln2": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
+        "mlp": {
+            "w1": (jax.random.normal(k1, (D, F)) * s).astype(dt),
+            "b1": jnp.zeros((F,), dt),
+            "w2": (jax.random.normal(k2, (F, D)) * (1.0 / math.sqrt(F))).astype(dt),
+            "b2": jnp.zeros((D,), dt),
+        },
+    }
+
+
+def init_transformer_params(key, cfg: TransformerConfig) -> Dict[str, PyTree]:
+    keys = jax.random.split(key, cfg.nlayers)
+    return {
+        "blocks": [init_block_params(k, cfg) for k in keys],
+        "ln_f": {"scale": jnp.ones((cfg.dim,), cfg.dtype), "bias": jnp.zeros((cfg.dim,), cfg.dtype)},
+    }
+
+
+# ----------------------------------------------------------------------- specs
+
+
+def block_param_specs(axis: str = "tensor") -> Dict[str, PyTree]:
+    """PartitionSpec tree for one block under TP.  Column-parallel weights
+    shard their output dim, row-parallel their input dim; LN and row biases
+    replicated (added post-reduction exactly once)."""
+    return {
+        "ln1": {"scale": P(), "bias": P()},
+        "attn": {
+            "wqkv": P(None, None, axis),  # heads contiguous on last dim
+            "bqkv": P(None, axis),
+            "wo": P(axis, None),
+            "bo": P(),
+        },
+        "ln2": {"scale": P(), "bias": P()},
+        "mlp": {
+            "w1": P(None, axis),
+            "b1": P(axis),
+            "w2": P(axis, None),
+            "b2": P(),
+        },
+    }
+
+
+def transformer_param_specs(cfg: TransformerConfig, axis: str = "tensor") -> Dict[str, PyTree]:
+    return {
+        "blocks": [block_param_specs(axis) for _ in range(cfg.nlayers)],
+        "ln_f": {"scale": P(), "bias": P()},
+    }

@@ -1,0 +1,86 @@
+"""Tensor/sequence-parallel core ops — analogue of
+``torchdistpackage/parallel/tensor_parallel/tp_utils.py`` (248 LoC).
+
+The reference implements Megatron-style autograd regions by hand
+(`_ReduceFromModelParallelRegion`, `_GatherFromSequenceParallelRegion`,
+`_ReduceScatterToSequenceParallelRegion`, tp_utils.py:39-149) because eager
+PyTorch needs explicit backward rules.  Under ``shard_map`` + JAX AD the
+transposes come for free and *correctly*:
+
+- ``all_gather``   (SP gather, fwd)  <-AD->  ``psum_scatter`` (bwd)
+- ``psum_scatter`` (SP scatter, fwd) <-AD->  ``all_gather``   (bwd)
+- replicated operand entering a per-shard matmul (``pvary``) <-AD-> ``psum``
+  of its gradient — this is the Megatron "f" region whose backward all-reduce
+  the reference *misses* in non-SP mode (SURVEY.md §3.4); here it cannot be
+  missed.
+
+Unlike the reference, which keeps a module-global ``TP_GROUP`` disconnected
+from its own topology singleton (tp_utils.py:7-15 — an integration gap), the
+default axis here is the topology's canonical ``'tensor'`` axis, overridable
+per call.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...dist.topology import TENSOR_AXIS
+
+# Default mesh-axis name used by TP layers; override per-call via ``axis=``.
+_TP_AXIS = TENSOR_AXIS
+
+
+def set_tp_axis(name: str) -> None:
+    """Analogue of ``set_tp_group`` (tp_utils.py:12-15)."""
+    global _TP_AXIS
+    _TP_AXIS = name
+
+
+def get_tp_axis() -> str:
+    return _TP_AXIS
+
+
+def tp_size() -> int:
+    """Axis size — traced-safe inside shard_map."""
+    return jax.lax.axis_size(_TP_AXIS)
+
+
+# --------------------------------------------------------------------- regions
+# All of these are *traced* ops for use inside shard_map over the TP axis.
+# seq_dim defaults to 1 for [batch, seq, hidden] layout (TPU-friendly; the
+# reference uses seq-first dim 0, tp_utils.py:52-108 — layout is a free choice
+# here since XLA owns the memory layout anyway).
+
+
+def reduce_from_tp(x: jnp.ndarray, axis: Optional[str] = None) -> jnp.ndarray:
+    """Forward all-reduce over the TP axis (row-parallel output); backward is
+    identity — exactly `_ReduceFromModelParallelRegion` (tp_utils.py:39-49)."""
+    return jax.lax.psum(x, axis or _TP_AXIS)
+
+
+def gather_from_sp(x: jnp.ndarray, axis: Optional[str] = None, seq_dim: int = 1) -> jnp.ndarray:
+    """SP -> full: fwd all-gather along the sequence dim, bwd reduce-scatter
+    (`_GatherFromSequenceParallelRegion`, tp_utils.py:126-149)."""
+    return jax.lax.all_gather(x, axis or _TP_AXIS, axis=seq_dim, tiled=True)
+
+
+def scatter_to_sp(x: jnp.ndarray, axis: Optional[str] = None, seq_dim: int = 1) -> jnp.ndarray:
+    """Full -> SP: fwd reduce-scatter along the sequence dim, bwd all-gather
+    (`_ReduceScatterToSequenceParallelRegion`, tp_utils.py:110-123)."""
+    return jax.lax.psum_scatter(x, axis or _TP_AXIS, scatter_dimension=seq_dim, tiled=True)
+
+
+def split_to_sp(x: jnp.ndarray, axis: Optional[str] = None, seq_dim: int = 1) -> jnp.ndarray:
+    """Full -> SP without reduction: each shard keeps its sequence slice; bwd
+    all-gathers (`_split_along_first_dim`, tp_utils.py:88-108).  Used at the
+    model boundary to enter SP from a replicated activation."""
+    ax = axis or _TP_AXIS
+    n = jax.lax.axis_size(ax)
+    idx = jax.lax.axis_index(ax)
+    if x.shape[seq_dim] % n != 0:
+        raise ValueError(f"seq dim {x.shape[seq_dim]} not divisible by TP size {n}")
+    chunk = x.shape[seq_dim] // n
+    return jax.lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=seq_dim)
