@@ -71,7 +71,9 @@ def bench_collective(
         mesh = tpc.get_view()
     n = mesh.shape[axis]
     elem = jnp.dtype(dtype).itemsize
-    count = max(n, nbytes // elem // n * n)  # divisible by axis size
+    # divisible by n (and by n*n for all_to_all's [count//n, n] local split)
+    quantum = n * n if op == "all_to_all" else n
+    count = max(quantum, nbytes // elem // quantum * quantum)
 
     if op == "all_reduce":
         body = lambda x: jax.lax.psum(x, axis)

@@ -1,2 +1,10 @@
 from .data_parallel import DataParallel, reduce_gradients
+from .zero import ZeroOptimizer, zero_partition_spec
+from .clip import (
+    DynamicLossScale,
+    clip_by_global_norm_parallel,
+    clip_grads_by_global_norm,
+    global_grad_norm,
+)
 from . import tensor_parallel
+from . import pipeline_parallel

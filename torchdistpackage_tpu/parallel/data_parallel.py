@@ -119,6 +119,70 @@ def reduce_gradients(
     return jax.tree_util.tree_map_with_path(reduce_leaf, grads)
 
 
+def local_value_and_grad(
+    loss_fn: Callable[[PyTree, PyTree], jnp.ndarray],
+    params: PyTree,
+    batch: PyTree,
+    grad_accum_iters: int = 1,
+):
+    """(loss, grads) of the local mean loss; with accumulation, scans
+    microbatches (split from the leading batch dim) summing grads locally —
+    the reference's reduce-only-on-last-microbatch semantics
+    (naive_ddp.py:108-110).  Traced; call inside shard_map.  The scan carry's
+    varying axes are derived from an abstract eval so this works under any
+    TP/SP/PP composition inside ``loss_fn``."""
+    if grad_accum_iters == 1:
+        return jax.value_and_grad(loss_fn)(params, batch)
+
+    def split(x):
+        b = x.shape[0]
+        if b % grad_accum_iters != 0:
+            raise ValueError(
+                f"local batch dim {b} not divisible by grad_accum_iters {grad_accum_iters}"
+            )
+        return x.reshape(grad_accum_iters, b // grad_accum_iters, *x.shape[1:])
+
+    micro = jax.tree.map(split, batch)
+    first = jax.tree.map(lambda m: m[0], micro)
+    loss_aval, grads_aval = jax.eval_shape(
+        lambda p, mb: jax.value_and_grad(loss_fn)(p, mb), params, first
+    )
+
+    def zeros_like_aval(a):
+        z = jnp.zeros(a.shape, a.dtype)
+        vm = tuple(getattr(a, "vma", ()))
+        return _mark_varying(z, vm) if vm else z
+
+    def body(carry, mb):
+        ls, gs = carry
+        l, g = jax.value_and_grad(loss_fn)(params, mb)
+        return (ls + l, jax.tree.map(jnp.add, gs, g)), None
+
+    (loss, grads), _ = jax.lax.scan(
+        body,
+        (zeros_like_aval(loss_aval), jax.tree.map(zeros_like_aval, grads_aval)),
+        micro,
+    )
+    inv = 1.0 / grad_accum_iters
+    return loss * inv, jax.tree.map(lambda g: g * inv, grads)
+
+
+def normalize_model_axis_grads(loss, grads, mesh, data_axes: Tuple[str, ...]):
+    """Rescale raw local grads for model-axis redundancy: over non-data axes
+    the in-step AD has already summed each param's cotangents (shard_map
+    transpose semantics), so the grads correspond to the *sum* of the
+    per-model-shard losses; the true per-data-shard loss is their mean.
+    Returns (grads, other_axes) where other_axes are the non-data mesh axes
+    the loss varies on."""
+    other = tuple(a for a in mesh.axis_names if a not in data_axes and a in _vma(loss))
+    r = 1
+    for a in other:
+        r *= mesh.shape[a]
+    if r > 1:
+        grads = jax.tree.map(lambda g: g / r, grads)
+    return grads, other
+
+
 class DataParallel:
     """Builder of data-parallel (optionally grad-accumulating) train steps.
 
@@ -190,62 +254,11 @@ class DataParallel:
         axis = self.axis
         data_axes = (axis,) if isinstance(axis, str) else tuple(axis)
 
-        def local_grads(params, batch):
-            if grad_accum_iters == 1:
-                return jax.value_and_grad(loss_fn)(params, batch)
-
-            def split(x):
-                b = x.shape[0]
-                if b % grad_accum_iters != 0:
-                    raise ValueError(
-                        f"local batch dim {b} not divisible by grad_accum_iters {grad_accum_iters}"
-                    )
-                return x.reshape(grad_accum_iters, b // grad_accum_iters, *x.shape[1:])
-
-            micro = jax.tree.map(split, batch)
-
-            def body(carry, mb):
-                loss_sum, gsum = carry
-                loss, g = jax.value_and_grad(loss_fn)(params, mb)
-                return (loss_sum + loss, jax.tree.map(jnp.add, gsum, g)), None
-
-            # The carry's varying axes must match the loss/grads exactly —
-            # which depends on loss_fn internals (TP collectives etc.), so
-            # derive them from an abstract eval of one microbatch.
-            first = jax.tree.map(lambda m: m[0], micro)
-            loss_aval, grads_aval = jax.eval_shape(
-                lambda p, mb: jax.value_and_grad(loss_fn)(p, mb), params, first
-            )
-
-            def zeros_like_aval(a):
-                z = jnp.zeros(a.shape, a.dtype)
-                vm = tuple(getattr(a, "vma", ()))
-                return _mark_varying(z, vm) if vm else z
-
-            zeros = jax.tree.map(zeros_like_aval, grads_aval)
-            loss0 = zeros_like_aval(loss_aval)
-            (loss_sum, gsum), _ = jax.lax.scan(body, (loss0, zeros), micro)
-            inv = 1.0 / grad_accum_iters
-            return loss_sum * inv, jax.tree.map(lambda g: g * inv, gsum)
-
         def step(params, opt_state, batch):
             # Keep grads local over the data axes (one explicit reduce below).
             p_local = pvary_params(params, data_axes)
-            loss, grads = local_grads(p_local, batch)
-            # Over non-data (model) axes the in-step AD has already summed each
-            # param's cotangents (shard_map transpose semantics), so the raw
-            # grads are d(sum over model axes of local loss)/dp.  The true
-            # per-data-shard loss is the *mean* over those axes — whether each
-            # shard computed the loss redundantly (TP with gathered output) or
-            # partially (seq-sharded loss) — so rescale by their product.
-            other = tuple(
-                a for a in mesh.axis_names if a not in data_axes and a in _vma(loss)
-            )
-            r = 1
-            for a in other:
-                r *= mesh.shape[a]
-            if r > 1:
-                grads = jax.tree.map(lambda g: g / r, grads)
+            loss, grads = local_value_and_grad(loss_fn, p_local, batch, grad_accum_iters)
+            grads, other = normalize_model_axis_grads(loss, grads, mesh, data_axes)
             grads = reduce_gradients(grads, axis, self.reduce_op, self.grad_reduce_overrides)
             if other:
                 loss = jax.lax.pmean(loss, other)

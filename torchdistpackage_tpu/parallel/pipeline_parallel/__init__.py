@@ -1,0 +1,18 @@
+from .pipeline_helper import (
+    flat_and_partition,
+    param_count,
+    partition_balanced,
+    partition_uniform,
+    stack_stage_params,
+    stacked_param_specs,
+    unstack_stage_params,
+)
+from .pipeline_sched import (
+    is_first_stage,
+    is_last_stage,
+    last_stage_value,
+    pipeline_forward,
+    pipeline_loss,
+    shift_right,
+    stage_index,
+)

@@ -1,0 +1,135 @@
+"""Pipeline model partitioning — analogue of
+``torchdistpackage/parallel/pipeline_parallel/pipeline_helper.py`` (183 LoC).
+
+The reference flattens a model into a module list and partitions it uniformly
+(pipeline_helper.py:6-17) or balanced by param count via binary search + heap
+refinement (pipeline_helper.py:20-111).  Here models are param pytrees; the
+partitioners work on per-layer weight counts and return stage boundaries, and
+:func:`stack_stage_params` reorganizes a per-layer param list into
+stage-stacked global arrays ready to shard over the ``pipe`` axis (each stage
+owns a contiguous, equal-size slab — the layout the scan-based SPMD schedule
+needs)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+PyTree = Any
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[Tuple[int, int]]:
+    """Contiguous [start, end) ranges, as even as possible
+    (pipeline_helper.py:6-17 semantics)."""
+    if num_parts <= 0:
+        raise ValueError("num_parts must be positive")
+    base = num_items // num_parts
+    rem = num_items % num_parts
+    bounds = []
+    start = 0
+    for i in range(num_parts):
+        size = base + (1 if i < rem else 0)
+        bounds.append((start, start + size))
+        start += size
+    return bounds
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[Tuple[int, int]]:
+    """Contiguous partition minimizing the max part weight — binary search on
+    the bottleneck + greedy packing, then boundary refinement
+    (pipeline_helper.py:20-111 semantics, simpler implementation)."""
+    w = [float(x) for x in weights]
+    n = len(w)
+    if num_parts > n:
+        raise ValueError(f"cannot split {n} layers into {num_parts} stages")
+
+    def parts_needed(cap: float) -> int:
+        parts, cur = 1, 0.0
+        for x in w:
+            if x > cap:
+                return num_parts + 1
+            if cur + x > cap:
+                parts += 1
+                cur = x
+            else:
+                cur += x
+        return parts
+
+    lo, hi = max(w), sum(w)
+    for _ in range(100):
+        mid = (lo + hi) / 2
+        if parts_needed(mid) <= num_parts:
+            hi = mid
+        else:
+            lo = mid
+    cap = hi
+    # greedy pack at capacity, then force exactly num_parts parts
+    bounds: List[Tuple[int, int]] = []
+    start, cur = 0, 0.0
+    for i, x in enumerate(w):
+        if cur + x > cap and i > start:
+            bounds.append((start, i))
+            start, cur = i, x
+        else:
+            cur += x
+    bounds.append((start, n))
+    while len(bounds) < num_parts:  # split the heaviest splittable part
+        sizes = [sum(w[a:b]) if b - a > 1 else -1 for a, b in bounds]
+        j = int(np.argmax(sizes))
+        a, b = bounds[j]
+        best, best_diff = a + 1, float("inf")
+        for cut in range(a + 1, b):
+            diff = abs(sum(w[a:cut]) - sum(w[cut:b]))
+            if diff < best_diff:
+                best, best_diff = cut, diff
+        bounds[j : j + 1] = [(a, best), (best, b)]
+    return bounds
+
+
+def flat_and_partition(
+    weights: Sequence[float], num_parts: int, method: str = "balanced"
+) -> List[Tuple[int, int]]:
+    """Dispatch like the reference's ``flat_and_partition``
+    (pipeline_helper.py:179-183)."""
+    if method == "uniform":
+        return partition_uniform(len(weights), num_parts)
+    if method == "balanced":
+        return partition_balanced(weights, num_parts)
+    raise ValueError(f"unknown partition method {method!r}")
+
+
+def param_count(tree: PyTree) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+def stack_stage_params(layer_params: List[PyTree]) -> PyTree:
+    """Stack a list of homogeneous per-layer param trees into arrays with a
+    leading ``[num_layers]`` dim — shard that dim over 'pipe' so each stage
+    holds its contiguous slab, and scan over it within the stage."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *layer_params)
+
+
+def unstack_stage_params(stacked: PyTree) -> List[PyTree]:
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
+
+
+def stacked_param_specs(
+    stacked: PyTree,
+    pipe_axis: str = "pipe",
+    inner_specs: Optional[PyTree] = None,
+) -> PyTree:
+    """PartitionSpecs for stacked layer params: 'pipe' on the layer dim,
+    composed with optional per-leaf TP specs for the remaining dims."""
+
+    def one(x, inner):
+        entries = tuple(inner) if inner is not None else ()
+        return P(pipe_axis, *entries)
+
+    if inner_specs is None:
+        return jax.tree.map(lambda x: P(pipe_axis), stacked)
+    return jax.tree.map(one, stacked, inner_specs)

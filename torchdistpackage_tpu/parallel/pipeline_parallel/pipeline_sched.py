@@ -1,0 +1,210 @@
+"""SPMD pipeline schedule — analogue of the reference's 1F1B scheduler +
+p2p comm layer (``pipeline_parallel/pipeline_sched.py`` 269 LoC,
+``pipeline_parallel/comm.py`` 595 LoC).
+
+The reference drives warmup -> steady 1F1B -> cooldown from Python, moving
+activations with batched NCCL isend/irecv guarded by a shape-meta handshake
+(comm.py:26-105) and a defensive ``cuda.synchronize`` (comm.py:326-327).
+Under XLA the whole schedule is **one compiled collective program**:
+
+- microbatches advance through stages inside a ``lax.scan`` over
+  ``M + P - 1`` ticks (fill -> steady -> drain);
+- inter-stage transfer is a single ``ppermute`` per tick over the ``pipe``
+  axis — shapes are static at trace time, so the reference's entire meta
+  protocol and race guard vanish by construction;
+- backward is JAX AD through the scan: the transpose of ``ppermute`` is the
+  reverse ``ppermute``, which *is* the backward pipeline, microbatch grads
+  accumulating in the scan-carry — the reference's grad-accumulate-then-
+  reduce-once behavior (naive_ddp.py:108-110) falls out;
+- peak memory is governed by ``jax.checkpoint`` around the stage body
+  (1F1B's raison d'être — bounded live activations — achieved by remat
+  rather than schedule order, which XLA controls anyway);
+- the pipeline bubble is the same (P-1)/(M+P-1) as the reference's 1F1B.
+
+Non-linear stage graphs (the reference supports CLIP-style fwd_fn/bwd_fn
+pairs, Intro.md:54-66) are supported the same way: ``stage_fn`` is arbitrary
+user code — it sees (stage_params, activation, per-tick aux) and can branch on
+``stage_index``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ...dist.topology import PIPE_AXIS
+
+PyTree = Any
+
+
+def _stage_probe(stage_params, microbatches, stage_fn, pipe_axis):
+    """(zero_state, want_vma): the stage activation's shape/dtype and the
+    varying-axis set the scan carry must hold — activations vary over every
+    axis the inputs/params vary over, plus pipe (via ppermute).  Shape-infers
+    with a probe input carrying the full vma so stage_fn-internal scans see
+    consistent carry types."""
+    from ..data_parallel import _mark_varying, _vma
+
+    want_vma = _vma(microbatches) | _vma(jax.tree.leaves(stage_params)[0]) | {pipe_axis}
+    probe = microbatches[0]
+    missing = tuple(a for a in want_vma if a not in _vma(probe))
+    if missing:
+        probe = _mark_varying(probe, missing)
+    out_shape = jax.eval_shape(stage_fn, stage_params, probe)
+    zero_state = jnp.zeros(out_shape.shape, out_shape.dtype)
+    missing = tuple(a for a in want_vma if a not in _vma(zero_state))
+    if missing:
+        zero_state = _mark_varying(zero_state, missing)
+    return zero_state, want_vma
+
+
+def stage_index(pipe_axis: str = PIPE_AXIS):
+    return jax.lax.axis_index(pipe_axis)
+
+
+def is_first_stage(pipe_axis: str = PIPE_AXIS):
+    return jax.lax.axis_index(pipe_axis) == 0
+
+
+def is_last_stage(pipe_axis: str = PIPE_AXIS):
+    return jax.lax.axis_index(pipe_axis) == jax.lax.axis_size(pipe_axis) - 1
+
+
+def last_stage_value(x, pipe_axis: str = PIPE_AXIS):
+    """Cheaply broadcast a (small) per-stage value from the last stage to all
+    stages: mask + psum.  The scalar analogue of the reference's loss returned
+    by the final stage."""
+    return jax.lax.psum(jnp.where(is_last_stage(pipe_axis), x, jnp.zeros_like(x)), pipe_axis)
+
+
+def shift_right(x, pipe_axis: str = PIPE_AXIS):
+    """Send to the next stage (non-circular): stage s's value arrives at s+1;
+    stage 0 receives zeros.  The ppermute analogue of
+    send_forward/recv_forward (comm.py:362-435)."""
+    n = jax.lax.axis_size(pipe_axis)
+    return jax.lax.ppermute(x, pipe_axis, [(i, i + 1) for i in range(n - 1)])
+
+
+def pipeline_forward(
+    stage_params: PyTree,
+    microbatches: jnp.ndarray,
+    stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
+    num_microbatches: int,
+    pipe_axis: str = PIPE_AXIS,
+    remat: bool = True,
+    collect_outputs: bool = True,
+):
+    """Run the pipelined forward inside shard_map.
+
+    - ``stage_params``: this stage's local params (e.g. its slab of stacked
+      layers, ``[L_local, ...]`` leaves).
+    - ``microbatches``: ``[M, mbs, ...]`` local microbatch inputs (only read
+      on stage 0; pass the same array everywhere).
+    - ``stage_fn(stage_params, x) -> y``: one stage's compute; activations
+      must keep shape/dtype across stages (classic linear pipeline).
+
+    Returns ``outputs`` of shape ``[M, mbs, ...]`` — valid on the **last**
+    stage (garbage elsewhere; combine with :func:`last_stage_value` or mask).
+    When ``collect_outputs=False`` returns None (use the scanning loss variant
+    in :func:`pipeline_loss` instead to avoid materializing outputs).
+    """
+    M = num_microbatches
+    P_ = jax.lax.axis_size(pipe_axis)
+    ticks = M + P_ - 1
+    first = is_first_stage(pipe_axis)
+
+    body_fn = stage_fn
+    if remat:
+        body_fn = jax.checkpoint(stage_fn)
+
+    from ..data_parallel import _mark_varying, _vma
+
+    zero_state, want_vma = _stage_probe(stage_params, microbatches, stage_fn, pipe_axis)
+
+    outputs = None
+    if collect_outputs:
+        outputs = jnp.zeros((M,) + zero_state.shape, zero_state.dtype)
+        o_missing = tuple(a for a in want_vma if a not in _vma(outputs))
+        if o_missing:
+            outputs = _mark_varying(outputs, o_missing)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 consumes microbatch t (clamped in the drain phase — those
+        # results never reach the loss); others consume what arrived
+        mb = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, M - 1), axis=0, keepdims=False
+        )
+        x = jnp.where(first, mb, state)
+        y = body_fn(stage_params, x)
+        nxt = shift_right(y, pipe_axis)
+        if outputs is not None:
+            idx = jnp.maximum(t - (P_ - 1), 0)
+            outputs = jax.lax.cond(
+                t >= P_ - 1,
+                lambda o: jax.lax.dynamic_update_index_in_dim(o, y, idx, axis=0),
+                lambda o: o,
+                outputs,
+            )
+        return (nxt, outputs), None
+
+    (state, outputs), _ = jax.lax.scan(
+        tick, (zero_state, outputs), jnp.arange(ticks)
+    )
+    return outputs
+
+
+def pipeline_loss(
+    stage_params: PyTree,
+    microbatches: jnp.ndarray,
+    targets: jnp.ndarray,
+    stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
+    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    num_microbatches: int,
+    pipe_axis: str = PIPE_AXIS,
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Pipelined forward + per-microbatch loss on the last stage, without
+    materializing the output buffer.  Returns the mean loss, valid on every
+    stage (masked psum broadcast).
+
+    ``targets``: ``[M, mbs, ...]`` — read on the last stage only.
+    ``loss_fn(y, target) -> scalar`` (mean over the microbatch).
+    """
+    M = num_microbatches
+    P_ = jax.lax.axis_size(pipe_axis)
+    ticks = M + P_ - 1
+    first = is_first_stage(pipe_axis)
+    last = is_last_stage(pipe_axis)
+
+    body_fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    from ..data_parallel import _mark_varying, _vma
+
+    zero_state, want_vma = _stage_probe(stage_params, microbatches, stage_fn, pipe_axis)
+    loss0 = jnp.zeros(())
+    l_missing = tuple(a for a in (want_vma | _vma(targets)) if a not in _vma(loss0))
+    if l_missing:
+        loss0 = _mark_varying(loss0, l_missing)
+
+    def tick(carry, t):
+        state, loss_sum = carry
+        mb = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, M - 1), axis=0, keepdims=False
+        )
+        x = jnp.where(first, mb, state)
+        y = body_fn(stage_params, x)
+        nxt = shift_right(y, pipe_axis)
+        # last stage: microbatch (t - P + 1) completed this tick
+        m_idx = jnp.maximum(t - (P_ - 1), 0)
+        tgt = jax.lax.dynamic_index_in_dim(targets, m_idx, axis=0, keepdims=False)
+        mb_loss = loss_fn(y, tgt)
+        valid = jnp.logical_and(last, t >= P_ - 1)
+        loss_sum = loss_sum + jnp.where(valid, mb_loss, 0.0)
+        return (nxt, loss_sum), None
+
+    (_, loss_sum), _ = jax.lax.scan(tick, (zero_state, loss0), jnp.arange(ticks))
+    # broadcast from the last stage; grads flow back through the mask
+    return jax.lax.psum(loss_sum, pipe_axis) / M
