@@ -1,0 +1,99 @@
+"""Benchmark: flagship GPT training throughput (tokens/sec/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+On TPU: a GPT-125M-class model at seq 2048, bf16 matmuls, full train step
+(fwd+bwd+adamw) on the available chip(s) (single-chip DP mesh when only one).
+On CPU (no TPU attached): a tiny config so the harness still produces a line.
+``vs_baseline`` compares against BENCH_BASELINE.json if present (first
+recorded measurement wins as baseline — the reference publishes no numbers,
+BASELINE.md), else 1.0.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    import optax
+
+    from torchdistpackage_tpu.models import GPTConfig, gpt_loss, init_gpt_params
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+
+    if on_accel:
+        cfg = GPTConfig(
+            vocab_size=32768, dim=768, nheads=12, nlayers=12, max_seq=2048,
+            ffn_mult=4, dtype=jnp.bfloat16,
+        )
+        batch_size, steps, warmup = 8, 20, 3
+    else:
+        cfg = GPTConfig(
+            vocab_size=512, dim=128, nheads=4, nlayers=4, max_seq=256,
+            ffn_mult=2, dtype=jnp.float32,
+        )
+        batch_size, steps, warmup = 4, 5, 2
+
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4)
+    state = opt.init(params)
+
+    def loss_fn(p, batch):
+        return gpt_loss(p, batch, cfg)
+
+    @jax.jit
+    def step(params, state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, state = opt.update(grads, state, params)
+        return jax.tree.map(jnp.add, params, updates), state, loss
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    batch = {
+        "tokens": jax.random.randint(k1, (batch_size, cfg.max_seq), 0, cfg.vocab_size),
+        "targets": jax.random.randint(k2, (batch_size, cfg.max_seq), 0, cfg.vocab_size),
+    }
+
+    for _ in range(warmup):
+        params, state, loss = step(params, state, batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, state, loss = step(params, state, batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    n_chips = max(1, jax.device_count())
+    tokens_per_sec_chip = batch_size * cfg.max_seq * steps / dt / n_chips
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+    vs_baseline = 1.0
+    try:
+        with open(baseline_path) as f:
+            base = json.load(f)
+        if base.get("backend") == backend and base.get("value"):
+            vs_baseline = tokens_per_sec_chip / float(base["value"])
+    except (OSError, ValueError):
+        with open(baseline_path, "w") as f:
+            json.dump(
+                {"backend": backend, "value": tokens_per_sec_chip,
+                 "unit": "tokens/sec/chip",
+                 "config": f"gpt d{cfg.dim} L{cfg.nlayers} seq{cfg.max_seq} b{batch_size}"},
+                f,
+            )
+
+    print(json.dumps({
+        "metric": f"gpt-{'125m' if on_accel else 'tiny'}-train-throughput",
+        "value": round(tokens_per_sec_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -144,6 +144,51 @@ def test_pipeline_loss_and_grads_match_serial(devices8):
         )
 
 
+@pytest.mark.parametrize("sp", [False, True])
+def test_pipeline_with_tp_probe(devices8, sp):
+    """Regression: the scan-carry vma probe must track the stage OUTPUT's
+    varying axes, not guess from the first param leaf — PP x TP non-SP
+    (output psum-reduced over tensor => carry must NOT be tensor-varying)
+    and PP x TP SP (seq-sharded carry => tensor-varying) both trace."""
+    pp, tp = 2, 2
+    tpc.setup_process_groups([("pipe", pp), ("tensor", tp)], devices=devices8[:4])
+    mesh = tpc.get_view()
+    layers, stacked = _layers_and_stack()
+    from torchdistpackage_tpu.parallel.tensor_parallel import block_param_specs
+
+    bspecs = block_param_specs("tensor")
+    specs = jax.tree.map(
+        lambda s: P("pipe", *tuple(s)), bspecs, is_leaf=lambda x: isinstance(x, P)
+    )
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), stacked, specs
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, MBS, S, CFG.dim))
+
+    def stage_fn(sp_params, h):
+        def body(h, lp):
+            return block_forward(lp, h, CFG, axis="tensor", sp=sp), None
+
+        h, _ = jax.lax.scan(body, h, sp_params)
+        return h
+
+    in_x_spec = P(None, None, "tensor") if sp else P()
+
+    def body(params, mbs):
+        out = pipeline_forward(params, mbs, stage_fn, num_microbatches=M)
+        return last_stage_value(out)
+
+    fwd = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=(specs, in_x_spec), out_specs=in_x_spec)
+    )
+    out = fwd(sharded, x)
+
+    want = jnp.stack(
+        [_serial_forward(layers, x[m]) for m in range(M)]
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
 def test_pipeline_with_dp(devices8):
     """PP=2 x DP=4: pipelined loss inside a DataParallel train step."""
     import optax

@@ -1,0 +1,10 @@
+from .gpt import (
+    GPTConfig,
+    gpt_forward,
+    gpt_loss,
+    gpt_param_specs,
+    gpt_pipeline_loss,
+    init_gpt_params,
+    vocab_parallel_embed,
+    vocab_parallel_xent,
+)

@@ -41,18 +41,31 @@ PyTree = Any
 
 def _stage_probe(stage_params, microbatches, stage_fn, pipe_axis):
     """(zero_state, want_vma): the stage activation's shape/dtype and the
-    varying-axis set the scan carry must hold — activations vary over every
-    axis the inputs/params vary over, plus pipe (via ppermute).  Shape-infers
-    with a probe input carrying the full vma so stage_fn-internal scans see
-    consistent carry types."""
+    varying-axis set the scan carry must hold.
+
+    The carry's vma is a fixed point: the tick computes
+    ``shift_right(stage_fn(params, where(first, mb, state)))``, so the state
+    must vary over exactly ``vma(stage_fn output) | vma(mb) | {pipe}`` — which
+    itself depends on the state's vma.  Iterate ``jax.eval_shape`` (whose
+    results carry vma) until stable; this handles both under-marking (output
+    picks up axes from sharded params) and over-marking (output drops axes via
+    an internal psum) for any TP/SP/PP composition."""
     from ..data_parallel import _mark_varying, _vma
 
-    want_vma = _vma(microbatches) | _vma(jax.tree.leaves(stage_params)[0]) | {pipe_axis}
-    probe = microbatches[0]
-    missing = tuple(a for a in want_vma if a not in _vma(probe))
-    if missing:
-        probe = _mark_varying(probe, missing)
-    out_shape = jax.eval_shape(stage_fn, stage_params, probe)
+    mb_vma = _vma(microbatches)
+    want_vma = mb_vma | {pipe_axis}
+    probe0 = microbatches[0]
+    out_shape = None
+    for _ in range(8):  # bounded by the number of mesh axes
+        probe = probe0
+        missing = tuple(a for a in want_vma if a not in _vma(probe))
+        if missing:
+            probe = _mark_varying(probe, missing)
+        out_shape = jax.eval_shape(stage_fn, stage_params, probe)
+        new_want = frozenset(getattr(out_shape, "vma", frozenset())) | mb_vma | {pipe_axis}
+        if new_want == want_vma:
+            break
+        want_vma = new_want
     zero_state = jnp.zeros(out_shape.shape, out_shape.dtype)
     missing = tuple(a for a in want_vma if a not in _vma(zero_state))
     if missing:
@@ -87,6 +100,54 @@ def shift_right(x, pipe_axis: str = PIPE_AXIS):
     return jax.lax.ppermute(x, pipe_axis, [(i, i + 1) for i in range(n - 1)])
 
 
+def _pipeline_scan(
+    stage_params: PyTree,
+    microbatches: jnp.ndarray,
+    stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
+    num_microbatches: int,
+    pipe_axis: str,
+    remat: bool,
+    make_acc: Callable,
+    consume: Callable,
+):
+    """Shared fill -> steady -> drain scan driver for the pipelined schedules.
+
+    Each tick: stage 0 consumes microbatch ``min(t, M-1)`` (clamped in the
+    drain phase — those results never reach a consumer), other stages consume
+    what ``shift_right`` delivered; the stage output is both shifted onward
+    and handed to ``consume``.
+
+    - ``make_acc(zero_state, want_vma) -> acc0`` builds the scan's accumulator
+      (output buffer / loss sum / None).
+    - ``consume(acc, y, m_idx, steady) -> acc`` folds in the stage output for
+      completed microbatch ``m_idx``; ``steady`` is the traced ``t >= P-1``
+      validity predicate.
+    """
+    M = num_microbatches
+    P_ = jax.lax.axis_size(pipe_axis)
+    ticks = M + P_ - 1
+    first = is_first_stage(pipe_axis)
+    body_fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    zero_state, want_vma = _stage_probe(stage_params, microbatches, stage_fn, pipe_axis)
+    acc0 = make_acc(zero_state, want_vma)
+
+    def tick(carry, t):
+        state, acc = carry
+        mb = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, M - 1), axis=0, keepdims=False
+        )
+        x = jnp.where(first, mb, state)
+        y = body_fn(stage_params, x)
+        nxt = shift_right(y, pipe_axis)
+        m_idx = jnp.maximum(t - (P_ - 1), 0)
+        acc = consume(acc, y, m_idx, t >= P_ - 1)
+        return (nxt, acc), None
+
+    (_, acc), _ = jax.lax.scan(tick, (zero_state, acc0), jnp.arange(ticks))
+    return acc
+
+
 def pipeline_forward(
     stage_params: PyTree,
     microbatches: jnp.ndarray,
@@ -110,50 +171,30 @@ def pipeline_forward(
     When ``collect_outputs=False`` returns None (use the scanning loss variant
     in :func:`pipeline_loss` instead to avoid materializing outputs).
     """
-    M = num_microbatches
-    P_ = jax.lax.axis_size(pipe_axis)
-    ticks = M + P_ - 1
-    first = is_first_stage(pipe_axis)
-
-    body_fn = stage_fn
-    if remat:
-        body_fn = jax.checkpoint(stage_fn)
-
     from ..data_parallel import _mark_varying, _vma
 
-    zero_state, want_vma = _stage_probe(stage_params, microbatches, stage_fn, pipe_axis)
+    M = num_microbatches
 
-    outputs = None
-    if collect_outputs:
+    def make_acc(zero_state, want_vma):
+        if not collect_outputs:
+            return None
         outputs = jnp.zeros((M,) + zero_state.shape, zero_state.dtype)
-        o_missing = tuple(a for a in want_vma if a not in _vma(outputs))
-        if o_missing:
-            outputs = _mark_varying(outputs, o_missing)
+        missing = tuple(a for a in want_vma if a not in _vma(outputs))
+        return _mark_varying(outputs, missing) if missing else outputs
 
-    def tick(carry, t):
-        state, outputs = carry
-        # stage 0 consumes microbatch t (clamped in the drain phase — those
-        # results never reach the loss); others consume what arrived
-        mb = jax.lax.dynamic_index_in_dim(
-            microbatches, jnp.minimum(t, M - 1), axis=0, keepdims=False
+    def consume(outputs, y, m_idx, steady):
+        if outputs is None:
+            return None
+        return jax.lax.cond(
+            steady,
+            lambda o: jax.lax.dynamic_update_index_in_dim(o, y, m_idx, axis=0),
+            lambda o: o,
+            outputs,
         )
-        x = jnp.where(first, mb, state)
-        y = body_fn(stage_params, x)
-        nxt = shift_right(y, pipe_axis)
-        if outputs is not None:
-            idx = jnp.maximum(t - (P_ - 1), 0)
-            outputs = jax.lax.cond(
-                t >= P_ - 1,
-                lambda o: jax.lax.dynamic_update_index_in_dim(o, y, idx, axis=0),
-                lambda o: o,
-                outputs,
-            )
-        return (nxt, outputs), None
 
-    (state, outputs), _ = jax.lax.scan(
-        tick, (zero_state, outputs), jnp.arange(ticks)
+    return _pipeline_scan(
+        stage_params, microbatches, stage_fn, M, pipe_axis, remat, make_acc, consume
     )
-    return outputs
 
 
 def pipeline_loss(
@@ -173,38 +214,24 @@ def pipeline_loss(
     ``targets``: ``[M, mbs, ...]`` — read on the last stage only.
     ``loss_fn(y, target) -> scalar`` (mean over the microbatch).
     """
-    M = num_microbatches
-    P_ = jax.lax.axis_size(pipe_axis)
-    ticks = M + P_ - 1
-    first = is_first_stage(pipe_axis)
-    last = is_last_stage(pipe_axis)
-
-    body_fn = jax.checkpoint(stage_fn) if remat else stage_fn
-
     from ..data_parallel import _mark_varying, _vma
 
-    zero_state, want_vma = _stage_probe(stage_params, microbatches, stage_fn, pipe_axis)
-    loss0 = jnp.zeros(())
-    l_missing = tuple(a for a in (want_vma | _vma(targets)) if a not in _vma(loss0))
-    if l_missing:
-        loss0 = _mark_varying(loss0, l_missing)
+    M = num_microbatches
+    last = is_last_stage(pipe_axis)
 
-    def tick(carry, t):
-        state, loss_sum = carry
-        mb = jax.lax.dynamic_index_in_dim(
-            microbatches, jnp.minimum(t, M - 1), axis=0, keepdims=False
-        )
-        x = jnp.where(first, mb, state)
-        y = body_fn(stage_params, x)
-        nxt = shift_right(y, pipe_axis)
-        # last stage: microbatch (t - P + 1) completed this tick
-        m_idx = jnp.maximum(t - (P_ - 1), 0)
+    def make_acc(zero_state, want_vma):
+        loss0 = jnp.zeros(())
+        missing = tuple(a for a in (want_vma | _vma(targets)) if a not in _vma(loss0))
+        return _mark_varying(loss0, missing) if missing else loss0
+
+    def consume(loss_sum, y, m_idx, steady):
         tgt = jax.lax.dynamic_index_in_dim(targets, m_idx, axis=0, keepdims=False)
         mb_loss = loss_fn(y, tgt)
-        valid = jnp.logical_and(last, t >= P_ - 1)
-        loss_sum = loss_sum + jnp.where(valid, mb_loss, 0.0)
-        return (nxt, loss_sum), None
+        valid = jnp.logical_and(last, steady)
+        return loss_sum + jnp.where(valid, mb_loss, 0.0)
 
-    (_, loss_sum), _ = jax.lax.scan(tick, (zero_state, loss0), jnp.arange(ticks))
+    loss_sum = _pipeline_scan(
+        stage_params, microbatches, stage_fn, M, pipe_axis, remat, make_acc, consume
+    )
     # broadcast from the last stage; grads flow back through the mask
     return jax.lax.psum(loss_sum, pipe_axis) / M

@@ -131,16 +131,11 @@ class ZeroOptimizer:
             if self.param_specs is not None
             else jax.tree.map(lambda _: P(), params)
         )
-        zero_specs = jax.tree.map(
-            lambda x, s: zero_partition_spec(x.shape, s, self.shard_axis, n)[0],
-            params,
-            p_specs,
-        )
-        shard_dims = jax.tree.map(
-            lambda x, s: zero_partition_spec(x.shape, s, self.shard_axis, n)[1],
-            params,
-            p_specs,
-        )
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_s = treedef.flatten_up_to(p_specs)
+        pairs = [zero_partition_spec(x.shape, s, self.shard_axis, n) for x, s in zip(flat_p, flat_s)]
+        zero_specs = jax.tree_util.tree_unflatten(treedef, [sp for sp, _ in pairs])
+        shard_dims = jax.tree_util.tree_unflatten(treedef, [d for _, d in pairs])
         return p_specs, zero_specs, shard_dims
 
     def _local_shape(self, x, spec) -> jax.ShapeDtypeStruct:
