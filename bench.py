@@ -46,6 +46,17 @@ def main() -> None:
     def loss_fn(p, batch):
         return gpt_loss(p, batch, cfg)
 
+    # DP mesh over all attached chips so per-chip throughput is honest on
+    # multi-chip hosts: params replicated, batch sharded on its leading dim.
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n_chips = max(1, jax.device_count())
+    mesh = Mesh(jax.devices(), axis_names=("data",))
+    replicated = NamedSharding(mesh, P())
+    batch_sharded = NamedSharding(mesh, P("data"))
+    params = jax.device_put(params, replicated)
+    state = jax.device_put(state, replicated)
+
     @jax.jit
     def step(params, state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -53,10 +64,12 @@ def main() -> None:
         return jax.tree.map(jnp.add, params, updates), state, loss
 
     k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    global_batch = batch_size * n_chips
     batch = {
-        "tokens": jax.random.randint(k1, (batch_size, cfg.max_seq), 0, cfg.vocab_size),
-        "targets": jax.random.randint(k2, (batch_size, cfg.max_seq), 0, cfg.vocab_size),
+        "tokens": jax.random.randint(k1, (global_batch, cfg.max_seq), 0, cfg.vocab_size),
+        "targets": jax.random.randint(k2, (global_batch, cfg.max_seq), 0, cfg.vocab_size),
     }
+    batch = jax.device_put(batch, batch_sharded)
 
     for _ in range(warmup):
         params, state, loss = step(params, state, batch)
@@ -68,24 +81,29 @@ def main() -> None:
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
-    n_chips = max(1, jax.device_count())
-    tokens_per_sec_chip = batch_size * cfg.max_seq * steps / dt / n_chips
+    tokens_per_sec_chip = global_batch * cfg.max_seq * steps / dt / n_chips
 
+    # Baselines are recorded per backend (first measurement for a backend
+    # wins); the file maps backend name -> record.
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
-    vs_baseline = 1.0
     try:
         with open(baseline_path) as f:
-            base = json.load(f)
-        if base.get("backend") == backend and base.get("value"):
-            vs_baseline = tokens_per_sec_chip / float(base["value"])
+            baselines = json.load(f)
+        if "backend" in baselines and "value" in baselines:  # legacy flat format
+            baselines = {baselines["backend"]: baselines}
     except (OSError, ValueError):
+        baselines = {}
+    vs_baseline = 1.0
+    if backend in baselines and baselines[backend].get("value"):
+        vs_baseline = tokens_per_sec_chip / float(baselines[backend]["value"])
+    else:
+        baselines[backend] = {
+            "backend": backend, "value": tokens_per_sec_chip,
+            "unit": "tokens/sec/chip",
+            "config": f"gpt d{cfg.dim} L{cfg.nlayers} seq{cfg.max_seq} b{global_batch}",
+        }
         with open(baseline_path, "w") as f:
-            json.dump(
-                {"backend": backend, "value": tokens_per_sec_chip,
-                 "unit": "tokens/sec/chip",
-                 "config": f"gpt d{cfg.dim} L{cfg.nlayers} seq{cfg.max_seq} b{batch_size}"},
-                f,
-            )
+            json.dump(baselines, f)
 
     print(json.dumps({
         "metric": f"gpt-{'125m' if on_accel else 'tiny'}-train-throughput",

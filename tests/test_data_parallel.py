@@ -117,8 +117,12 @@ def test_grad_reduce_overrides_moe_dp_semantics(devices8):
     # shared grad = global mean(x) = 3.5, averaged over all 8 shards
     np.testing.assert_allclose(np.asarray(g["shared"]), 3.5, rtol=1e-6)
     # device (dp, ep) holds x element dp*4+ep, so its local grad is that
-    # value; averaging over moe_dp only gives ep rank j: (j + (j+4))/2 = j+2
-    want = np.array([2.0, 3.0, 4.0, 5.0])
+    # value.  Override + 'mean' = mean over the GLOBAL batch: psum over
+    # moe_dp, normalized by the full data-group size (8) — each expert sees
+    # only 1/ep of the batch, so this is the true d(global mean loss)/d(w),
+    # matching serial training exactly (see test_moe.py).  For ep rank j:
+    # (j + (j+4)) / 8.
+    want = (np.arange(4.0) * 2 + 4.0) / 8.0
     got = np.asarray(g["expert"])
     np.testing.assert_allclose(got, want, rtol=1e-6)
 

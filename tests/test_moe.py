@@ -1,0 +1,163 @@
+"""MoE golden tests, in the reference's discipline (SURVEY.md §4): same
+weights, serial model vs EP-sharded model, forward AND training parity.
+The reference has no native MoE dispatch to test against (it delegates to
+DeepSpeed forks, explore/moe/ds_fmoe_main.py) — the golden here is a dense
+per-token mixture computed with plain einsums."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchdistpackage_tpu.dist import tpc
+from torchdistpackage_tpu.parallel.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_forward,
+    moe_grad_reduce_overrides,
+    moe_param_specs,
+)
+
+CFG = MoEConfig(dim=16, ffn_dim=32, num_experts=4, top_k=2, capacity_factor=4.0)
+
+
+def dense_mixture_golden(params, x, cfg):
+    """Every token through every expert, combined by renormalized top-k gates
+    (valid when capacity drops nothing)."""
+    B, S, D = x.shape
+    t = x.reshape(-1, D)
+    probs = jax.nn.softmax((t @ params["router"]["w"]).astype(jnp.float32), axis=-1)
+    gv, gi = jax.lax.top_k(probs, cfg.top_k)
+    gv = gv / jnp.sum(gv, axis=-1, keepdims=True)
+    w = jnp.zeros_like(probs)
+    for j in range(cfg.top_k):
+        w = w + jax.nn.one_hot(gi[:, j], cfg.num_experts) * gv[:, j : j + 1]
+    e = params["experts"]
+    h = jax.nn.gelu(jnp.einsum("td,edf->etf", t, e["w1"]) + e["b1"][:, None, :])
+    out = jnp.einsum("etf,efd->etd", h, e["w2"]) + e["b2"][:, None, :]
+    y = jnp.einsum("te,etd->td", w.astype(x.dtype), out)
+    return y.reshape(B, S, D)
+
+
+def test_moe_serial_matches_dense_golden():
+    params = init_moe_params(jax.random.PRNGKey(0), CFG)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, CFG.dim))
+    y, aux = moe_forward(params, x, CFG)
+    golden = dense_mixture_golden(params, x, CFG)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(golden), rtol=1e-5, atol=1e-5)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_moe_capacity_drops_are_zero():
+    # capacity 1 slot/expert: overflowing tokens must contribute exactly zero
+    cfg = MoEConfig(dim=8, ffn_dim=16, num_experts=2, top_k=1, capacity_factor=0.01)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 16, cfg.dim))
+    y, _ = moe_forward(params, x, cfg)
+    y = np.asarray(y).reshape(-1, cfg.dim)
+    # at most 2 tokens (1 per expert) produce nonzero output
+    nonzero = np.sum(np.any(np.abs(y) > 0, axis=-1))
+    assert nonzero <= 2, nonzero
+    assert np.all(np.isfinite(y))
+
+
+def _moe_view(devices8, ep=4):
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    tpc.build_moe_mesh(moe_ep_size=ep)
+    return tpc.get_view("moe")
+
+
+def test_moe_ep_matches_serial(devices8):
+    mesh = _moe_view(devices8)
+    params = init_moe_params(jax.random.PRNGKey(0), CFG)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, CFG.dim))
+
+    serial, _ = moe_forward(params, x, CFG)
+
+    specs = moe_param_specs("moe_ep")
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+    )
+    xspec = P(("moe_dp", "moe_ep"))
+    x_sh = jax.device_put(x, NamedSharding(mesh, xspec))
+
+    def fwd(p, xx):
+        y, aux = moe_forward(p, xx, CFG, ep_axis="moe_ep")
+        return y, jax.lax.pmean(aux, ("moe_dp", "moe_ep"))
+
+    out, aux = jax.jit(
+        shard_map(fwd, mesh=mesh, in_specs=(specs, xspec), out_specs=(xspec, P()))
+    )(sharded, x_sh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(serial), rtol=1e-5, atol=1e-5)
+    assert np.isfinite(float(aux))
+
+
+def test_moedp_training_matches_serial(devices8):
+    """EP=4 x MoE-DP=2 train step with expert-grad override must track the
+    single-device trajectory (the reference's MoEDP capability,
+    naive_ddp.py:233-441, tested as in examples/test_ddp.py)."""
+    from torchdistpackage_tpu.parallel.data_parallel import DataParallel
+
+    mesh = _moe_view(devices8)
+    params = init_moe_params(jax.random.PRNGKey(0), CFG)
+    specs = moe_param_specs("moe_ep")
+    opt = optax.sgd(5e-2)
+
+    def loss_fn(p, batch, ep_axis=None):
+        y, _aux = moe_forward(p, batch["x"], CFG, ep_axis=ep_axis)
+        return jnp.mean((y - batch["y"]) ** 2)
+
+    dp = DataParallel(
+        mesh=mesh,
+        axis=("moe_dp", "moe_ep"),
+        grad_reduce_overrides=moe_grad_reduce_overrides(),
+    )
+    sharded = dp.broadcast_params(params, param_specs=specs)
+    state = opt.init(sharded)
+    step = dp.make_train_step(
+        functools.partial(loss_fn, ep_axis="moe_ep"),
+        opt,
+        param_specs=specs,
+        batch_spec={"x": P(("moe_dp", "moe_ep")), "y": P(("moe_dp", "moe_ep"))},
+    )
+
+    sparams, sstate = params, opt.init(params)
+
+    @jax.jit
+    def serial_step(p, s, b):
+        loss, g = jax.value_and_grad(loss_fn)(p, b)
+        u, s = opt.update(g, s, p)
+        return jax.tree.map(jnp.add, p, u), s, loss
+
+    for i in range(3):
+        kx, ky = jax.random.split(jax.random.PRNGKey(10 + i))
+        batch = {
+            "x": jax.random.normal(kx, (8, 8, CFG.dim)),
+            "y": jax.random.normal(ky, (8, 8, CFG.dim)),
+        }
+        sparams, sstate, sloss = serial_step(sparams, sstate, batch)
+        sh_batch = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P(("moe_dp", "moe_ep")))),
+            batch,
+        )
+        sharded, state, dloss = step(sharded, state, sh_batch)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
+
+    for name in ("w1", "b1", "w2", "b2"):
+        np.testing.assert_allclose(
+            np.asarray(sharded["experts"][name]),
+            np.asarray(sparams["experts"][name]),
+            rtol=1e-4,
+            atol=1e-5,
+            err_msg=f"expert param {name} diverged",
+        )
+    np.testing.assert_allclose(
+        np.asarray(sharded["router"]["w"]),
+        np.asarray(sparams["router"]["w"]),
+        rtol=1e-4,
+        atol=1e-5,
+    )

@@ -1,4 +1,11 @@
 from .data_parallel import DataParallel, reduce_gradients
+from .moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_forward,
+    moe_grad_reduce_overrides,
+    moe_param_specs,
+)
 from .zero import ZeroOptimizer, zero_partition_spec
 from .clip import (
     DynamicLossScale,

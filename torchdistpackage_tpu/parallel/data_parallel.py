@@ -93,10 +93,16 @@ def reduce_gradients(
 
     ``grad_reduce_overrides``: ``{name_substring: axes_tuple}`` — grads whose
     '/'-joined key path matches a substring reduce over the given axes instead
-    (empty tuple = no reduction; the grad stays per-shard, which requires the
-    param itself to be sharded/varying over the un-reduced axes).  First match
-    wins.  This subsumes the reference's params-to-ignore and is how MoE-DP
-    composes (expert grads reduce over 'moe_dp' only).
+    (empty tuple = no reduction at all; the grad stays per-shard, the analogue
+    of the reference's params-to-ignore).  First match wins.
+
+    Override + ``'mean'`` semantics: the result is the mean over the *global*
+    batch — the grad is psum-ed over the override axes and normalized by the
+    FULL data-group size.  This matters for MoE-DP (expert grads reduce over
+    'moe_dp' only): the all_to_all transpose has already summed each expert's
+    cotangents across its EP peers, so normalizing by the moe_dp size alone
+    would over-count by the EP size.  The reference papers over this inside
+    DeepSpeed's expert-grad scaling; here it is explicit.
     """
     if reduce_op not in ("mean", "sum"):
         raise ValueError(f"reduce_op must be 'mean' or 'sum', got {reduce_op!r}")
@@ -106,15 +112,28 @@ def reduce_gradients(
 
     def reduce_leaf(path, g):
         name = _key_str(path)
+        matched = False
         axes = default_axes
         for tok, ax in overrides.items():
             if tok in name:
                 axes = tuple(ax)
+                matched = True
                 break
         # only reduce over axes the grad actually varies on (a grad can
         # already be unvarying over an axis, e.g. after implicit psum)
-        axes = tuple(a for a in axes if a in _vma(g))
-        return red(g, axes) if axes else g
+        vaxes = tuple(a for a in axes if a in _vma(g))
+        if not matched:
+            return red(g, vaxes) if vaxes else g
+        if not axes:
+            return g  # explicitly ignored — raw per-shard grad
+        if vaxes:
+            g = jax.lax.psum(g, vaxes)
+        if reduce_op == "mean":
+            denom = 1
+            for a in default_axes:
+                denom *= jax.lax.axis_size(a)
+            g = g / denom
+        return g
 
     return jax.tree_util.tree_map_with_path(reduce_leaf, grads)
 

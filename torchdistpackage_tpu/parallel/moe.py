@@ -1,0 +1,203 @@
+"""Mixture-of-Experts: expert parallelism (EP) + MoE data parallelism.
+
+Analogue of the reference's MoE support — ``tpc.build_moe_groups``
+(process_topo.py:118-143) plus ``MoEDP``/``create_moe_dp_hooks``
+(naive_ddp.py:233-441, moe_dp.md) — but **first-class**: the reference
+delegates the actual expert all-to-all dispatch to DeepSpeed/fastmoe forks
+(explore/moe/ds_fmoe_main.py:19-25); here token dispatch is implemented
+natively as dense dispatch/combine einsums (MXU-friendly, the GShard/Switch
+pattern) with ``lax.all_to_all`` over the ``'moe_ep'`` mesh axis.
+
+Design mirrors the package's TP layers: parameters are global-array pytrees;
+``ep_axis=None`` runs serially on full weights, while inside ``shard_map``
+each device holds ``num_experts / ep`` stacked experts (leading expert dim
+sharded over the EP axis — see :func:`moe_param_specs`) and the forward
+inserts the all-to-alls.  Static shapes are kept through capacity-factor
+padding (SURVEY.md §7 "hard parts"): each expert processes a fixed
+``capacity`` slots per device; overflowing tokens are dropped (contribute
+zero, i.e. pass through the residual), underfull slots are zero-padded.
+
+MoE-DP (replicated-expert data parallelism) composes through
+:class:`~..parallel.data_parallel.DataParallel`'s ``grad_reduce_overrides``:
+expert grads reduce over ``'moe_dp'`` only, everything else over the full
+data group — exactly the reference's hook split (naive_ddp.py:269-441).
+:func:`moe_grad_reduce_overrides` returns the right override dict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..dist.topology import EXPERT_AXIS, MOE_DATA_AXIS
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    dim: int
+    ffn_dim: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    # jitter / z-loss knobs kept minimal; aux load-balance loss is standard
+    aux_loss_weight: float = 1e-2
+    dtype: Any = jnp.float32
+
+
+# ------------------------------------------------------------------ dispatch
+
+
+def _top_k_dispatch(
+    probs: jnp.ndarray, k: int, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Build dense dispatch/combine tensors (GShard-style).
+
+    probs: [T, E] router probabilities.  Returns
+    ``dispatch`` [T, E, C] one-hot (token t occupies slot c of expert e) and
+    ``combine``  [T, E, C] = gate weight on that slot (0 for dropped tokens).
+
+    Priority: all 1st choices are ranked before any 2nd choice (within a
+    choice, token order), matching Switch/GShard so low-index tokens don't
+    starve later experts of their primary assignments.
+    """
+    T, E = probs.shape
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [T, k]
+    # renormalize the kept gates so the combine weights sum to 1 per token
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=probs.dtype)  # [T, k, E]
+    # rank slots choice-major: flatten to [k*T, E] with all 1st choices first
+    flat = onehot.transpose(1, 0, 2).reshape(k * T, E)
+    pos = jnp.cumsum(flat, axis=0) - flat  # position of each slot in its expert
+    pos = pos.reshape(k, T, E).transpose(1, 0, 2)  # [T, k, E]
+    within_cap = (pos < capacity).astype(probs.dtype)
+
+    keep = onehot * within_cap  # [T, k, E]
+    slot = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T, k] slot index
+    slot_oh = jax.nn.one_hot(slot, capacity, dtype=probs.dtype)  # [T, k, C]
+
+    # dispatch[t, e, c] = any kept choice of t mapping to (e, c)
+    dispatch = jnp.einsum("tke,tkc->tec", keep, slot_oh)
+    combine = jnp.einsum("tk,tke,tkc->tec", gate_vals, keep, slot_oh)
+    return dispatch, combine
+
+
+def _load_balance_loss(probs: jnp.ndarray, dispatch: jnp.ndarray) -> jnp.ndarray:
+    """Switch-style aux loss: E * sum_e mean_t(dispatched_e) * mean_t(p_e)."""
+    E = probs.shape[-1]
+    frac_tokens = jnp.mean(jnp.sum(dispatch, axis=-1), axis=0)  # [E]
+    frac_probs = jnp.mean(probs, axis=0)  # [E]
+    return E * jnp.sum(frac_tokens * frac_probs)
+
+
+# ------------------------------------------------------------------- experts
+
+
+def _expert_ffn(p: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
+    """Per-expert MLP on stacked experts.  x: [E, G, D] -> [E, G, D]."""
+    h = jax.nn.gelu(jnp.einsum("egd,edf->egf", x, p["w1"]) + p["b1"][:, None, :])
+    return jnp.einsum("egf,efd->egd", h, p["w2"]) + p["b2"][:, None, :]
+
+
+def moe_forward(
+    params: Dict[str, PyTree],
+    x: jnp.ndarray,
+    cfg: MoEConfig,
+    ep_axis: Optional[str] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """MoE FFN layer.  x: [B, S, D] (the device-local tokens under EP).
+
+    Returns ``(y, aux_loss)``; add ``cfg.aux_loss_weight * aux_loss`` to the
+    training loss.  With ``ep_axis`` set (inside shard_map) the stacked expert
+    params hold only the local shard of experts and tokens are exchanged with
+    two ``all_to_all`` collectives over the EP axis; dropped tokens contribute
+    zero so callers should use the output additively (residual).
+    """
+    B, S, D = x.shape
+    T = B * S
+    E = cfg.num_experts
+    tokens = x.reshape(T, D)
+
+    probs = jax.nn.softmax(
+        (tokens @ params["router"]["w"]).astype(jnp.float32), axis=-1
+    )  # [T, E] in fp32 for routing stability
+    capacity = max(1, int(math.ceil(T * cfg.top_k * cfg.capacity_factor / E)))
+    dispatch, combine = _top_k_dispatch(probs, cfg.top_k, capacity)
+    aux = _load_balance_loss(probs, dispatch)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)  # [E, C, D]
+
+    if ep_axis is None:
+        expert_out = _expert_ffn(params["experts"], expert_in)  # [E, C, D]
+    else:
+        ep = jax.lax.axis_size(ep_axis)
+        if E % ep != 0:
+            raise ValueError(f"num_experts {E} not divisible by EP size {ep}")
+        e_loc = E // ep
+        # [E, C, D] -> [ep, e_loc, C, D]; exchange: dim0 becomes source device
+        send = expert_in.reshape(ep, e_loc, capacity, D)
+        recv = jax.lax.all_to_all(send, ep_axis, split_axis=0, concat_axis=0)
+        # my local experts now see ep*C slots (C from every EP peer)
+        grouped = recv.transpose(1, 0, 2, 3).reshape(e_loc, ep * capacity, D)
+        out = _expert_ffn(params["experts"], grouped)
+        back = out.reshape(e_loc, ep, capacity, D).transpose(1, 0, 2, 3)
+        expert_out = jax.lax.all_to_all(
+            back, ep_axis, split_axis=0, concat_axis=0
+        ).reshape(E, capacity, D)
+
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y.reshape(B, S, D), aux.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------- init
+
+
+def init_moe_params(key, cfg: MoEConfig) -> Dict[str, PyTree]:
+    kr, k1, k2 = jax.random.split(key, 3)
+    D, F, E = cfg.dim, cfg.ffn_dim, cfg.num_experts
+    dt = cfg.dtype
+    return {
+        "router": {"w": (jax.random.normal(kr, (D, E)) / math.sqrt(D)).astype(dt)},
+        "experts": {
+            "w1": (jax.random.normal(k1, (E, D, F)) / math.sqrt(D)).astype(dt),
+            "b1": jnp.zeros((E, F), dt),
+            "w2": (jax.random.normal(k2, (E, F, D)) / math.sqrt(F)).astype(dt),
+            "b2": jnp.zeros((E, D), dt),
+        },
+    }
+
+
+def moe_param_specs(ep_axis: str = EXPERT_AXIS) -> Dict[str, PyTree]:
+    """Router replicated; stacked expert arrays sharded on the expert dim over
+    the EP axis.  Sharding *is* the expert placement — no manual scatter."""
+    return {
+        "router": {"w": P()},
+        "experts": {
+            "w1": P(ep_axis, None, None),
+            "b1": P(ep_axis, None),
+            "w2": P(ep_axis, None, None),
+            "b2": P(ep_axis, None),
+        },
+    }
+
+
+def moe_grad_reduce_overrides(
+    moe_dp_axis: str = MOE_DATA_AXIS,
+) -> Dict[str, Tuple[str, ...]]:
+    """Override dict for :class:`DataParallel`: expert grads reduce over the
+    ``moe_dp`` axis only (replicated-expert DP, naive_ddp.py:269-441); the EP
+    dimension must NOT be reduced — each EP shard owns different experts.
+    Router and all dense params use the DataParallel default (full data group).
+    """
+    return {"experts": (moe_dp_axis,)}
