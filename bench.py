@@ -29,7 +29,7 @@ def main() -> None:
     if on_accel:
         cfg = GPTConfig(
             vocab_size=32768, dim=768, nheads=12, nlayers=12, max_seq=2048,
-            ffn_mult=4, dtype=jnp.bfloat16,
+            ffn_mult=4, dtype=jnp.bfloat16, attn_impl="flash",
         )
         batch_size, steps, warmup = 8, 20, 3
     else:
@@ -71,14 +71,19 @@ def main() -> None:
     }
     batch = jax.device_put(batch, batch_sharded)
 
+    # NB: sync via host transfer (float(loss)), NOT block_until_ready — over
+    # the axon TPU tunnel block_until_ready can return before execution
+    # completes, which makes timings fictitious.  The steps form a data
+    # dependency chain (params feed the next step), so fetching the final
+    # loss bounds the whole run.
     for _ in range(warmup):
         params, state, loss = step(params, state, batch)
-    jax.block_until_ready(loss)
+    float(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         params, state, loss = step(params, state, batch)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
 
     tokens_per_sec_chip = global_batch * cfg.max_seq * steps / dt / n_chips

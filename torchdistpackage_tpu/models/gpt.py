@@ -54,6 +54,7 @@ class GPTConfig:
     ffn_mult: int = 4
     causal: bool = True
     dtype: Any = jnp.float32
+    attn_impl: str = "naive"  # 'naive' | 'flash' (Pallas kernel)
 
     @property
     def block(self) -> TransformerConfig:
@@ -64,6 +65,7 @@ class GPTConfig:
             ffn_mult=self.ffn_mult,
             causal=self.causal,
             dtype=self.dtype,
+            attn_impl=self.attn_impl,
         )
 
     def num_params(self) -> int:

@@ -1,0 +1,2 @@
+from .flash_attention import flash_attention, mha_reference
+from .ring_attention import ring_attention, ulysses_attention

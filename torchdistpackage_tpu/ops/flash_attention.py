@@ -1,0 +1,305 @@
+"""Flash attention as a Pallas TPU kernel (fwd + custom-VJP bwd).
+
+The reference only *derives* this math in a single-device numpy study
+(explore/flash-attn/tile_attn.py:100-212 — tiled online-softmax fwd+bwd); it
+ships no kernel.  Here it is a first-class TPU kernel: blockwise online
+softmax with f32 accumulators in VMEM, MXU matmuls via ``jnp.dot`` with
+``preferred_element_type``, causal upper-block skipping (the loop over KV
+blocks stops at the diagonal), and a standard flash backward (recompute
+probabilities from the saved logsumexp; dq kernel loops over KV blocks, dkv
+kernel loops over Q blocks).
+
+On CPU (tests / CI sim) the kernels run in Pallas interpreter mode
+automatically, so the same code path is exercised everywhere.
+
+Current scope: K/V for one (batch, head) stays VMEM-resident per program
+(O(S) VMEM, fine to S ~ 16k at D=64 bf16; long-context runs shard S over the
+ring first — ops/ring_attention.py — so per-shard S stays moderate).  A
+blocked-KV 3D-grid revision lifts this ceiling for single-chip long S.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # finite "minus infinity": avoids (-inf) - (-inf) NaNs
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def mha_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Plain softmax(QK^T)V golden — [B, H, S, D] layout."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        Sq, Sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Sq, Sk), dtype=bool), k=Sk - Sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ------------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_k, seq_k):
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    qi = pl.program_id(1)
+
+    q = q_ref[0]  # [Bq, D] storage dtype — MXU takes bf16 in, f32 out
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kv = seq_k // block_k
+    if causal:
+        # process KV blocks up to and including the diagonal block
+        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, num_kv)
+    else:
+        hi = num_kv
+
+    def body(j, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vblk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.dot(
+            p.astype(vblk.dtype), vblk, preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m, l, acc))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)  # [Bq, 1]
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    grid = (BH, Sq // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k, seq_k=Sk
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ------------------------------------------------------------------ backward
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, causal, block_k, seq_k
+):
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    qi = pl.program_id(1)
+
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0]  # [Bq, 1]
+    delta = delta_ref[0]
+    dq = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kv = seq_k // block_k
+    if causal:
+        hi = jnp.minimum(jax.lax.div((qi + 1) * block_q + block_k - 1, block_k), num_kv)
+    else:
+        hi = num_kv
+
+    def body(j, dq):
+        kblk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vblk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [Bq, Bk]
+        dp = jnp.dot(do, vblk.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(kblk.dtype)
+        return dq + jnp.dot(ds, kblk, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, hi, body, dq)
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, sm_scale, causal, block_q, seq_q,
+):
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2]
+    ki = pl.program_id(1)
+
+    k = k_ref[0]
+    v = v_ref[0]
+    dk = jnp.zeros((block_k, d), jnp.float32)
+    dv = jnp.zeros((block_k, d), jnp.float32)
+
+    num_q = seq_q // block_q
+    # causal: only q blocks at or after this kv block contribute
+    lo = jax.lax.div(ki * block_k, block_q) if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]  # [Bq, 1]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale  # [Bq, Bk]
+        if causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv = dv + jnp.dot(p.T.astype(do.dtype), do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(lo, num_q, body, (dk, dv))
+    dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, res, dout):
+    q, k, v, o, lse = res
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    delta = jnp.sum(dout.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # [BH, Sq, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k, seq_k=Sk
+        ),
+        grid=(BH, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, dout, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, seq_q=Sq
+        ),
+        grid=(BH, Sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Sq, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Sq, 1), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, dout, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------ public op
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, res, dout):
+    return _bwd(sm_scale, causal, block_q, block_k, res, dout)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+) -> jnp.ndarray:
+    """Blockwise (flash) attention.  [B, H, S, D] layout, differentiable.
+
+    Block sizes are clamped to the sequence lengths; S must be divisible by
+    the (clamped) block sizes — pad upstream for ragged lengths.
+    """
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    if Sq % block_q or Sk % block_k:
+        raise ValueError(f"seq lengths ({Sq}, {Sk}) not divisible by blocks ({block_q}, {block_k})")
+    qf = q.reshape(B * H, Sq, D)
+    kf = k.reshape(B * H, Sk, D)
+    vf = v.reshape(B * H, Sk, D)
+    o = _flash(qf, kf, vf, float(sm_scale), bool(causal), int(block_q), int(block_k))
+    return o.reshape(B, H, Sq, D)

@@ -1,0 +1,149 @@
+"""Context parallelism for long sequences: ring attention + Ulysses.
+
+The reference has NO context parallelism (SURVEY.md §5 "Long-context": its
+only long-sequence mechanism is Megatron SP, and its only seed is the
+single-device tiled-softmax study explore/flash-attn/tile_attn.py:100-212).
+This module is the capability *extension* SURVEY.md §7 step 8 calls for,
+built the TPU way:
+
+- :func:`ring_attention` — sequence sharded over a ``'context'`` mesh axis;
+  each device keeps its Q shard resident and the KV shards rotate around the
+  ICI ring via ``lax.ppermute`` (one hop per step), combined with the
+  blockwise online-softmax update.  Activation memory per device is
+  O(S/cp) and each step's ppermute overlaps with the attention compute of
+  the block in hand (XLA async collectives).  Differentiable: AD transposes
+  ppermute to the reverse rotation automatically.
+- :func:`ulysses_attention` — the all-to-all alternative: scatter heads /
+  gather sequence over the axis, run full (flash) attention on H/cp local
+  heads, scatter back.  Two all_to_alls instead of cp-1 ppermute hops;
+  better when H >= cp and S very long.
+
+Both are for use inside ``shard_map`` with the sequence dim of q/k/v sharded
+over ``axis``; both run serially when ``axis`` is None (golden path).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import NEG_INF, mha_reference
+
+
+def _block_update(q, k, v, m, l, acc, qpos, kpos, causal, sm_scale):
+    """One online-softmax accumulation step against a KV block.
+
+    q: [B,H,Sq,D]; k,v: [B,H,Sk,D]; m,l: [B,H,Sq,1]; acc: [B,H,Sq,D];
+    qpos: [Sq], kpos: [Sk] global token positions for causal masking."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        mask = kpos[None, :] <= qpos[:, None]  # [Sq, Sk]
+        s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * corr + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+    )
+    return m_new, l, acc
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis: Optional[str] = None,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Ring attention over the ``axis`` mesh ring.  [B, H, S_local, D] layout
+    with the global sequence sharded contiguously over the axis (shard i owns
+    positions [i*S_local, (i+1)*S_local))."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if axis is None:
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    B, H, S, D = q.shape
+    qpos = idx * S + jnp.arange(S)
+
+    # accumulators are per-shard values: mark them varying over the ring axis
+    # so the scan carry type is stable
+    from ..parallel.data_parallel import _mark_varying
+
+    m0 = _mark_varying(jnp.full((B, H, S, 1), NEG_INF, jnp.float32), (axis,))
+    l0 = _mark_varying(jnp.zeros((B, H, S, 1), jnp.float32), (axis,))
+    acc0 = _mark_varying(jnp.zeros((B, H, S, D), jnp.float32), (axis,))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        m, l, acc, kc, vc = carry
+        src = (idx - t) % n  # original owner of the KV block in hand
+        kpos = src * S + jnp.arange(S)
+
+        def update(opers):
+            m, l, acc = opers
+            return _block_update(q, kc, vc, m, l, acc, qpos, kpos, causal, sm_scale)
+
+        if causal:
+            # KV shards entirely in the future are fully masked — skip their
+            # FLOPs (~half the steps across the ring); cond keeps the scan
+            # body uniform so the ppermute below still overlaps compute
+            m, l, acc = jax.lax.cond(src <= idx, update, lambda o: o, (m, l, acc))
+        else:
+            m, l, acc = update((m, l, acc))
+        # rotate KV to the next ring neighbor (skippable on the last step,
+        # but a uniform scan body lets XLA overlap the hop with compute)
+        kc = jax.lax.ppermute(kc, axis, perm)
+        vc = jax.lax.ppermute(vc, axis, perm)
+        return (m, l, acc, kc, vc), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(n))
+    return (acc / l).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis: Optional[str] = None,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    use_flash: bool = False,
+) -> jnp.ndarray:
+    """Ulysses (DeepSpeed-style) sequence parallelism: all_to_all scatters
+    heads and gathers sequence, attention runs on full sequences with H/cp
+    local heads, then the inverse all_to_all restores [B, H, S_local, D]."""
+    if axis is None:
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    n = jax.lax.axis_size(axis)
+    B, H, S, D = q.shape
+    if H % n != 0:
+        raise ValueError(f"heads {H} not divisible by context-parallel size {n}")
+
+    def scatter_heads(x):
+        # [B, H, S_loc, D] -> [B, n, H/n, S_loc, D] -> a2a (recv dim = source
+        # rank, inserted *before* seq so the global order is preserved)
+        x = x.reshape(B, n, H // n, S, D)
+        x = jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2)
+        return x.reshape(B, H // n, n * S, D)
+
+    def gather_heads(x):
+        x = x.reshape(B, H // n, n, S, D)
+        x = jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1)
+        return x.reshape(B, H, S, D)
+
+    qf, kf, vf = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    if use_flash:
+        from .flash_attention import flash_attention
+
+        out = flash_attention(qf, kf, vf, causal=causal, sm_scale=sm_scale)
+    else:
+        out = mha_reference(qf, kf, vf, causal=causal, sm_scale=sm_scale)
+    return gather_heads(out)

@@ -45,6 +45,9 @@ class TransformerConfig:
     ffn_mult: int = 4
     causal: bool = True
     dtype: Any = jnp.float32
+    # 'naive' materializes the [S, S] score matrix; 'flash' uses the Pallas
+    # blockwise kernel (ops/flash_attention.py) — preferred on TPU for long S
+    attn_impl: str = "naive"
 
     @property
     def head_dim(self) -> int:
@@ -82,12 +85,14 @@ def attention_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: Transforme
     k = k.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
 
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
-    if cfg.causal:
-        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
-        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    if cfg.attn_impl == "flash":
+        from ...ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, causal=cfg.causal)
+    else:
+        from ...ops.flash_attention import mha_reference
+
+        out = mha_reference(q, k, v, causal=cfg.causal)
     out = out.transpose(0, 2, 1, 3).reshape(B, S, h_loc * hd)
     return out @ p["wo"]  # [B,S,D] — partial sum across TP shards
 
