@@ -7,6 +7,7 @@ from .moe import (
     moe_param_specs,
 )
 from .zero import ZeroOptimizer, zero_partition_spec
+from .ema import ShardedEMA
 from .clip import (
     DynamicLossScale,
     clip_by_global_norm_parallel,

@@ -1,0 +1,48 @@
+"""Utility layer — determinism, partitioning, logging and profiling ranges.
+
+Analogue of the reference's ``utils.py`` (fix_rand + partition_params) and
+``torchdistpackage/dist/utils.py`` (NVTX ranges, nsys capture gating,
+inf/nan probe, master-only print).
+"""
+
+from .random import fix_rand, axis_unique_key, per_axis_keys
+from .partition import partition_params
+from .logging import (
+    disable_non_master_print,
+    enable_all_print,
+    is_master,
+    master_only,
+    master_print,
+)
+from .profiling import (
+    TimedScope,
+    prof_start,
+    prof_stop,
+    scope_decorator,
+)
+from .checkpoint import (
+    CheckpointManager,
+    get_mp_ckpt_suffix,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+__all__ = [
+    "fix_rand",
+    "axis_unique_key",
+    "per_axis_keys",
+    "partition_params",
+    "disable_non_master_print",
+    "enable_all_print",
+    "is_master",
+    "master_only",
+    "master_print",
+    "TimedScope",
+    "prof_start",
+    "prof_stop",
+    "scope_decorator",
+    "CheckpointManager",
+    "get_mp_ckpt_suffix",
+    "load_checkpoint",
+    "save_checkpoint",
+]

@@ -1,0 +1,180 @@
+"""Sharded checkpoint save/resume + model-parallel ckpt naming.
+
+The reference ships only a per-partition filename helper
+(``dist/model_parallel_ckpt.py:4-21`` — suffix ``_tp_{r}_pp_{r}.pth``; note
+its bare ``is_mode_inited`` NameError, SURVEY §2#15) and rank-0 state
+reconstruction inside ShardedEMA; there is **no** unified save/load or resume
+(SURVEY §5).  Here checkpointing is first-class and TPU-native: Orbax writes
+each array *shard-parallel* from every host (no rank-0 gather, no per-rank
+files to stitch), records the mesh/PartitionSpec layout, and restores
+directly into any sharding you ask for — so a checkpoint written on one mesh
+can resume on another (e.g. TP=4 -> TP=2) by just passing the new specs.
+
+- :func:`get_mp_ckpt_suffix` — behavioral parity with the reference helper
+  (with the NameError fixed), for users who want legacy-style names.
+- :func:`save_checkpoint` / :func:`load_checkpoint` — one-shot pytree
+  save/restore (params, opt state, EMA, step counters, ...).
+- :class:`CheckpointManager` — step-numbered checkpoints, retention policy,
+  and ``latest_step`` resume — the missing "resume logic".
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PyTree = Any
+
+
+def get_mp_ckpt_suffix() -> str:
+    """Per-partition filename suffix, e.g. ``_tp_0_pp_1`` — parity with
+    ``get_mp_ckpt_suffix`` (model_parallel_ckpt.py:4-21), minus its
+    ``is_mode_inited`` NameError.  Empty string when no model parallelism."""
+    from ..dist.topology import PIPE_AXIS, TENSOR_AXIS, tpc
+
+    suffix = ""
+    if tpc.is_mode_inited(TENSOR_AXIS):
+        suffix += f"_tp_{tpc.process_axis_index(TENSOR_AXIS)}"
+    if tpc.is_mode_inited(PIPE_AXIS):
+        suffix += f"_pp_{tpc.process_axis_index(PIPE_AXIS)}"
+    return suffix
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def save_checkpoint(path: str, state: PyTree, force: bool = True) -> None:
+    """Write ``state`` (any pytree of arrays/scalars) to ``path``.
+
+    Every host writes its own shards in parallel; jax.Arrays keep their
+    sharding metadata.  Replaces the reference's nonexistent save path and
+    ShardedEMA's rank-0 send/recv reconstruction (sharded_ema.py:36-61).
+    """
+    ocp = _ocp()
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=force)
+
+
+def load_checkpoint(
+    path: str,
+    template: Optional[PyTree] = None,
+    mesh: Optional[Mesh] = None,
+    specs: Optional[PyTree] = None,
+) -> PyTree:
+    """Restore a pytree from ``path``.
+
+    - ``template=None``: restore as numpy arrays (host-side inspection).
+    - ``template`` given (arrays or ShapeDtypeStructs): restore into that
+      structure's shapes/dtypes/shardings.
+    - ``mesh`` + ``specs`` given: override shardings — this is the
+      resharding-resume path (checkpoint from one mesh, resume on another).
+    """
+    ocp = _ocp()
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if template is None:
+            return ckptr.restore(path)
+
+        if mesh is not None and specs is not None:
+            def abstract(x, s):
+                shape = np.shape(x)
+                dtype = getattr(x, "dtype", np.asarray(x).dtype)
+                return jax.ShapeDtypeStruct(
+                    shape, dtype, sharding=NamedSharding(mesh, s or PartitionSpec())
+                )
+
+            template = jax.tree.map(abstract, template, specs)
+        else:
+            def abstract(x):
+                if isinstance(x, jax.ShapeDtypeStruct):
+                    return x
+                shape = np.shape(x)
+                dtype = getattr(x, "dtype", np.asarray(x).dtype)
+                sharding = getattr(x, "sharding", None)
+                return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+            template = jax.tree.map(abstract, template)
+        return ckptr.restore(path, template)
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention + latest-step resume.
+
+    The subsystem the reference lacks entirely (SURVEY §5 "no unified
+    save/load, no resume logic").  Usage::
+
+        mgr = CheckpointManager(dir, max_to_keep=3)
+        mgr.save(step, {'params': params, 'opt': opt_state})
+        ...
+        step = mgr.latest_step()          # None if fresh run
+        state = mgr.restore(step, template={'params': params, 'opt': opt_state})
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3, save_interval_steps: int = 1):
+        ocp = _ocp()
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+            ),
+        )
+
+    def save(self, step: int, state: PyTree, wait: bool = False) -> bool:
+        ocp = _ocp()
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+        return saved
+
+    def restore(
+        self,
+        step: Optional[int] = None,
+        template: Optional[PyTree] = None,
+        mesh: Optional[Mesh] = None,
+        specs: Optional[PyTree] = None,
+    ) -> PyTree:
+        ocp = _ocp()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if template is None:
+            return self._mgr.restore(step)
+        if mesh is not None and specs is not None:
+            def abstract(x, s):
+                return jax.ShapeDtypeStruct(
+                    np.shape(x),
+                    getattr(x, "dtype", np.asarray(x).dtype),
+                    sharding=NamedSharding(mesh, s or PartitionSpec()),
+                )
+
+            template = jax.tree.map(abstract, template, specs)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return self._mgr.all_steps()
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

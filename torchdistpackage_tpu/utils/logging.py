@@ -1,0 +1,65 @@
+"""Rank-gated printing — analogue of ``disable_non_master_print``
+(reference ``dist/utils.py:91-103``) and the rank-gated prints sprinkled
+through the reference (process_topo.py:67-68).
+
+"Master" on TPU means ``jax.process_index() == 0`` — under SPMD there is one
+Python process per host, not per device, so this is the multi-host analogue
+of the reference's rank-0 gating.
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+from typing import Callable
+
+import jax
+
+_builtin_print = builtins.print
+_patched = False
+
+
+def is_master() -> bool:
+    return jax.process_index() == 0
+
+
+def master_print(*args, **kwargs) -> None:
+    """Print only on process 0 (always uses the un-patched builtin)."""
+    if is_master():
+        _builtin_print(*args, **kwargs)
+
+
+def master_only(fn: Callable) -> Callable:
+    """Decorator: run ``fn`` only on process 0, return None elsewhere."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if is_master():
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapper
+
+
+def disable_non_master_print(force: bool = False) -> None:
+    """Patch ``builtins.print`` to no-op on non-master processes.
+
+    Callers can escape the gate per-call with ``print(..., force=True)`` —
+    same escape hatch as the reference (dist/utils.py:96-101).  Repeated
+    calls re-install the gate with the new ``force`` default.
+    """
+    global _patched
+
+    def gated_print(*args, force: bool = force, force_print: bool = False, **kwargs):
+        if is_master() or force or force_print:
+            _builtin_print(*args, **kwargs)
+
+    builtins.print = gated_print
+    _patched = True
+
+
+def enable_all_print() -> None:
+    """Undo :func:`disable_non_master_print`."""
+    global _patched
+    builtins.print = _builtin_print
+    _patched = False

@@ -1,0 +1,92 @@
+"""Profiling ranges + trace capture gating.
+
+Analogue of the reference's NVTX toolkit (``dist/utils.py:11-69``):
+
+- ``cu_prof_start/stop`` (nsys capture window)  -> :func:`prof_start` /
+  :func:`prof_stop` around ``jax.profiler`` trace collection (view in
+  TensorBoard / Perfetto instead of nsys).
+- ``nvtx_decorator``                            -> :func:`scope_decorator`
+  using ``jax.named_scope`` (names flow into XLA HLO metadata and show up in
+  the TPU trace viewer — the XLA-native equivalent of an NVTX range) plus a
+  host-side ``TraceAnnotation`` for the host timeline.
+- ``NVTXContext`` (timing context)              -> :class:`TimedScope`,
+  which additionally blocks on device completion so wall times are real
+  (XLA is async; naive host timing measures dispatch, not execution).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Callable, Optional
+
+import jax
+
+
+def prof_start(logdir: str = "/tmp/jax-trace") -> None:
+    """Begin a profiler capture window (TensorBoard/Perfetto trace).
+
+    Like ``cu_prof_start`` (dist/utils.py:11-21) this is meant to bracket a
+    few steady-state steps, not a whole run.
+    """
+    jax.profiler.start_trace(logdir)
+
+
+def prof_stop() -> None:
+    jax.profiler.stop_trace()
+
+
+def scope_decorator(fn: Callable = None, *, name: Optional[str] = None) -> Callable:
+    """Wrap ``fn`` in a named scope visible in both device (HLO metadata) and
+    host (TraceAnnotation) timelines — analogue of ``nvtx_decorator``
+    (dist/utils.py:35-44)."""
+
+    def deco(f: Callable) -> Callable:
+        scope = name or f.__qualname__
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            with jax.named_scope(scope), jax.profiler.TraceAnnotation(scope):
+                return f(*args, **kwargs)
+
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+class TimedScope:
+    """``with TimedScope('fwd') as t: ...`` — named range + real wall time.
+
+    Analogue of ``NVTXContext`` (dist/utils.py:46-69).  On exit it
+    ``block_until_ready``-s ``sync_on`` (or nothing, measuring host time only)
+    so ``t.elapsed`` reflects device completion, then optionally prints.
+    """
+
+    def __init__(self, name: str, verbose: bool = False):
+        self.name = name
+        self.verbose = verbose
+        self.elapsed: Optional[float] = None
+        self._sync_target = None
+
+    def sync_on(self, *arrays) -> None:
+        """Register outputs to block on before stopping the clock."""
+        self._sync_target = arrays
+
+    def __enter__(self) -> "TimedScope":
+        self._scope = jax.named_scope(self.name)
+        self._annot = jax.profiler.TraceAnnotation(self.name)
+        self._scope.__enter__()
+        self._annot.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._sync_target is not None:
+            jax.block_until_ready(self._sync_target)
+        self.elapsed = time.perf_counter() - self._t0
+        self._annot.__exit__(*exc)
+        self._scope.__exit__(*exc)
+        if self.verbose:
+            print(f"[{self.name}] {self.elapsed * 1e3:.3f} ms")

@@ -1,0 +1,70 @@
+"""Determinism + sharded RNG helpers.
+
+Analogue of ``fix_rand`` (reference ``utils.py:4-33``), which seeds
+torch/cuda/numpy/python and flips cuDNN into deterministic mode.  On TPU the
+compute path is deterministic by construction (XLA, no atomics in the hot
+ops), so "fixing randomness" reduces to (a) seeding every host-side RNG that
+data pipelines might touch and (b) threading an explicit ``jax.random`` key —
+which we return, because idiomatic JAX keeps randomness functional instead of
+global.
+
+The per-axis helpers solve the problem the reference never had to: under SPMD
+every device runs the same program, so "different dropout per data shard, same
+init per tensor shard" must be expressed by folding mesh coordinates into the
+key (SURVEY §7 "per-axis sharded RNG").
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+AxisName = Union[str, Tuple[str, ...]]
+
+
+def fix_rand(seed: int = 1024) -> jax.Array:
+    """Seed python/numpy (+torch if importable) and return a jax PRNG key.
+
+    Mirrors the reference's ``fix_rand`` (utils.py:4-33) including its default
+    seed.  The torch branch is soft — torch is only a host-side data-pipeline
+    concern here, never the compute path.
+    """
+    random.seed(seed)
+    np.random.seed(seed)
+    os.environ.setdefault("PYTHONHASHSEED", str(seed))
+    try:  # pragma: no cover - torch optional
+        import torch
+
+        torch.manual_seed(seed)
+        if torch.cuda.is_available():
+            torch.cuda.manual_seed_all(seed)
+    except ImportError:
+        pass
+    return jax.random.PRNGKey(seed)
+
+
+def axis_unique_key(key: jax.Array, *axes: AxisName) -> jax.Array:
+    """Fold the mesh coordinates along ``axes`` into ``key`` — traced; call
+    inside ``shard_map``.
+
+    Devices that differ in any listed axis get distinct keys; devices that
+    agree on all of them share one.  E.g. dropout that differs per data shard
+    but is identical across tensor shards: ``axis_unique_key(key, 'data')``.
+    """
+    for ax in axes:
+        names = ax if isinstance(ax, tuple) else (ax,)
+        for name in names:
+            key = jax.random.fold_in(key, jax.lax.axis_index(name))
+    return key
+
+
+def per_axis_keys(key: jax.Array, sizes: Sequence[int]) -> np.ndarray:
+    """Host-side: a grid of keys of shape ``sizes`` (for placing pre-split
+    randomness, e.g. per-stage init in a pipeline loop)."""
+    n = int(np.prod(sizes))
+    keys = jax.random.split(key, n)
+    return np.asarray(keys).reshape(tuple(sizes) + (2,))
