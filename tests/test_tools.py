@@ -1,0 +1,187 @@
+"""Tests for the tools layer: profiler, NaN hunting, surgery/int8, SLURM
+monitor (subprocess-mocked)."""
+
+import subprocess
+from unittest import mock
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchdistpackage_tpu.tools import (
+    QuantizedLinear,
+    check_model_params,
+    check_tensors,
+    dequantize_int8,
+    determine_job_is_alive,
+    find_nan_block,
+    get_model_profile,
+    int8_matmul,
+    launch_job,
+    nan_guard,
+    profile_blocks,
+    quantize_int8,
+    quantize_params_int8,
+    replace_params,
+    report_prof,
+)
+from torchdistpackage_tpu.tools import slurm_job_monitor as sjm
+
+
+# ---------------------------------------------------------------- profiler
+
+
+def test_profile_blocks_and_report():
+    w1 = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
+    w2 = jax.random.normal(jax.random.PRNGKey(1), (128, 64))
+    blocks = [
+        ("expand", lambda x: jnp.tanh(x @ w1)),
+        ("contract", lambda x: x @ w2),
+    ]
+    x = jnp.ones((8, 64))
+    profiles, out = profile_blocks(blocks, x, warmup=1, iters=2)
+    assert out.shape == (8, 64)
+    assert [p.name for p in profiles] == ["expand", "contract"]
+    # activation bytes are exact: (8,128) f32 and (8,64) f32
+    assert profiles[0].act_bytes == 8 * 128 * 4
+    assert profiles[1].act_bytes == 8 * 64 * 4
+    assert all(p.time_ms > 0 for p in profiles)
+    rep = report_prof(profiles)
+    assert "expand" in rep and "MB/ms" in rep and "TOTAL" in rep
+    # one-call variant prints
+    ps = get_model_profile(blocks, x, print_report=False)
+    assert len(ps) == 2
+
+
+# ---------------------------------------------------------------- nan tools
+
+
+def test_check_tensors_paths():
+    tree = {"a": jnp.ones((3,)), "b": {"c": jnp.array([1.0, jnp.nan])}}
+    bad = check_tensors(tree, name="t")
+    assert bad == ["t/b/c (nan=1, inf=0)"]
+    with pytest.raises(FloatingPointError):
+        check_tensors(tree, raise_on_bad=True)
+    assert check_model_params({"w": jnp.zeros((2,))}) == []
+
+
+def test_nan_guard_raises_inside_jit():
+    @nan_guard(name="div")
+    def f(x):
+        return x / x  # nan at 0
+
+    ok = jax.jit(f)(jnp.ones((4,)))
+    np.testing.assert_allclose(np.asarray(ok), 1.0)
+    with pytest.raises(Exception):  # XLA wraps the callback error
+        jax.block_until_ready(jax.jit(f)(jnp.zeros((4,))))
+
+
+def test_find_nan_block():
+    blocks = [
+        ("ok", lambda x: x + 1),
+        ("bad", lambda x: jnp.log(x - 10.0)),  # negative -> nan
+        ("after", lambda x: x * 2),
+    ]
+    name, _ = find_nan_block(blocks, jnp.ones((4,)))
+    assert name == "bad"
+    name, out = find_nan_block(blocks[:1], jnp.ones((4,)))
+    assert name is None and float(out[0]) == 2.0
+
+
+# ------------------------------------------------------------- surgery/int8
+
+
+def test_quantize_int8_accuracy():
+    w = jax.random.normal(jax.random.PRNGKey(0), (256, 128)) * 0.02
+    ql = quantize_int8(w)
+    assert ql.q.dtype == jnp.int8 and ql.scale.shape == (128,)
+    deq = dequantize_int8(ql)
+    err = float(jnp.max(jnp.abs(deq - w)))
+    assert err <= float(jnp.max(ql.scale)) * 0.51  # within half a quant step
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 256))
+    y_ref = x @ w
+    y_q = int8_matmul(x, ql)
+    rel = float(jnp.linalg.norm(y_q - y_ref) / jnp.linalg.norm(y_ref))
+    assert rel < 0.02
+    # jit-compatible (QuantizedLinear is a pytree)
+    y_jit = jax.jit(int8_matmul)(x, ql)
+    np.testing.assert_allclose(np.asarray(y_jit), np.asarray(y_q), rtol=1e-5)
+
+
+def test_quantize_params_sweep_and_replace():
+    params = {
+        "blk": {"w": jnp.ones((128, 64)), "ln": jnp.ones((64,)), "b": jnp.zeros((64,))},
+        "emb": jnp.ones((8, 4)),  # too small -> untouched
+    }
+    qp = quantize_params_int8(params)
+    assert isinstance(qp["blk"]["w"], QuantizedLinear)
+    assert isinstance(qp["blk"]["ln"], jax.Array)  # 1-D untouched
+    assert isinstance(qp["emb"], jax.Array)  # below min_size untouched
+
+    # generic surgery: zero out biases by predicate
+    zp = replace_params(
+        params,
+        lambda key, leaf: key.endswith("/b"),
+        lambda key, leaf: jnp.full_like(leaf, 7.0),
+    )
+    assert float(zp["blk"]["b"][0]) == 7.0
+    assert float(zp["blk"]["ln"][0]) == 1.0
+
+
+# ------------------------------------------------------------ slurm monitor
+
+
+def _fake_run(stdout_map):
+    def run(cmd, **kw):
+        key = cmd[0]
+        out = stdout_map.get(key, "")
+        return subprocess.CompletedProcess(cmd, 0, stdout=out, stderr="")
+
+    return run
+
+
+def test_launch_and_state_parsing():
+    with mock.patch.object(
+        sjm.subprocess, "run",
+        side_effect=_fake_run({"sbatch": "Submitted batch job 4242\n"}),
+    ):
+        assert launch_job("train.sbatch") == "4242"
+    with mock.patch.object(
+        sjm.subprocess, "run",
+        side_effect=_fake_run({"sacct": "4242  RUNNING\n4242.batch  RUNNING\n"}),
+    ):
+        assert sjm.get_job_state("4242") == "RUNNING"
+        assert determine_job_is_alive("4242")
+    with mock.patch.object(
+        sjm.subprocess, "run",
+        side_effect=_fake_run({"sacct": "4242  FAILED\n"}),
+    ):
+        assert not determine_job_is_alive("4242")
+    # CANCELLED+ suffix normalization
+    with mock.patch.object(
+        sjm.subprocess, "run",
+        side_effect=_fake_run({"sacct": "4242  CANCELLED+\n"}),
+    ):
+        assert sjm.get_job_state("4242") == "CANCELLED"
+
+
+def test_monitor_relaunches_until_completed():
+    states = iter(["FAILED", "RUNNING", "COMPLETED"])
+    submitted = []
+
+    def run(cmd, **kw):
+        if cmd[0] == "sbatch":
+            submitted.append(cmd)
+            return subprocess.CompletedProcess(cmd, 0, stdout=f"Submitted batch job {100 + len(submitted)}\n", stderr="")
+        if cmd[0] == "sacct":
+            jid = cmd[2]
+            return subprocess.CompletedProcess(cmd, 0, stdout=f"{jid}  {next(states)}\n", stderr="")
+        return subprocess.CompletedProcess(cmd, 0, stdout="", stderr="")
+
+    with mock.patch.object(sjm.subprocess, "run", side_effect=run), \
+         mock.patch.object(sjm.time, "sleep"):
+        final = sjm.monitor_job("train.sbatch", max_relaunches=3)
+    assert final == "102"  # one relaunch after FAILED
+    assert len(submitted) == 2

@@ -17,4 +17,24 @@ from .dist import (
     is_using_pp,
 )
 
+_SUBPACKAGES = ("models", "ops", "parallel", "tools", "utils")
+
+
+def __getattr__(name: str):
+    # Lazy subpackage import (PEP 562): keeps `import torchdistpackage_tpu`
+    # light — e.g. the SLURM babysitter runs on login nodes without pulling
+    # Pallas kernels or the model stack.
+    if name in _SUBPACKAGES:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBPACKAGES))
+
+
 __version__ = "0.1.0"

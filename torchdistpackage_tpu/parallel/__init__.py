@@ -8,6 +8,7 @@ from .moe import (
 )
 from .zero import ZeroOptimizer, zero_partition_spec
 from .ema import ShardedEMA
+from .fsdp import FSDP, memory_report, offload_to_host, reload_to_device
 from .clip import (
     DynamicLossScale,
     clip_by_global_norm_parallel,

@@ -142,10 +142,10 @@ class ShardedEMA:
         ``verify_with_gt`` (sharded_ema.py:63-70; reference uses exact
         ``torch.equal``, we default to exact too via atol=0)."""
         mine = self.state_dict(state)
+        if jax.tree_util.tree_structure(mine) != jax.tree_util.tree_structure(gt):
+            return False
         flat_m = jax.tree_util.tree_leaves(mine)
         flat_g = jax.tree_util.tree_leaves(gt)
-        if len(flat_m) != len(flat_g):
-            return False
         for m, g in zip(flat_m, flat_g):
             g = np.asarray(jax.device_get(g), dtype=np.asarray(m).dtype)
             if not np.allclose(m, g, atol=atol, rtol=0.0):

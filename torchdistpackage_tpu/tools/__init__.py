@@ -1,0 +1,50 @@
+"""Tools layer — profiling, NaN hunting, param surgery / int8 quantization,
+and SLURM job babysitting.
+
+Analogue of the reference's ``torchdistpackage/tools/`` (module_profiler,
+debug_nan, module_replace, bnb_fc/bminf_int8, slurm_job_monitor).
+"""
+
+from .profiler import (
+    BlockProfile,
+    get_model_profile,
+    profile_blocks,
+    report_prof,
+)
+from .debug_nan import (
+    check_model_params,
+    check_tensors,
+    enable_nan_debug,
+    find_nan_block,
+    nan_guard,
+)
+from .surgery import (
+    QuantizedLinear,
+    dequantize_int8,
+    int8_matmul,
+    quantize_int8,
+    quantize_params_int8,
+    replace_params,
+)
+from .slurm_job_monitor import determine_job_is_alive, launch_job, monitor_job
+
+__all__ = [
+    "BlockProfile",
+    "get_model_profile",
+    "profile_blocks",
+    "report_prof",
+    "check_model_params",
+    "check_tensors",
+    "enable_nan_debug",
+    "find_nan_block",
+    "nan_guard",
+    "QuantizedLinear",
+    "dequantize_int8",
+    "int8_matmul",
+    "quantize_int8",
+    "quantize_params_int8",
+    "replace_params",
+    "determine_job_is_alive",
+    "launch_job",
+    "monitor_job",
+]

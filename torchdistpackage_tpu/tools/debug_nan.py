@@ -1,0 +1,112 @@
+"""NaN/Inf hunting — analogue of ``debug_nan``
+(``torchdistpackage/tools/debug_nan.py``, 60 LoC).
+
+The reference registers fwd/bwd hooks that scan every module's tensors and
+drop into pdb at the first offender.  TPU-native equivalents:
+
+- :func:`enable_nan_debug` — flips ``jax_debug_nans``, XLA's own
+  first-offender trap (re-runs the offending primitive un-jitted and raises
+  with a traceback — strictly stronger than the reference's pdb hook).
+- :func:`check_tensors` — host-side pytree scan reporting the key-paths of
+  non-finite leaves (``check_tensors``, debug_nan.py:3-21).
+- :func:`nan_guard` — decorator that checks a jitted function's outputs via
+  ``jax.debug.callback`` (works *inside* jit, on device, per step — the
+  hook-per-forward analogue).
+- :func:`find_nan_block` — run a block-decomposed model and return the first
+  block producing non-finite values (the "which layer?" question the
+  reference answers with its per-module hooks).
+- :func:`check_model_params` (debug_nan.py:55-60) — param-tree scan.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+def enable_nan_debug(enable: bool = True) -> None:
+    """XLA-native nan trap: any nan produced under jit raises at the
+    offending primitive."""
+    jax.config.update("jax_debug_nans", enable)
+
+
+from ..utils.tree import key_str as _key_str
+
+
+def check_tensors(tree: PyTree, name: str = "tensors", raise_on_bad: bool = False) -> List[str]:
+    """Scan a (host or device) pytree; return key-paths of non-finite leaves.
+
+    Analogue of ``check_tensors`` (debug_nan.py:3-21) minus the pdb drop —
+    pass ``raise_on_bad=True`` to fail fast instead.
+    """
+    bad: List[str] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            n_nan = int(np.isnan(arr).sum())
+            n_inf = int(np.isinf(arr).sum())
+            bad.append(f"{name}/{_key_str(path)} (nan={n_nan}, inf={n_inf})")
+    if bad and raise_on_bad:
+        raise FloatingPointError(f"non-finite values in {name}: {bad}")
+    return bad
+
+
+def check_model_params(params: PyTree, raise_on_bad: bool = False) -> List[str]:
+    """Analogue of ``check_model_params`` (debug_nan.py:55-60)."""
+    return check_tensors(params, name="params", raise_on_bad=raise_on_bad)
+
+
+def nan_guard(fn: Callable = None, *, name: Optional[str] = None) -> Callable:
+    """Decorator: after ``fn``'s outputs are computed (still on device, still
+    under jit), a callback scans them and raises on non-finite values —
+    the per-forward hook analogue (fwd_hook_wrapper, debug_nan.py:24-38)."""
+
+    def deco(f: Callable) -> Callable:
+        label = name or f.__qualname__
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            out = f(*args, **kwargs)
+
+            def leaf_flags(tree):
+                return [
+                    jnp.logical_not(jnp.all(jnp.isfinite(x)))
+                    for x in jax.tree_util.tree_leaves(tree)
+                    if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+                ]
+
+            flags = leaf_flags(out)
+            if flags:
+                def report(*host_flags):
+                    if any(bool(h) for h in host_flags):
+                        raise FloatingPointError(
+                            f"nan_guard: non-finite output of {label}"
+                        )
+
+                jax.debug.callback(report, *flags)
+            return out
+
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def find_nan_block(
+    blocks: Sequence[Tuple[str, Callable]], x: PyTree
+) -> Tuple[Optional[str], PyTree]:
+    """Run ``[(name, fn), ...]`` sequentially; return (first offending block
+    name or None, last output).  The "walk the model, stop at the first bad
+    layer" workflow of the reference's hooks, for block-decomposed models."""
+    for name, fn in blocks:
+        x = fn(x)
+        if check_tensors(x, name=name):
+            return name, x
+    return None, x

@@ -1,0 +1,164 @@
+"""Per-block model profiler — analogue of ``module_profiler``
+(``torchdistpackage/tools/module_profiler.py``, 171 LoC).
+
+The reference installs forward pre/post hooks on every submodule, records
+``cuda.synchronize``-ed timestamps + ``memory_allocated`` deltas and
+activation sizes, then prints a per-level report sorted by **MB/ms** — the
+ratio that tells you where gradient checkpointing buys the most memory per
+unit of recompute (module_profiler.py:97-144, module_profile.md:36-45).
+
+TPU-native design: JAX models are functions, not module trees, and XLA is
+async — so instead of hooks we profile a model expressed as a sequence of
+named block functions (the natural decomposition of a transformer stack):
+
+- wall time per block via ``block_until_ready`` timing of the jitted block,
+- activation bytes = output leaf nbytes (what remat would NOT store),
+- FLOPs + bytes-accessed from XLA's own ``cost_analysis`` on the compiled
+  block (no hand-counting),
+- on-device peak/temp memory from ``memory_analysis`` when the backend
+  reports it (TPU does; the CPU sim may not).
+
+The report ranks blocks by activation-MB per ms of recompute — same decision
+metric as the reference, computed from compiler ground truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class BlockProfile:
+    name: str
+    time_ms: float
+    act_bytes: int
+    flops: float
+    bytes_accessed: float
+    temp_bytes: int
+
+    @property
+    def act_mb(self) -> float:
+        return self.act_bytes / 1e6
+
+    @property
+    def mb_per_ms(self) -> float:
+        """The remat-placement metric (module_profile.md:36-45): activation
+        memory you free per ms of recompute you pay."""
+        return self.act_mb / self.time_ms if self.time_ms > 0 else float("inf")
+
+
+def _tree_bytes(tree: PyTree) -> int:
+    return sum(
+        int(np.prod(x.shape)) * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(tree)
+        if hasattr(x, "shape") and hasattr(x, "dtype")
+    )
+
+
+def _cost(compiled) -> Tuple[float, float, int]:
+    """(flops, bytes_accessed, temp_bytes) from XLA analyses; zeros when the
+    backend doesn't report them."""
+    flops = bytes_accessed = 0.0
+    temp = 0
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        bytes_accessed = float(ca.get("bytes accessed", 0.0))
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        temp = int(getattr(ma, "temp_size_in_bytes", 0))
+    except Exception:
+        pass
+    return flops, bytes_accessed, temp
+
+
+def profile_blocks(
+    blocks: Sequence[Tuple[str, Callable]],
+    x: PyTree,
+    warmup: int = 1,
+    iters: int = 3,
+) -> Tuple[List[BlockProfile], PyTree]:
+    """Run ``x`` through ``[(name, fn), ...]`` sequentially, profiling each.
+
+    Each ``fn`` takes the previous block's output.  Returns the per-block
+    profiles and the final output.  Analogue of ``register_profile_hooks`` +
+    a forward pass (module_profiler.py:61-94), with XLA cost analysis instead
+    of memory-counter deltas.
+    """
+    profiles: List[BlockProfile] = []
+    for name, fn in blocks:
+        jitted = jax.jit(fn)
+        lowered = jitted.lower(x)
+        compiled = lowered.compile()
+        flops, bytes_accessed, temp = _cost(compiled)
+        if iters < 1:
+            raise ValueError("iters must be >= 1")
+        for _ in range(warmup):  # warmup=0 measures the cold first run
+            jax.block_until_ready(compiled(x))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = compiled(x)
+        jax.block_until_ready(out)
+        dt_ms = (time.perf_counter() - t0) / iters * 1e3
+        profiles.append(
+            BlockProfile(
+                name=name,
+                time_ms=dt_ms,
+                act_bytes=_tree_bytes(out),
+                flops=flops,
+                bytes_accessed=bytes_accessed,
+                temp_bytes=temp,
+            )
+        )
+        x = out
+    return profiles, x
+
+
+def report_prof(profiles: Sequence[BlockProfile], sort_by_ratio: bool = True) -> str:
+    """Formatted table, MB/ms-sorted like ``report_prof``
+    (module_profiler.py:97-144) — top rows are the best remat candidates."""
+    rows = list(profiles)
+    if sort_by_ratio:
+        rows = sorted(rows, key=lambda p: -p.mb_per_ms)
+    header = (
+        f"{'block':<24}{'time_ms':>10}{'act_MB':>10}{'MB/ms':>10}"
+        f"{'GFLOP':>10}{'GB_touched':>12}{'temp_MB':>10}"
+    )
+    lines = [header, "-" * len(header)]
+    for p in rows:
+        lines.append(
+            f"{p.name:<24}{p.time_ms:>10.3f}{p.act_mb:>10.3f}{p.mb_per_ms:>10.3f}"
+            f"{p.flops / 1e9:>10.3f}{p.bytes_accessed / 1e9:>12.4f}"
+            f"{p.temp_bytes / 1e6:>10.3f}"
+        )
+    total_t = sum(p.time_ms for p in profiles)
+    total_mb = sum(p.act_mb for p in profiles)
+    lines.append("-" * len(header))
+    lines.append(f"{'TOTAL':<24}{total_t:>10.3f}{total_mb:>10.3f}")
+    return "\n".join(lines)
+
+
+def get_model_profile(
+    blocks: Sequence[Tuple[str, Callable]],
+    x: PyTree,
+    warmup: int = 1,
+    iters: int = 3,
+    print_report: bool = True,
+) -> List[BlockProfile]:
+    """One-call profile + report — analogue of ``get_model_profile``
+    (module_profiler.py:146-171)."""
+    profiles, _ = profile_blocks(blocks, x, warmup=warmup, iters=iters)
+    if print_report:
+        print(report_prof(profiles))
+    return profiles

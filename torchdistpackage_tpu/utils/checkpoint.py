@@ -50,6 +50,14 @@ def _ocp():
     return ocp
 
 
+def _norm_path(path: str) -> str:
+    """Absolutize local paths; leave URI schemes (gs://, s3://, ...) intact —
+    Orbax handles those natively and abspath would mangle them."""
+    if "://" in path:
+        return path
+    return os.path.abspath(path)
+
+
 def save_checkpoint(path: str, state: PyTree, force: bool = True) -> None:
     """Write ``state`` (any pytree of arrays/scalars) to ``path``.
 
@@ -58,7 +66,7 @@ def save_checkpoint(path: str, state: PyTree, force: bool = True) -> None:
     ShardedEMA's rank-0 send/recv reconstruction (sharded_ema.py:36-61).
     """
     ocp = _ocp()
-    path = os.path.abspath(path)
+    path = _norm_path(path)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, state, force=force)
 
@@ -78,7 +86,18 @@ def load_checkpoint(
       resharding-resume path (checkpoint from one mesh, resume on another).
     """
     ocp = _ocp()
-    path = os.path.abspath(path)
+    path = _norm_path(path)
+    if specs is not None and mesh is None:
+        from ..dist.topology import tpc
+
+        mesh = tpc.get_view()
+    if mesh is not None and specs is None:
+        raise ValueError("load_checkpoint: `mesh` given without `specs`")
+    if specs is not None and template is None:
+        raise ValueError(
+            "load_checkpoint: resharding restore (`specs`) needs `template` "
+            "for the shapes/dtypes"
+        )
     with ocp.StandardCheckpointer() as ckptr:
         if template is None:
             return ckptr.restore(path)
@@ -120,7 +139,7 @@ class CheckpointManager:
 
     def __init__(self, directory: str, max_to_keep: int = 3, save_interval_steps: int = 1):
         ocp = _ocp()
-        self.directory = os.path.abspath(directory)
+        self.directory = _norm_path(directory)
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -148,6 +167,17 @@ class CheckpointManager:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if specs is not None and mesh is None:
+            from ..dist.topology import tpc
+
+            mesh = tpc.get_view()
+        if mesh is not None and specs is None:
+            raise ValueError("restore: `mesh` given without `specs`")
+        if specs is not None and template is None:
+            raise ValueError(
+                "restore: resharding restore (`specs`) needs `template` "
+                "for the shapes/dtypes"
+            )
         if template is None:
             return self._mgr.restore(step)
         if mesh is not None and specs is not None:

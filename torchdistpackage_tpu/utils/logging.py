@@ -16,7 +16,6 @@ from typing import Callable
 import jax
 
 _builtin_print = builtins.print
-_patched = False
 
 
 def is_master() -> bool:
@@ -48,18 +47,14 @@ def disable_non_master_print(force: bool = False) -> None:
     same escape hatch as the reference (dist/utils.py:96-101).  Repeated
     calls re-install the gate with the new ``force`` default.
     """
-    global _patched
 
     def gated_print(*args, force: bool = force, force_print: bool = False, **kwargs):
         if is_master() or force or force_print:
             _builtin_print(*args, **kwargs)
 
     builtins.print = gated_print
-    _patched = True
 
 
 def enable_all_print() -> None:
     """Undo :func:`disable_non_master_print`."""
-    global _patched
     builtins.print = _builtin_print
-    _patched = False

@@ -19,11 +19,9 @@ from typing import Any, List, Tuple
 import jax
 import numpy as np
 
+from .tree import key_str as _key_str
+
 PyTree = Any
-
-
-def _key_str(path) -> str:
-    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
 
 
 def _numel(x) -> int:

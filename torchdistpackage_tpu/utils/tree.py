@@ -1,0 +1,10 @@
+"""Shared pytree helpers."""
+
+from __future__ import annotations
+
+
+def key_str(path) -> str:
+    """Render a jax key-path as 'a/b/0' — the canonical leaf name used by
+    partitioning, surgery and debug tooling (one implementation so predicates
+    and partition rules always agree on names)."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
