@@ -168,3 +168,19 @@ def test_tp_sp_pp_dp_training_matches_serial(devices8, params):
         rtol=1e-4,
         atol=1e-5,
     )
+
+
+def test_gpt_remat_grads_match():
+    """Activation-checkpointed grads must equal un-checkpointed grads."""
+    cfg = GPTConfig(vocab_size=64, dim=32, nheads=2, nlayers=3, max_seq=16,
+                    ffn_mult=2, dtype=jnp.float32)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    batch = {
+        "tokens": jax.random.randint(k1, (2, 16), 0, 64),
+        "targets": jax.random.randint(k2, (2, 16), 0, 64),
+    }
+    g0 = jax.jit(jax.grad(lambda p: gpt_loss(p, batch, cfg, remat=False)))(params)
+    g1 = jax.jit(jax.grad(lambda p: gpt_loss(p, batch, cfg, remat=True)))(params)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)

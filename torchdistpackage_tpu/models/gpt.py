@@ -125,7 +125,10 @@ def vocab_parallel_xent(
 # -------------------------------------------------------------------- forward
 
 
-def _scan_blocks(stacked: PyTree, x: jnp.ndarray, cfg: TransformerConfig, axis, sp):
+def _scan_blocks(
+    stacked: PyTree, x: jnp.ndarray, cfg: TransformerConfig, axis, sp,
+    remat: bool = False,
+):
     from ..parallel.data_parallel import _mark_varying, _vma
 
     # the carry's varying axes must cover the params' (e.g. pipe-sharded
@@ -137,8 +140,18 @@ def _scan_blocks(stacked: PyTree, x: jnp.ndarray, cfg: TransformerConfig, axis, 
     if missing:
         x = _mark_varying(x, missing)
 
+    blk = lambda lp, h: block_forward(lp, h, cfg, axis=axis, sp=sp)
+    if remat:
+        # activation checkpointing: only block boundaries are saved; the
+        # backward recomputes each block, trading ~1 extra fwd for O(L) less
+        # activation HBM — enables 2-4x larger per-chip batch (bench.py uses
+        # this; place selectively via tools/profiler.py MB/ms ranking)
+        # prevent_cse=False: scan's loop structure already blocks CSE, so the
+        # default optimization barriers would only cost performance
+        blk = jax.checkpoint(blk, prevent_cse=False)
+
     def body(h, lp):
-        return block_forward(lp, h, cfg, axis=axis, sp=sp), None
+        return blk(lp, h), None
 
     x, _ = jax.lax.scan(body, x, stacked)
     return x
@@ -166,13 +179,15 @@ def gpt_forward(
     cfg: GPTConfig,
     axis: Optional[str] = None,
     sp: bool = False,
+    remat: bool = False,
 ) -> jnp.ndarray:
     """tokens [B, S] -> logits [B, S, V_local].  Serial when ``axis`` is None,
-    TP(/SP) inside shard_map otherwise."""
+    TP(/SP) inside shard_map otherwise.  ``remat`` checkpoints each block
+    (see :func:`_scan_blocks`)."""
     h = gpt_embed(params, tokens, axis)
     if axis is not None and sp:
         h = split_to_sp(h, axis)
-    h = _scan_blocks(params["blocks"], h, cfg.block, axis, sp)
+    h = _scan_blocks(params["blocks"], h, cfg.block, axis, sp, remat=remat)
     return gpt_head(params, h, axis, sp)
 
 
@@ -182,10 +197,11 @@ def gpt_loss(
     cfg: GPTConfig,
     axis: Optional[str] = None,
     sp: bool = False,
+    remat: bool = False,
 ) -> jnp.ndarray:
     """Mean next-token cross-entropy.  ``batch``: {'tokens': [B, S],
     'targets': [B, S]}."""
-    logits = gpt_forward(params, batch["tokens"], cfg, axis=axis, sp=sp)
+    logits = gpt_forward(params, batch["tokens"], cfg, axis=axis, sp=sp, remat=remat)
     return vocab_parallel_xent(logits, batch["targets"], axis)
 
 
