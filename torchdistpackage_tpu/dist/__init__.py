@@ -11,3 +11,4 @@ from .topology import (
     tpc,
 )
 from .launch import setup_distributed, find_free_port
+from . import comm_bench

@@ -8,3 +8,11 @@ from .gpt import (
     vocab_parallel_embed,
     vocab_parallel_xent,
 )
+from .vit import (
+    ViTConfig,
+    init_vit_params,
+    patchify,
+    vit_forward,
+    vit_loss,
+    vit_param_specs,
+)

@@ -1,0 +1,164 @@
+"""Vision Transformer — the vision model family the reference exercises its
+DP/ZeRO paths with (``examples/test_ddp.py:74-86`` uses timm resnet50;
+``examples/test_zero_optim.py:88`` notes timm ViT).  Instead of wrapping an
+external torch model, the ViT is built from the same TP/SP transformer blocks
+as the GPT flagship, so every parallel strategy (DP, TP+SP, ZeRO, FSDP, EMA)
+applies to a vision workload unchanged.
+
+TPU notes: patchify is one reshape+matmul (a conv with stride=patch is
+exactly a [P*P*C, D] matmul on unfolded patches — MXU-friendly, no conv
+lowering needed); non-causal attention; mean-pool head (no CLS token keeps
+shapes static and pooling free).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.tensor_parallel import (
+    TransformerConfig,
+    block_forward,
+    block_param_specs,
+    init_block_params,
+    layer_norm,
+)
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    num_classes: int = 1000
+    dim: int = 384
+    nheads: int = 6
+    nlayers: int = 12
+    ffn_mult: int = 4
+    dtype: Any = jnp.float32
+    attn_impl: str = "naive"
+
+    @property
+    def num_patches(self) -> int:
+        assert self.image_size % self.patch_size == 0
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    @property
+    def block(self) -> TransformerConfig:
+        return TransformerConfig(
+            dim=self.dim, nheads=self.nheads, nlayers=self.nlayers,
+            ffn_mult=self.ffn_mult, causal=False, dtype=self.dtype,
+            attn_impl=self.attn_impl,
+        )
+
+
+def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """[B, H, W, C] -> [B, N, P*P*C] non-overlapping patches (pure reshape /
+    transpose — XLA fuses it into the following matmul's operand load)."""
+    B, H, W, C = images.shape
+    gh, gw = H // patch, W // patch
+    x = images.reshape(B, gh, patch, gw, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, gh * gw, patch * patch * C)
+
+
+def init_vit_params(key, cfg: ViTConfig) -> Dict[str, PyTree]:
+    kp, kpos, kh, kb = jax.random.split(key, 4)
+    dt = cfg.dtype
+    keys = jax.random.split(kb, cfg.nlayers)
+    blocks = [init_block_params(k, cfg.block) for k in keys]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *blocks)
+    return {
+        "patch_proj": {
+            "w": (jax.random.normal(kp, (cfg.patch_dim, cfg.dim))
+                  / math.sqrt(cfg.patch_dim)).astype(dt),
+            "b": jnp.zeros((cfg.dim,), dt),
+        },
+        "pos_emb": (jax.random.normal(kpos, (cfg.num_patches, cfg.dim)) * 0.02).astype(dt),
+        "blocks": stacked,
+        "ln_f": {"scale": jnp.ones((cfg.dim,), dt), "bias": jnp.zeros((cfg.dim,), dt)},
+        "head": {
+            "w": (jax.random.normal(kh, (cfg.dim, cfg.num_classes))
+                  / math.sqrt(cfg.dim)).astype(dt),
+            "b": jnp.zeros((cfg.num_classes,), dt),
+        },
+    }
+
+
+def vit_forward(
+    params: Dict[str, PyTree],
+    images: jnp.ndarray,
+    cfg: ViTConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """[B, H, W, C] images -> [B, num_classes] logits.  TP(/SP) over ``axis``
+    inside shard_map, serial when None — same contract as gpt_forward."""
+    from .gpt import _scan_blocks
+
+    x = patchify(images.astype(cfg.dtype), cfg.patch_size)
+    h = x @ params["patch_proj"]["w"] + params["patch_proj"]["b"]
+    h = h + params["pos_emb"]
+    if axis is not None and sp:
+        from ..parallel.tensor_parallel import split_to_sp
+
+        h = split_to_sp(h, axis)
+    h = _scan_blocks(params["blocks"], h, cfg.block, axis, sp, remat=remat)
+    if axis is not None and sp:
+        from ..parallel.tensor_parallel import gather_from_sp
+
+        h = gather_from_sp(h, axis)
+    h = layer_norm(h, params["ln_f"])
+    pooled = jnp.mean(h, axis=1)  # mean-pool over patches
+    return pooled @ params["head"]["w"] + params["head"]["b"]
+
+
+def vit_loss(
+    params: Dict[str, PyTree],
+    batch: Dict[str, jnp.ndarray],
+    cfg: ViTConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Mean softmax cross-entropy.  ``batch``: {'images': [B,H,W,C],
+    'labels': int [B]}.  Under TP the class dim of the head is sharded and
+    the CE closes with the same collectives as the GPT vocab-parallel CE."""
+    from .gpt import vocab_parallel_xent
+
+    logits = vit_forward(params, batch["images"], cfg, axis=axis, sp=sp, remat=remat)
+    # static shape tells whether the head was class-sharded: a local shard is
+    # narrower than num_classes (shapes are trace-time constants under XLA)
+    tp = axis if logits.shape[-1] != cfg.num_classes else None
+    return vocab_parallel_xent(logits, batch["labels"], tp)
+
+
+def vit_param_specs(cfg: ViTConfig, tp_axis: Optional[str] = None) -> Dict[str, PyTree]:
+    """PartitionSpec tree matching :func:`init_vit_params`: per-block TP specs
+    with a leading None for the layer-stack dim; class-sharded head when the
+    class count divides the TP size (else keep the head replicated by passing
+    specs with ``head`` overridden to P())."""
+    bspecs = block_param_specs(tp_axis)
+    is_spec = lambda x: isinstance(x, P)
+    blocks = jax.tree.map(lambda s: P(None, *tuple(s)), bspecs, is_leaf=is_spec)
+    head_w = P(None, tp_axis) if tp_axis else P()
+    head_b = P(tp_axis) if tp_axis else P()
+    return {
+        "patch_proj": {"w": P(), "b": P()},
+        "pos_emb": P(),
+        "blocks": blocks,
+        "ln_f": {"scale": P(), "bias": P()},
+        "head": {"w": head_w, "b": head_b},
+    }

@@ -168,6 +168,58 @@ def transformer_forward(
     return x
 
 
+def scan_blocks(
+    stacked: PyTree,
+    x: jnp.ndarray,
+    cfg: TransformerConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Run ``x`` through a layer-stacked block tree with ``lax.scan`` (one
+    compiled block body for L layers).  Shared by the GPT and ViT model
+    families and pipeline stage slabs.
+
+    ``remat`` checkpoints each block: only block boundaries are saved and the
+    backward recomputes the block, trading ~1 extra fwd for O(L) less
+    activation HBM — enables 2-4x larger per-chip batch (place selectively
+    via tools/profiler.py MB/ms ranking).
+    """
+    from ..data_parallel import _mark_varying, _vma
+
+    # the carry's varying axes must cover the params' (e.g. pipe-sharded
+    # stacks make the block output pipe-varying even when x starts replicated)
+    want = _vma(x)
+    for leaf in jax.tree.leaves(stacked):
+        want = want | _vma(leaf)
+    missing = tuple(a for a in want if a not in _vma(x))
+    if missing:
+        x = _mark_varying(x, missing)
+
+    blk = lambda lp, h: block_forward(lp, h, cfg, axis=axis, sp=sp)
+    if remat:
+        # prevent_cse=False: scan's loop structure already blocks CSE, so the
+        # default optimization barriers would only cost performance
+        blk = jax.checkpoint(blk, prevent_cse=False)
+
+    def body(h, lp):
+        return blk(lp, h), None
+
+    x, _ = jax.lax.scan(body, x, stacked)
+    return x
+
+
+def stacked_block_specs(
+    tp_axis: Optional[str] = None, stack_axis: Optional[str] = None
+) -> Dict[str, PyTree]:
+    """Per-block TP specs with a leading entry for the layer-stack dim —
+    ``stack_axis`` shards the stack (pipeline stages), None replicates it.
+    Shared by gpt_param_specs / vit_param_specs."""
+    bspecs = block_param_specs(tp_axis)
+    is_spec = lambda x: isinstance(x, P)
+    return jax.tree.map(lambda s: P(stack_axis, *tuple(s)), bspecs, is_leaf=is_spec)
+
+
 # ------------------------------------------------------------------------ init
 
 
