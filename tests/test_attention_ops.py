@@ -143,6 +143,38 @@ def test_transformer_flash_matches_naive():
         )
 
 
+def test_ring_flash_long_seq_8k(devices8):
+    """Long-context: 8k global tokens, 8-way CP ring with the Pallas flash
+    kernel per hop.  Cross-checked against the einsum-ring (use_flash=False)
+    golden path — the serial reference would materialize an 8k x 8k score
+    matrix, exactly what both ring paths avoid."""
+    tpc.setup_process_groups([("context", 8)], devices=devices8)
+    mesh = tpc.get_view()
+    S_global = 8192
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, S_global, 64), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, S_global, 64), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 1, S_global, 64), jnp.float32)
+    seq_spec = P(None, None, "context", None)
+
+    def run(use_flash):
+        return jax.jit(
+            shard_map(
+                functools.partial(
+                    ring_attention, axis="context", causal=True, use_flash=use_flash
+                ),
+                mesh=mesh,
+                in_specs=(seq_spec,) * 3,
+                out_specs=seq_spec,
+            )
+        )(*(jax.device_put(x, NamedSharding(mesh, seq_spec)) for x in (q, k, v)))
+
+    out_flash = run(True)
+    out_einsum = run(False)
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_einsum), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_ring_attention_long_seq_memory_shape(devices8):
     """Liveness at a longer sequence: 8-way CP over 2048 tokens, bf16."""
     tpc.setup_process_groups([("context", 8)], devices=devices8)

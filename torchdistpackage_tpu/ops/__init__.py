@@ -1,2 +1,2 @@
-from .flash_attention import flash_attention, mha_reference
+from .flash_attention import flash_attention, flash_attention_with_lse, mha_reference
 from .ring_attention import ring_attention, ulysses_attention

@@ -4,35 +4,53 @@ The reference only *derives* this math in a single-device numpy study
 (explore/flash-attn/tile_attn.py:100-212 — tiled online-softmax fwd+bwd); it
 ships no kernel.  Here it is a first-class TPU kernel: blockwise online
 softmax with f32 accumulators in VMEM, MXU matmuls via ``jnp.dot`` with
-``preferred_element_type``, causal upper-block skipping (the loop over KV
-blocks stops at the diagonal), and a standard flash backward (recompute
-probabilities from the saved logsumexp; dq kernel loops over KV blocks, dkv
-kernel loops over Q blocks).
+``preferred_element_type``, causal block skipping, and a standard flash
+backward (recompute probabilities from the saved logsumexp; dq kernel loops
+over KV blocks, dkv kernel loops over Q blocks).
+
+**Blocked-KV 3D grid**: K/V are streamed through VMEM one ``block_k`` tile at
+a time — the grid is ``(batch*heads, Sq/block_q, Sk/block_k)`` with the KV
+dimension innermost ("arbitrary" semantics, executed sequentially per core)
+and the online-softmax state ``(m, l, acc)`` carried in VMEM scratch across
+KV steps.  VMEM per program is O(block), independent of sequence length, so
+single-chip long-S is bounded by HBM, not VMEM; Mosaic double-buffers the KV
+block DMAs against the MXU work.
+
+The kernel also returns the per-row logsumexp **differentiably** (cotangents
+on lse fold into the standard flash ``delta`` term), which is what lets ring
+/ Ulysses context parallelism (ops/ring_attention.py) combine per-hop partial
+outputs exactly.
 
 On CPU (tests / CI sim) the kernels run in Pallas interpreter mode
 automatically, so the same code path is exercised everywhere.
-
-Current scope: K/V for one (batch, head) stays VMEM-resident per program
-(O(S) VMEM, fine to S ~ 16k at D=64 bf16; long-context runs shard S over the
-ring first — ops/ring_attention.py — so per-shard S stays moderate).  A
-blocked-KV 3D-grid revision lifts this ceiling for single-chip long S.
 """
 
 from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30  # finite "minus infinity": avoids (-inf) - (-inf) NaNs
+
+_LANES = 128  # m/l scratch keeps a full lane dim for layout friendliness
 
 
 def _interpret() -> bool:
     return jax.default_backend() == "cpu"
+
+
+def _compiler_params():
+    if _interpret():
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
 
 
 def mha_reference(
@@ -54,73 +72,105 @@ def mha_reference(
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct carrying the vma of ``like`` — required for
+    pallas_call under shard_map (check_vma=True)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _causal_hi(qi, block_q, block_k, num_kv):
+    """Number of KV blocks a causal row-block attends to (incl. diagonal)."""
+    hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+    return jnp.minimum(hi, num_kv)
+
+
 # ------------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_k, seq_k):
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, sm_scale, causal, num_kv,
+):
     block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
+    block_k = k_ref.shape[1]
     qi = pl.program_id(1)
+    kj = pl.program_id(2)
 
-    q = q_ref[0]  # [Bq, D] storage dtype — MXU takes bf16 in, f32 out
-    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q, 1), jnp.float32)
-    acc = jnp.zeros((block_q, d), jnp.float32)
+    hi = _causal_hi(qi, block_q, block_k, num_kv) if causal else num_kv
 
-    num_kv = seq_k // block_k
-    if causal:
-        # process KV blocks up to and including the diagonal block
-        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
-        hi = jnp.minimum(hi, num_kv)
-    else:
-        hi = num_kv
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def body(j, carry):
-        m, l, acc = carry
-        kblk = k_ref[0, pl.ds(j * block_k, block_k), :]
-        vblk = v_ref[0, pl.ds(j * block_k, block_k), :]
+    @pl.when(kj < hi)
+    def _compute():
+        q = q_ref[0]  # [Bq, D] storage dtype — MXU takes bf16 in, f32 out
+        kblk = k_ref[0]
+        vblk = v_ref[0]
+        m = m_ref[:, :1]
+        l = l_ref[:, :1]
         s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
             s = jnp.where(kpos <= qpos, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jnp.dot(
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jnp.dot(
             p.astype(vblk.dtype), vblk, preferred_element_type=jnp.float32
         )
-        return m_new, l, acc
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m, l, acc))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)  # [Bq, 1]
+    @pl.when(kj == hi - 1)
+    def _write():
+        m = m_ref[:, :1]
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m + jnp.log(l)  # [Bq, 1]
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
-    grid = (BH, Sq // block_q)
+    num_kv = Sk // block_k
+    grid = (BH, Sq // block_q, num_kv)
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k, seq_k=Sk
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, num_kv=num_kv
     )
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, Sq, 1), jnp.float32),
+            _out_struct((BH, Sq, D), q.dtype, q),
+            _out_struct((BH, Sq, 1), jnp.float32, q),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),       # acc
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # m
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # l
+        ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(q, k, v)
     return o, lse
@@ -130,126 +180,162 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, causal, block_k, seq_k
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
+    *, sm_scale, causal, num_kv,
 ):
     block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
+    block_k = k_ref.shape[1]
     qi = pl.program_id(1)
+    kj = pl.program_id(2)
 
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0]  # [Bq, 1]
-    delta = delta_ref[0]
-    dq = jnp.zeros((block_q, d), jnp.float32)
+    hi = _causal_hi(qi, block_q, block_k, num_kv) if causal else num_kv
 
-    num_kv = seq_k // block_k
-    if causal:
-        hi = jnp.minimum(jax.lax.div((qi + 1) * block_q + block_k - 1, block_k), num_kv)
-    else:
-        hi = num_kv
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-    def body(j, dq):
-        kblk = k_ref[0, pl.ds(j * block_k, block_k), :]
-        vblk = v_ref[0, pl.ds(j * block_k, block_k), :]
+    @pl.when(kj < hi)
+    def _compute():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]  # [Bq, 1]
+        delta = delta_ref[0]
+        kblk = k_ref[0]
+        vblk = v_ref[0]
         s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
             s = jnp.where(kpos <= qpos, s, NEG_INF)
         p = jnp.exp(s - lse)  # [Bq, Bk]
         dp = jnp.dot(do, vblk.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(kblk.dtype)
-        return dq + jnp.dot(ds, kblk, preferred_element_type=jnp.float32)
+        dq_acc_ref[...] = dq_acc_ref[...] + jnp.dot(
+            ds, kblk, preferred_element_type=jnp.float32
+        )
 
-    dq = jax.lax.fori_loop(0, hi, body, dq)
-    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+    @pl.when(kj == hi - 1)
+    def _write():
+        dq_ref[0] = (dq_acc_ref[...] * sm_scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, sm_scale, causal, block_q, seq_q,
+    dk_acc_ref, dv_acc_ref,
+    *, sm_scale, causal, num_q,
 ):
+    block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
-    d = k_ref.shape[2]
     ki = pl.program_id(1)
+    qi = pl.program_id(2)
 
-    k = k_ref[0]
-    v = v_ref[0]
-    dk = jnp.zeros((block_k, d), jnp.float32)
-    dv = jnp.zeros((block_k, d), jnp.float32)
-
-    num_q = seq_q // block_q
     # causal: only q blocks at or after this kv block contribute
     lo = jax.lax.div(ki * block_k, block_q) if causal else 0
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :]
-        do = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]  # [Bq, 1]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    @pl.when(qi >= lo)
+    def _compute():
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]  # [Bq, 1]
+        delta = delta_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale  # [Bq, Bk]
         if causal:
-            qpos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
             s = jnp.where(kpos <= qpos, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dv = dv + jnp.dot(p.T.astype(do.dtype), do, preferred_element_type=jnp.float32)
+        dv_acc_ref[...] = dv_acc_ref[...] + jnp.dot(
+            p.T.astype(do.dtype), do, preferred_element_type=jnp.float32
+        )
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(q.dtype)
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_acc_ref[...] = dk_acc_ref[...] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
 
-    dk, dv = jax.lax.fori_loop(lo, num_q, body, (dk, dv))
-    dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == num_q - 1)
+    def _write():
+        dk_ref[0] = (dk_acc_ref[...] * sm_scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, res, dout):
+def _bwd(sm_scale, causal, block_q, block_k, res, cts):
     q, k, v, o, lse = res
+    dout, dlse = cts
     BH, Sq, D = q.shape
     Sk = k.shape[1]
-    delta = jnp.sum(dout.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # [BH, Sq, 1]
+    num_q = Sq // block_q
+    num_kv = Sk // block_k
+    # delta is the standard flash rowsum(do * o); a cotangent on lse folds in
+    # exactly here: d lse_i / d s_ij = p_ij, so ds += dlse_i * p_ij, i.e.
+    # delta' = delta - dlse.
+    delta = jnp.sum(
+        dout.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [BH, Sq, 1]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k, seq_k=Sk
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, num_kv=num_kv
         ),
-        grid=(BH, Sq // block_q),
+        grid=(BH, num_q, num_kv),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=_out_struct(q.shape, q.dtype, q),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(q, k, v, dout, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, seq_q=Sq
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, num_q=num_q
         ),
-        grid=(BH, Sk // block_k),
+        grid=(BH, num_kv, num_q),
         in_specs=[
-            pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Sq, 1), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Sq, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            _out_struct(k.shape, k.dtype, k),
+            _out_struct(v.shape, v.dtype, v),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(q, k, v, dout, lse, delta)
     return dq, dk, dv
@@ -260,20 +346,34 @@ def _bwd(sm_scale, causal, block_q, block_k, res, dout):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
-    return o
+    return _fwd(q, k, v, sm_scale, causal, block_q, block_k)
 
 
 def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
     o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(sm_scale, causal, block_q, block_k, res, dout):
-    return _bwd(sm_scale, causal, block_q, block_k, res, dout)
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, res, cts):
+    return _bwd(sm_scale, causal, block_q, block_k, res, cts)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _prep(q, k, v, sm_scale, block_q, block_k):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    # clamp to the sequence, then shrink to an exact divisor (gcd) so any
+    # shard length works — e.g. ring shards of 384 with block_q=256 use 128
+    block_q = math.gcd(min(block_q, Sq), Sq)
+    block_k = math.gcd(min(block_k, Sk), Sk)
+    qf = q.reshape(B * H, Sq, D)
+    kf = k.reshape(B * H, Sk, D)
+    vf = v.reshape(B * H, Sk, D)
+    return qf, kf, vf, float(sm_scale), int(block_q), int(block_k)
 
 
 def flash_attention(
@@ -287,19 +387,34 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Blockwise (flash) attention.  [B, H, S, D] layout, differentiable.
 
-    Block sizes are clamped to the sequence lengths; S must be divisible by
-    the (clamped) block sizes — pad upstream for ragged lengths.
+    Block sizes are clamped to the sequence lengths and shrunk (gcd) to exact
+    divisors of S, so any shard length traces; power-of-two S keeps the
+    requested blocks.  Pad upstream if S is prime-ish and perf matters.
     """
     B, H, Sq, D = q.shape
-    Sk = k.shape[2]
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(D)
-    block_q = min(block_q, Sq)
-    block_k = min(block_k, Sk)
-    if Sq % block_q or Sk % block_k:
-        raise ValueError(f"seq lengths ({Sq}, {Sk}) not divisible by blocks ({block_q}, {block_k})")
-    qf = q.reshape(B * H, Sq, D)
-    kf = k.reshape(B * H, Sk, D)
-    vf = v.reshape(B * H, Sk, D)
-    o = _flash(qf, kf, vf, float(sm_scale), bool(causal), int(block_q), int(block_k))
+    qf, kf, vf, sm_scale, block_q, block_k = _prep(q, k, v, sm_scale, block_q, block_k)
+    o, _ = _flash(qf, kf, vf, sm_scale, bool(causal), block_q, block_k)
     return o.reshape(B, H, Sq, D)
+
+
+def flash_attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Like :func:`flash_attention` but also returns the per-row logsumexp
+    ``[B, H, S]`` (f32), differentiably.
+
+    This is the composition point for ring / Ulysses context parallelism:
+    per-hop partial outputs combine exactly via
+    ``o = sum_i exp(lse_i - lse_total) * o_i`` with
+    ``lse_total = logaddexp_i(lse_i)`` (ops/ring_attention.py).
+    """
+    B, H, Sq, D = q.shape
+    qf, kf, vf, sm_scale, block_q, block_k = _prep(q, k, v, sm_scale, block_q, block_k)
+    o, lse = _flash(qf, kf, vf, sm_scale, bool(causal), block_q, block_k)
+    return o.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)

@@ -8,13 +8,17 @@ built the TPU way:
 
 - :func:`ring_attention` — sequence sharded over a ``'context'`` mesh axis;
   each device keeps its Q shard resident and the KV shards rotate around the
-  ICI ring via ``lax.ppermute`` (one hop per step), combined with the
-  blockwise online-softmax update.  Activation memory per device is
-  O(S/cp) and each step's ppermute overlaps with the attention compute of
-  the block in hand (XLA async collectives).  Differentiable: AD transposes
-  ppermute to the reverse rotation automatically.
+  ICI ring via ``lax.ppermute`` (one hop per step).  With ``use_flash=True``
+  (default) each hop runs the Pallas flash kernel on the KV shard in hand
+  (``flash_attention_with_lse``) and the per-hop partial outputs combine
+  exactly through their logsumexps — so the inner loop is MXU-blocked VMEM
+  compute, never an [S_loc, S_loc] score matrix in HBM.  Activation memory
+  per device is O(S/cp) and each step's ppermute overlaps with the attention
+  compute of the block in hand (XLA async collectives).  Differentiable: AD
+  transposes ppermute to the reverse rotation automatically, and the flash
+  kernel's lse output carries its own cotangent.
 - :func:`ulysses_attention` — the all-to-all alternative: scatter heads /
-  gather sequence over the axis, run full (flash) attention on H/cp local
+  gather sequence over the axis, run full flash attention on H/cp local
   heads, scatter back.  Two all_to_alls instead of cp-1 ppermute hops;
   better when H >= cp and S very long.
 
@@ -30,7 +34,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .flash_attention import NEG_INF, mha_reference
+from .flash_attention import NEG_INF, flash_attention_with_lse, mha_reference
 
 
 def _block_update(q, k, v, m, l, acc, qpos, kpos, causal, sm_scale):
@@ -52,6 +56,18 @@ def _block_update(q, k, v, m, l, acc, qpos, kpos, causal, sm_scale):
     return m_new, l, acc
 
 
+def _lse_combine(o, lse, o_j, lse_j):
+    """Exactly combine two softmax partials given their logsumexps.
+
+    ``o``/``o_j`` are each normalized over their own KV subset; the combined
+    output weights them by exp(lse - lse_new) — the fraction of the total
+    softmax mass each subset carries.  o/lse: [B,H,S,D] f32 / [B,H,S] f32."""
+    lse_new = jnp.logaddexp(lse, lse_j)
+    w = jnp.exp(lse - lse_new)[..., None]
+    w_j = jnp.exp(lse_j - lse_new)[..., None]
+    return o * w + o_j.astype(jnp.float32) * w_j, lse_new
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -59,14 +75,27 @@ def ring_attention(
     axis: Optional[str] = None,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    use_flash: bool = True,
+    block_q: int = 256,
+    block_k: int = 512,
 ) -> jnp.ndarray:
     """Ring attention over the ``axis`` mesh ring.  [B, H, S_local, D] layout
     with the global sequence sharded contiguously over the axis (shard i owns
-    positions [i*S_local, (i+1)*S_local))."""
+    positions [i*S_local, (i+1)*S_local)).
+
+    ``use_flash=True`` runs the Pallas flash kernel per ring hop and combines
+    hops via logsumexp (:func:`_lse_combine`); the shard alignment means each
+    hop is either the diagonal (standard causal flash), entirely in the past
+    (non-causal flash), or entirely in the future (skipped).
+    ``use_flash=False`` keeps the XLA einsum online-softmax update (golden /
+    debug path — materializes [S_loc, S_loc] scores per hop).
+    """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if axis is None:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    if use_flash:
+        return _ring_attention_flash(q, k, v, axis, causal, sm_scale, block_q, block_k)
 
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
@@ -108,6 +137,58 @@ def ring_attention(
     return (acc / l).astype(q.dtype)
 
 
+def _ring_attention_flash(q, k, v, axis, causal, sm_scale, block_q, block_k):
+    """Flash-kernel ring: per hop, one Pallas flash call over the KV shard in
+    hand; hops combine exactly via logsumexp weights."""
+    from ..parallel.data_parallel import _mark_varying
+
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    B, H, S, D = q.shape
+
+    o0 = _mark_varying(jnp.zeros((B, H, S, D), jnp.float32), (axis,))
+    lse0 = _mark_varying(jnp.full((B, H, S), NEG_INF, jnp.float32), (axis,))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def flash_hop(kc, vc, hop_causal):
+        return flash_attention_with_lse(
+            q, kc, vc, causal=hop_causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k,
+        )
+
+    def step(carry, t):
+        o, lse, kc, vc = carry
+        src = (idx - t) % n  # original owner of the KV shard in hand
+
+        if causal:
+            def skip(opers):
+                # future shard: fully masked — zero mass keeps combine exact
+                # (derive from q so the vma matches the flash branches)
+                return q * 0, jnp.float32(NEG_INF) + (q[..., 0] * 0).astype(jnp.float32)
+
+            def diag(opers):
+                return flash_hop(*opers, hop_causal=True)
+
+            def past(opers):
+                return flash_hop(*opers, hop_causal=False)
+
+            # src > idx -> 0 (skip), src == idx -> 1 (diag), src < idx -> 2 (past)
+            branch = (src <= idx).astype(jnp.int32) + (src < idx).astype(jnp.int32)
+            o_j, lse_j = jax.lax.switch(branch, [skip, diag, past], (kc, vc))
+        else:
+            o_j, lse_j = flash_hop(kc, vc, hop_causal=False)
+
+        o, lse = _lse_combine(o, lse, o_j, lse_j)
+        # rotate KV to the next ring neighbor (uniform scan body lets XLA
+        # overlap the hop with the flash compute)
+        kc = jax.lax.ppermute(kc, axis, perm)
+        vc = jax.lax.ppermute(vc, axis, perm)
+        return (o, lse, kc, vc), None
+
+    (o, lse, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype)
+
+
 def ulysses_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -115,11 +196,12 @@ def ulysses_attention(
     axis: Optional[str] = None,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    use_flash: bool = False,
+    use_flash: bool = True,
 ) -> jnp.ndarray:
     """Ulysses (DeepSpeed-style) sequence parallelism: all_to_all scatters
     heads and gathers sequence, attention runs on full sequences with H/cp
-    local heads, then the inverse all_to_all restores [B, H, S_local, D]."""
+    local heads (through the Pallas flash kernel by default), then the
+    inverse all_to_all restores [B, H, S_local, D]."""
     if axis is None:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     n = jax.lax.axis_size(axis)
