@@ -18,6 +18,7 @@ from torchdistpackage_tpu.models import (
     gpt_forward,
     gpt_loss,
     gpt_param_specs,
+    gpt_pipeline_1f1b,
     gpt_pipeline_loss,
     init_gpt_params,
 )
@@ -155,6 +156,82 @@ def test_tp_sp_pp_dp_training_matches_serial(devices8, params):
         np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
 
     for name in ["tok_emb", "head"]:
+        np.testing.assert_allclose(
+            np.asarray(sharded[name]),
+            np.asarray(sparams[name]),
+            rtol=1e-4,
+            atol=1e-5,
+            err_msg=f"param divergence at {name}",
+        )
+    np.testing.assert_allclose(
+        np.asarray(sharded["blocks"]["mlp"]["w1"]),
+        np.asarray(sparams["blocks"]["mlp"]["w1"]),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+
+
+def test_gpt_1f1b_training_matches_serial(devices8, params):
+    """Full-composition 1F1B: DP=2 x PP=2 x TP=2 (+SP) with the interleaved
+    schedule supplying (loss, grads) directly to the DataParallel step; two
+    optimizer steps must track the serial model — the strongest form of the
+    reference's golden discipline applied to the 1F1B scheduler."""
+    M, mbs = 4, 2
+    tpc.setup_process_groups(
+        [("data", 2), ("pipe", 2), ("tensor", 2)], devices=devices8
+    )
+    mesh = tpc.get_view()
+    specs = gpt_param_specs(CFG, tp_axis="tensor", pipe_axis="pipe")
+
+    def vg_fn(p, batch):
+        return gpt_pipeline_1f1b(
+            p, batch, CFG, num_microbatches=M, tp_axis="tensor", sp=True
+        )
+
+    opt = optax.sgd(1e-1)
+    dp = DataParallel(mesh=mesh)
+    sharded = dp.broadcast_params(params, param_specs=specs)
+    state = opt.init(sharded)
+    step = dp.make_train_step(
+        value_and_grad_fn=vg_fn,
+        optimizer=opt,
+        param_specs=specs,
+        batch_spec={"tokens": P(None, "data"), "targets": P(None, "data")},
+    )
+
+    sparams, sstate = params, opt.init(params)
+
+    def serial_loss(p, batch):
+        losses = [
+            gpt_loss(
+                p,
+                {"tokens": batch["tokens"][m], "targets": batch["targets"][m]},
+                CFG,
+            )
+            for m in range(M)
+        ]
+        return jnp.mean(jnp.stack(losses))
+
+    @jax.jit
+    def serial_step(p, s, b):
+        loss, g = jax.value_and_grad(serial_loss)(p, b)
+        u, s = opt.update(g, s, p)
+        return jax.tree.map(jnp.add, p, u), s, loss
+
+    for i in range(2):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(20 + i))
+        batch = {
+            "tokens": jax.random.randint(k1, (M, mbs * 2, S), 0, CFG.vocab_size),
+            "targets": jax.random.randint(k2, (M, mbs * 2, S), 0, CFG.vocab_size),
+        }
+        sparams, sstate, sloss = serial_step(sparams, sstate, batch)
+        dbatch = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P(None, "data"))), batch
+        )
+        sharded, state, dloss = step(sharded, state, dbatch)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
+
+    for name in ["tok_emb", "pos_emb", "head"]:
         np.testing.assert_allclose(
             np.asarray(sharded[name]),
             np.asarray(sparams[name]),

@@ -3,6 +3,7 @@ from .gpt import (
     gpt_forward,
     gpt_loss,
     gpt_param_specs,
+    gpt_pipeline_1f1b,
     gpt_pipeline_loss,
     init_gpt_params,
     vocab_parallel_embed,

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.pipeline_parallel import pipeline_loss
+from ..parallel.pipeline_parallel import pipeline_1f1b, pipeline_loss
 from ..parallel.tensor_parallel import (
     TransformerConfig,
     block_forward,
@@ -222,22 +222,21 @@ def gpt_pipeline_loss(
     ``pipe`` axis, optionally + ``tensor``/``data``).
 
     ``batch``: {'tokens': [M, mbs, S], 'targets': [M, mbs, S]} microbatched on
-    the leading dim.  Embedding runs un-pipelined (computed on every stage,
-    consumed on stage 0 — its grad arrives via the shard_map transpose psum
-    over ``pipe``, the analogue of tied-embedding grad sync); the block stack
-    is the pipelined region (each stage scans its slab of the layer-stacked
-    params); LN + head + vocab-parallel CE run in the last stage's
-    per-microbatch loss."""
+    the leading dim.  The embedding runs PER TICK inside the pipeline scan on
+    stage 0 (its grad arrives via the shard_map transpose psum over ``pipe``,
+    the analogue of tied-embedding grad sync), so only the raw int tokens —
+    never M pre-embedded activations — stay resident; the block stack is the
+    pipelined region (each stage scans its slab of the layer-stacked params);
+    LN + head + vocab-parallel CE run in the last stage's per-microbatch
+    loss."""
     M = num_microbatches
     tokens, targets = batch["tokens"], batch["targets"]
 
-    def embed_mb(toks):
-        h = gpt_embed(params, toks, tp_axis)
+    def first_fn(p, toks):
+        h = gpt_embed(p, toks, tp_axis)
         if tp_axis is not None and sp:
             h = split_to_sp(h, tp_axis)
         return h
-
-    microbatches = jax.vmap(embed_mb)(tokens)
 
     def stage_fn(stacked, x):
         return _scan_blocks(stacked, x, cfg.block, tp_axis, sp)
@@ -248,13 +247,64 @@ def gpt_pipeline_loss(
 
     return pipeline_loss(
         params["blocks"],
-        microbatches,
+        tokens,
         targets,
         stage_fn=stage_fn,
         loss_fn=mb_loss,
         num_microbatches=M,
         pipe_axis=pipe_axis,
         remat=remat,
+        first_fn=first_fn,
+        params=params,
+    )
+
+
+def gpt_pipeline_1f1b(
+    params: Dict[str, PyTree],
+    batch: Dict[str, jnp.ndarray],
+    cfg: GPTConfig,
+    num_microbatches: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+    sp: bool = False,
+    remat: bool = True,
+):
+    """1F1B-scheduled GPT training step core: returns ``(loss, grads)``
+    directly (do NOT wrap in ``jax.grad`` — see
+    :func:`...pipeline_parallel.pipeline_1f1b`).  Peak live activations are
+    O(pipe_size), independent of the microbatch count, matching the
+    reference's steady-state interleave
+    (pipeline_parallel/pipeline_sched.py:163-211).
+
+    Stage ownership: stage 0 embeds (per tick), the last stage runs LN + head
+    + vocab-parallel CE inside its backward unit; embed/head grads are
+    psum-ed over ``pipe`` once at the end.
+
+    ``batch``: {'tokens': [M, mbs, S], 'targets': [M, mbs, S]}.
+    """
+
+    def first_fn(p, toks):
+        h = gpt_embed(p, toks, tp_axis)
+        if tp_axis is not None and sp:
+            h = split_to_sp(h, tp_axis)
+        return h
+
+    def stage_fn(p, x):
+        return _scan_blocks(p["blocks"], x, cfg.block, tp_axis, sp, remat=remat)
+
+    def last_fn(p, y, tgt):
+        logits = gpt_head(p, y, tp_axis, sp)
+        return vocab_parallel_xent(logits, tgt, tp_axis)
+
+    return pipeline_1f1b(
+        params,
+        batch["tokens"],
+        batch["targets"],
+        first_fn=first_fn,
+        stage_fn=stage_fn,
+        last_fn=last_fn,
+        num_microbatches=num_microbatches,
+        pipe_axis=pipe_axis,
     )
 
 

@@ -249,12 +249,13 @@ class DataParallel:
 
     def make_train_step(
         self,
-        loss_fn: Callable[[PyTree, PyTree], jnp.ndarray],
-        optimizer,
+        loss_fn: Optional[Callable[[PyTree, PyTree], jnp.ndarray]] = None,
+        optimizer=None,
         grad_accum_iters: int = 1,
         param_specs: Optional[PyTree] = None,
         batch_spec: Optional[PyTree] = None,
         donate: bool = True,
+        value_and_grad_fn: Optional[Callable] = None,
     ):
         """Build a jitted SPMD train step.
 
@@ -268,7 +269,22 @@ class DataParallel:
           replicated (TP composition); default replicated.
         - ``batch_spec``: per-leaf PartitionSpec for the batch; default sharded
           on dim 0 over the data axis.
+        - ``value_and_grad_fn(params, batch) -> (loss, grads)``: supply the
+          loss AND grads directly instead of ``loss_fn`` — for schedules whose
+          backward cannot be expressed as outer AD, e.g. the 1F1B pipeline
+          (``pipeline_parallel.pipeline_1f1b`` / ``gpt_pipeline_1f1b``), whose
+          backward interleaves with its forward inside one scan.
         """
+        if (loss_fn is None) == (value_and_grad_fn is None):
+            raise ValueError("pass exactly one of loss_fn / value_and_grad_fn")
+        if optimizer is None:
+            raise ValueError("make_train_step requires an optax optimizer")
+        if value_and_grad_fn is not None and grad_accum_iters != 1:
+            raise ValueError(
+                "grad_accum_iters applies to the loss_fn path only; a "
+                "value_and_grad_fn (e.g. pipeline_1f1b) owns its own "
+                "microbatching"
+            )
         mesh = self.mesh
         axis = self.axis
         data_axes = (axis,) if isinstance(axis, str) else tuple(axis)
@@ -276,7 +292,10 @@ class DataParallel:
         def step(params, opt_state, batch):
             # Keep grads local over the data axes (one explicit reduce below).
             p_local = pvary_params(params, data_axes)
-            loss, grads = local_value_and_grad(loss_fn, p_local, batch, grad_accum_iters)
+            if value_and_grad_fn is not None:
+                loss, grads = value_and_grad_fn(p_local, batch)
+            else:
+                loss, grads = local_value_and_grad(loss_fn, p_local, batch, grad_accum_iters)
             grads, other = normalize_model_axis_grads(loss, grads, mesh, data_axes)
             grads = reduce_gradients(grads, axis, self.reduce_op, self.grad_reduce_overrides)
             if other:

@@ -11,8 +11,11 @@ from .pipeline_sched import (
     is_first_stage,
     is_last_stage,
     last_stage_value,
+    pipeline_1f1b,
     pipeline_forward,
     pipeline_loss,
+    ring_slots,
+    shift_left,
     shift_right,
     stage_index,
 )

@@ -73,6 +73,41 @@ def _stage_probe(stage_params, microbatches, stage_fn, pipe_axis):
     return zero_state, want_vma
 
 
+def _zeros_like_shapes(shapes):
+    """Zero pytree matching ShapeDtypeStructs (or values), reproducing vma."""
+    from ..data_parallel import _mark_varying
+
+    def z(a):
+        aval = a if isinstance(a, jax.ShapeDtypeStruct) else jax.typeof(a)
+        x = jnp.zeros(aval.shape, aval.dtype)
+        vm = tuple(getattr(aval, "vma", ()))
+        return _mark_varying(x, vm) if vm else x
+
+    return jax.tree.map(
+        z, shapes, is_leaf=lambda a: isinstance(a, jax.ShapeDtypeStruct)
+    )
+
+
+def _normalized_first_fn(first_fn, x_shape, want_vma):
+    """``(first_v, first_missing)``: ``first_v`` wraps ``first_fn`` to emit
+    the scan-carry vma; ``first_missing`` (static) lists the axes the
+    normalization must ADD.  If it contains the pipe axis, the added pvary's
+    transpose is a pipe psum — illegal inside a stage-gated cond, so callers
+    then run ``first_v`` unconditionally + select instead."""
+    from ..data_parallel import _mark_varying, _vma
+
+    first_missing = tuple(
+        a for a in want_vma if a not in frozenset(getattr(x_shape, "vma", frozenset()))
+    )
+
+    def first_v(p, mb):
+        o = first_fn(p, mb)
+        miss = tuple(a for a in want_vma if a not in _vma(o))
+        return _mark_varying(o, miss) if miss else o
+
+    return first_v, first_missing
+
+
 def stage_index(pipe_axis: str = PIPE_AXIS):
     return jax.lax.axis_index(pipe_axis)
 
@@ -100,6 +135,14 @@ def shift_right(x, pipe_axis: str = PIPE_AXIS):
     return jax.lax.ppermute(x, pipe_axis, [(i, i + 1) for i in range(n - 1)])
 
 
+def shift_left(x, pipe_axis: str = PIPE_AXIS):
+    """Send to the previous stage (non-circular): stage s's value arrives at
+    s-1; the last stage receives zeros.  The cotangent channel of the 1F1B
+    schedule — analogue of send_backward/recv_backward (comm.py:362-435)."""
+    n = jax.lax.axis_size(pipe_axis)
+    return jax.lax.ppermute(x, pipe_axis, [(i, i - 1) for i in range(1, n)])
+
+
 def _pipeline_scan(
     stage_params: PyTree,
     microbatches: jnp.ndarray,
@@ -109,6 +152,8 @@ def _pipeline_scan(
     remat: bool,
     make_acc: Callable,
     consume: Callable,
+    first_fn: Callable = None,
+    params: PyTree = None,
 ):
     """Shared fill -> steady -> drain scan driver for the pipelined schedules.
 
@@ -122,22 +167,58 @@ def _pipeline_scan(
     - ``consume(acc, y, m_idx, steady) -> acc`` folds in the stage output for
       completed microbatch ``m_idx``; ``steady`` is the traced ``t >= P-1``
       validity predicate.
+    - ``first_fn(params, mb) -> x`` (optional): stage-0 preprocessing (e.g.
+      token embedding) applied PER TICK inside the scan, so raw microbatch
+      inputs — not M pre-embedded activations — are what stays resident.
+      ``params`` is pipe-pvaried here so the embed cond-gates to stage 0 only
+      (its grad psum over pipe sits at the pvary transpose, outside the scan).
+      ``microbatches`` is then the raw-input pytree ``[M, ...]``.
     """
+    from ..data_parallel import pvary_params
+
     M = num_microbatches
     P_ = jax.lax.axis_size(pipe_axis)
     ticks = M + P_ - 1
     first = is_first_stage(pipe_axis)
     body_fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
-    zero_state, want_vma = _stage_probe(stage_params, microbatches, stage_fn, pipe_axis)
+    if first_fn is None:
+        zero_state, want_vma = _stage_probe(
+            stage_params, microbatches, stage_fn, pipe_axis
+        )
+        first_v, first_missing = None, ()
+    else:
+        # pipe-pvary so first_fn's output is pipe-varying -> stage-gated cond
+        # below is legal AND only stage 0 pays the embed FLOPs
+        params = pvary_params(params, (pipe_axis,))
+        mb0 = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, 0, axis=0, keepdims=False),
+            microbatches,
+        )
+        x_shape = jax.eval_shape(first_fn, params, mb0)
+        zero_state, want_vma = _stage_probe(
+            stage_params, _zeros_like_shapes(x_shape)[None], stage_fn, pipe_axis
+        )
+        first_v, first_missing = _normalized_first_fn(first_fn, x_shape, want_vma)
+
     acc0 = make_acc(zero_state, want_vma)
 
     def tick(carry, t):
         state, acc = carry
-        mb = jax.lax.dynamic_index_in_dim(
-            microbatches, jnp.minimum(t, M - 1), axis=0, keepdims=False
+        mb = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(
+                a, jnp.minimum(t, M - 1), axis=0, keepdims=False
+            ),
+            microbatches,
         )
-        x = jnp.where(first, mb, state)
+        if first_fn is None:
+            x = jnp.where(first, mb, state)
+        elif pipe_axis not in first_missing:
+            x = jax.lax.cond(
+                first, lambda op: first_v(params, op[0]), lambda op: op[1], (mb, state)
+            )
+        else:
+            x = jnp.where(first, first_v(params, mb), state)
         y = body_fn(stage_params, x)
         nxt = shift_right(y, pipe_axis)
         m_idx = jnp.maximum(t - (P_ - 1), 0)
@@ -156,6 +237,8 @@ def pipeline_forward(
     pipe_axis: str = PIPE_AXIS,
     remat: bool = True,
     collect_outputs: bool = True,
+    first_fn: Callable = None,
+    params: PyTree = None,
 ):
     """Run the pipelined forward inside shard_map.
 
@@ -193,7 +276,8 @@ def pipeline_forward(
         )
 
     return _pipeline_scan(
-        stage_params, microbatches, stage_fn, M, pipe_axis, remat, make_acc, consume
+        stage_params, microbatches, stage_fn, M, pipe_axis, remat, make_acc, consume,
+        first_fn=first_fn, params=params,
     )
 
 
@@ -206,6 +290,8 @@ def pipeline_loss(
     num_microbatches: int,
     pipe_axis: str = PIPE_AXIS,
     remat: bool = True,
+    first_fn: Callable = None,
+    params: PyTree = None,
 ) -> jnp.ndarray:
     """Pipelined forward + per-microbatch loss on the last stage, without
     materializing the output buffer.  Returns the mean loss, valid on every
@@ -213,6 +299,8 @@ def pipeline_loss(
 
     ``targets``: ``[M, mbs, ...]`` — read on the last stage only.
     ``loss_fn(y, target) -> scalar`` (mean over the microbatch).
+    ``first_fn(params, mb) -> x`` (optional): per-tick stage-0 preprocessing;
+    ``microbatches`` is then the raw input pytree (see ``_pipeline_scan``).
     """
     from ..data_parallel import _mark_varying, _vma
 
@@ -231,7 +319,255 @@ def pipeline_loss(
         return loss_sum + jnp.where(valid, mb_loss, 0.0)
 
     loss_sum = _pipeline_scan(
-        stage_params, microbatches, stage_fn, M, pipe_axis, remat, make_acc, consume
+        stage_params, microbatches, stage_fn, M, pipe_axis, remat, make_acc, consume,
+        first_fn=first_fn, params=params,
     )
     # broadcast from the last stage; grads flow back through the mask
     return jax.lax.psum(loss_sum, pipe_axis) / M
+
+
+# --------------------------------------------------------------------- 1F1B
+
+
+def ring_slots(num_microbatches: int, pipe_size: int) -> int:
+    """Stage-input slots the 1F1B schedule keeps live: ``min(M, 2P-1)``.
+
+    This is the schedule's memory guarantee — peak in-flight activations are
+    bounded by the pipeline depth, NOT the microbatch count (the property the
+    reference's steady-state 1F1B interleave exists for,
+    pipeline_parallel/pipeline_sched.py:163-211).  Stage s holds at most
+    ``2*(P-1-s)+1`` inputs; the SPMD program sizes the buffer for the worst
+    stage."""
+    return min(num_microbatches, 2 * pipe_size - 1)
+
+
+def pipeline_1f1b(
+    params: PyTree,
+    inputs: PyTree,
+    targets: PyTree,
+    first_fn: Callable[[PyTree, PyTree], jnp.ndarray],
+    stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
+    last_fn: Callable[[PyTree, jnp.ndarray, PyTree], jnp.ndarray],
+    num_microbatches: int,
+    pipe_axis: str = PIPE_AXIS,
+):
+    """One-forward-one-backward pipeline schedule: returns ``(loss, grads)``
+    directly (do NOT wrap in ``jax.grad`` — the backward pipeline runs inside).
+
+    The match for the reference's steady-state 1F1B interleave
+    (pipeline_parallel/pipeline_sched.py:163-211), rebuilt for SPMD/XLA: one
+    ``lax.scan`` over ``M + 2P - 2`` ticks where **every tick carries one
+    forward and one backward unit of work** —
+
+    - fwd: stage ``s`` runs microbatch ``m_f = t - s`` (fill wavefront), stage
+      0 sourcing it from ``first_fn(params, inputs[m_f])`` (embed), others
+      from the activation ``ppermute``-d in last tick; the stage INPUT is
+      saved in a ring buffer of :func:`ring_slots` slots.
+    - bwd: stage ``s`` runs microbatch ``m_b = t - 2(P-1) + s``: recompute the
+      stage from its saved input under ``jax.vjp`` (the remat), pull the
+      output cotangent from the next stage's ``shift_left`` (or, on the last
+      stage, from the vjp of ``last_fn``'s per-microbatch loss), accumulate
+      param grads, and send the input cotangent upstream.
+
+    Peak live activations are O(P) — independent of M — versus O(M) for AD
+    through :func:`pipeline_loss`'s forward scan (which must keep every tick's
+    carry for the reverse pass).  Total FLOPs are the same as remat-AD: fwd +
+    recompute + bwd per microbatch.
+
+    ``first_fn``/``last_fn`` take the FULL ``params`` pytree, so embedding and
+    head weights get their gradients here too (on their owning stage, then
+    psum-ed over ``pipe`` for every param leaf that is replicated across
+    stages — the explicit form of shard_map's transpose).
+
+    ``inputs``/``targets``: pytrees with leading dim ``M`` (raw microbatches;
+    read on the first / last stage respectively).  ``last_fn(params, y, tgt)``
+    returns the microbatch's mean loss.  Returns the mean loss over all M
+    (identical on every stage) and a grads pytree matching ``params``.
+    """
+    from ..data_parallel import _mark_varying, _vma, pvary_params
+
+    M = num_microbatches
+    P_ = jax.lax.axis_size(pipe_axis)
+    R = ring_slots(M, P_)
+    T = M + 2 * (P_ - 1)
+    s = jax.lax.axis_index(pipe_axis)
+    first = is_first_stage(pipe_axis)
+    last = is_last_stage(pipe_axis)
+
+    # Mark params pipe-varying so every vjp below yields LOCAL per-stage
+    # grads (no implicit psum inside the scan's conds, where a pipe
+    # collective would be illegal); the single explicit psum for
+    # pipe-replicated leaves happens once at the end (see ``sync``).
+    orig_params = params
+    params = pvary_params(params, (pipe_axis,))
+
+    take_mb = lambda tree, i: jax.tree.map(
+        lambda a: jax.lax.dynamic_index_in_dim(a, i, axis=0, keepdims=False), tree
+    )
+    mb0_in = take_mb(inputs, jnp.zeros((), jnp.int32))
+    mb0_tgt = take_mb(targets, jnp.zeros((), jnp.int32))
+
+    # ---- state aval fixed point (stage in/out shape + varying axes)
+    x_shape = jax.eval_shape(first_fn, params, mb0_in)
+    want_vma = frozenset(getattr(x_shape, "vma", frozenset())) | {pipe_axis}
+    zero_state = None
+    for _ in range(8):  # bounded by the number of mesh axes
+        zero_state = _zeros_like_shapes(x_shape)
+        missing = tuple(a for a in want_vma if a not in _vma(zero_state))
+        if missing:
+            zero_state = _mark_varying(zero_state, missing)
+        y_shape = jax.eval_shape(stage_fn, params, zero_state)
+        new_want = frozenset(getattr(y_shape, "vma", frozenset())) | want_vma
+        if new_want == want_vma:
+            break
+        want_vma = new_want
+    if y_shape.shape != x_shape.shape or y_shape.dtype != x_shape.dtype:
+        raise ValueError(
+            f"stage_fn must preserve activation shape/dtype for pipelining: "
+            f"{x_shape.shape}/{x_shape.dtype} -> {y_shape.shape}/{y_shape.dtype}"
+        )
+
+    # first_v normalizes first_fn's output vma; if that adds a PIPE marking
+    # (degenerate first_fn that ignores params, e.g. identity), its vjp
+    # contains a pipe psum and must run unconditionally each tick rather than
+    # inside the stage-gated cond.  Static, trace-time choice.
+    first_v, _first_missing = _normalized_first_fn(first_fn, x_shape, want_vma)
+    first_vjp_in_cond = pipe_axis not in _first_missing
+
+    # ---- one backward unit of work (runs under lax.cond when bwd is active)
+    def run_bwd(opers):
+        x_saved, cot_in, mb_tgt, mb_in = opers
+        y_, vjp_stage = jax.vjp(lambda p, xx: stage_fn(p, xx), params, x_saved)
+
+        def last_branch(op):
+            y_, mb_tgt, _ = op
+            loss_m, vjp_last = jax.vjp(
+                lambda p, yy: last_fn(p, yy, mb_tgt), params, y_
+            )
+            one = jnp.ones(jnp.shape(loss_m), jnp.result_type(loss_m))
+            miss = tuple(a for a in _vma(loss_m) if a not in _vma(one))
+            dp_last, g = vjp_last(_mark_varying(one, miss) if miss else one)
+            return loss_m, dp_last, g
+
+        last_shapes = jax.eval_shape(last_branch, (y_, mb_tgt, cot_in))
+
+        def mid_branch(op):
+            _, _, cot_in = op
+            zl, zp, _ = _zeros_like_shapes(last_shapes)
+            return zl, zp, cot_in
+
+        loss_m, dp_last, g = jax.lax.cond(
+            last, last_branch, mid_branch, (y_, mb_tgt, cot_in)
+        )
+
+        dp_stage, dx = vjp_stage(g)
+
+        if first_vjp_in_cond:
+            def first_branch(op):
+                mb_in, dx = op
+                _, vjp_first = jax.vjp(lambda p: first_v(p, mb_in), params)
+                (dp_first,) = vjp_first(dx)
+                return dp_first
+
+            first_shapes = jax.eval_shape(first_branch, (mb_in, dx))
+            dp_first = jax.lax.cond(
+                first,
+                first_branch,
+                lambda op: _zeros_like_shapes(first_shapes),
+                (mb_in, dx),
+            )
+            dp = jax.tree.map(lambda a, b, c: a + b + c, dp_stage, dp_last, dp_first)
+        else:
+            dp = jax.tree.map(lambda a, b: a + b, dp_stage, dp_last)
+        return loss_m, dp, dx
+
+    # ---- carry init (zeros with the right vma, via abstract eval)
+    saved0 = _zeros_like_shapes(
+        jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct((R,) + a.shape, a.dtype, vma=_vma(zero_state)),
+            jax.eval_shape(lambda z: z, zero_state),
+        )
+    )
+    cot0 = zero_state
+    bwd_shapes = jax.eval_shape(run_bwd, (zero_state, cot0, mb0_tgt, mb0_in))
+    # the loss accumulator inherits the TRUE loss aval's varying axes (e.g. a
+    # vocab-parallel CE has already psum-ed over 'tensor', so the loss must
+    # NOT be marked tensor-varying — downstream model-axis normalization keys
+    # on the loss vma)
+    loss0, grads0, _ = _zeros_like_shapes(bwd_shapes)
+
+    def tick(carry, t):
+        state, cot_state, saved_x, grads_acc, loss_sum = carry
+
+        # -------- forward unit
+        m_f = t - s
+        f_active = (m_f >= 0) & (m_f < M)
+        m_f_c = jnp.clip(m_f, 0, M - 1)
+        mb_in = take_mb(inputs, m_f_c)
+        x = jax.lax.cond(
+            first, lambda op: first_v(params, op[0]), lambda op: op[1], (mb_in, state)
+        )
+        y = stage_fn(params, x)
+        slot_f = jnp.mod(m_f_c, R)
+        saved_x = jax.lax.cond(
+            f_active,
+            lambda b: jax.lax.dynamic_update_index_in_dim(b, x, slot_f, axis=0),
+            lambda b: b,
+            saved_x,
+        )
+
+        # -------- backward unit
+        m_b = t - 2 * (P_ - 1) + s
+        b_active = (m_b >= 0) & (m_b < M)
+        m_b_c = jnp.clip(m_b, 0, M - 1)
+        x_saved = jax.lax.dynamic_index_in_dim(
+            saved_x, jnp.mod(m_b_c, R), axis=0, keepdims=False
+        )
+        mb_in_b = take_mb(inputs, m_b_c)
+        opers = (x_saved, cot_state, take_mb(targets, m_b_c), mb_in_b)
+        loss_m, dp, dx = jax.lax.cond(
+            b_active, run_bwd, lambda op: _zeros_like_shapes(bwd_shapes), opers
+        )
+
+        if not first_vjp_in_cond:
+            # degenerate first_fn (ignores params): its vjp contains a pipe
+            # psum (transpose of the vma normalization), so it must run
+            # unconditionally.  Mask the cotangent to stage 0's bwd window
+            # before, and the (pipe-replicated) grad after, so the final sync
+            # psum yields exactly stage 0's contribution.
+            gate = jnp.logical_and(first, b_active)
+            dxm = jax.tree.map(
+                lambda a: jnp.where(gate, a, jnp.zeros((), a.dtype)), dx
+            )
+            _, vjp_first = jax.vjp(lambda p: first_v(p, mb_in_b), params)
+            (dp_first,) = vjp_first(dxm)
+            dp_first = jax.tree.map(
+                lambda g: g * gate.astype(jnp.result_type(g)), dp_first
+            )
+            dp = jax.tree.map(jnp.add, dp, dp_first)
+
+        grads_acc = jax.tree.map(jnp.add, grads_acc, dp)
+        loss_sum = loss_sum + loss_m
+        return (shift_right(y), shift_left(dx), saved_x, grads_acc, loss_sum), None
+
+    (_, _, _, grads, loss_sum), _ = jax.lax.scan(
+        tick, (zero_state, cot0, saved0, grads0, loss0), jnp.arange(T)
+    )
+
+    # mean over microbatches; broadcast the last stage's loss everywhere
+    loss = jax.lax.psum(loss_sum, pipe_axis) / M
+    inv = 1.0 / M
+
+    # replicated-across-stages params (embed/head, anything not pipe-sharded)
+    # get contributions from their owning stage only — make every stage agree,
+    # the explicit form of shard_map's transpose-psum.
+    def sync(g, p):
+        g = g * inv if not isinstance(g, jax.ShapeDtypeStruct) else g
+        if pipe_axis in _vma(p):
+            return g
+        if pipe_axis in _vma(g):
+            return jax.lax.psum(g, pipe_axis)
+        return g
+
+    grads = jax.tree.map(lambda g, p: sync(g, p), grads, orig_params)
+    return loss, grads
