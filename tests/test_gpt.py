@@ -247,6 +247,67 @@ def test_gpt_1f1b_training_matches_serial(devices8, params):
     )
 
 
+def test_dropout_sharded_rng(devices8):
+    """The SURVEY §7 'per-axis sharded RNG' hard part, exercised in a real
+    model: with ``dropout_key = axis_unique_key(key, 'data')``, DATA shards
+    draw different dropout masks while TENSOR shards (replicated activations,
+    non-SP) draw identical ones — and dropout off is exactly deterministic."""
+    from torchdistpackage_tpu.parallel.data_parallel import _mark_varying
+    from torchdistpackage_tpu.utils import axis_unique_key
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, nheads=4, nlayers=2, max_seq=16, ffn_mult=2,
+        dropout_rate=0.5,
+    )
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    tpc.setup_process_groups([("data", 2), ("tensor", 2)], devices=devices8[:4])
+    mesh = tpc.get_view()
+    specs = gpt_param_specs(cfg, tp_axis="tensor")
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+    )
+    # IDENTICAL tokens on every data shard: any output difference across the
+    # data axis can only come from the dropout masks
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+
+    def fwd(p, toks):
+        key = axis_unique_key(jax.random.PRNGKey(7), "data")
+        h = gpt_embed(p, toks, "tensor")
+        from torchdistpackage_tpu.parallel.tensor_parallel import scan_blocks
+
+        h = scan_blocks(p["blocks"], h, cfg.block, "tensor", False, dropout_key=key)
+        # stack every device's local view: [data*tensor, B, S, D]
+        return _mark_varying(h[None], ("data", "tensor"))
+
+    from torchdistpackage_tpu.models.gpt import gpt_embed
+
+    out = jax.jit(
+        shard_map(
+            fwd,
+            mesh=mesh,
+            in_specs=(specs, P()),
+            out_specs=P(("data", "tensor")),
+        )
+    )(sharded, tokens)
+    out = np.asarray(out)  # rows: [d0t0, d0t1, d1t0, d1t1]
+    np.testing.assert_allclose(out[0], out[1], rtol=1e-5, atol=1e-6,
+                               err_msg="TP shards must agree on dropout masks")
+    np.testing.assert_allclose(out[2], out[3], rtol=1e-5, atol=1e-6,
+                               err_msg="TP shards must agree on dropout masks")
+    assert np.max(np.abs(out[0] - out[2])) > 1e-3, (
+        "data shards must draw DIFFERENT dropout masks"
+    )
+
+    # rate>0 but no key -> deterministic identity with the rate-0 model
+    logits_nokey = gpt_forward(params, tokens, cfg)
+    cfg0 = dataclasses.replace(cfg, dropout_rate=0.0)
+    np.testing.assert_allclose(
+        np.asarray(logits_nokey),
+        np.asarray(gpt_forward(params, tokens, cfg0)),
+        rtol=1e-6,
+    )
+
+
 def test_gpt_remat_grads_match():
     """Activation-checkpointed grads must equal un-checkpointed grads."""
     cfg = GPTConfig(vocab_size=64, dim=32, nheads=2, nlayers=3, max_seq=16,

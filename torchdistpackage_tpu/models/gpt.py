@@ -35,6 +35,7 @@ from ..parallel.tensor_parallel import (
     TransformerConfig,
     block_forward,
     block_param_specs,
+    scan_blocks,
     gather_from_sp,
     init_block_params,
     layer_norm,
@@ -55,6 +56,7 @@ class GPTConfig:
     causal: bool = True
     dtype: Any = jnp.float32
     attn_impl: str = "naive"  # 'naive' | 'flash' (Pallas kernel)
+    dropout_rate: float = 0.0  # residual dropout (needs a dropout_key)
 
     @property
     def block(self) -> TransformerConfig:
@@ -66,6 +68,7 @@ class GPTConfig:
             causal=self.causal,
             dtype=self.dtype,
             attn_impl=self.attn_impl,
+            dropout_rate=self.dropout_rate,
         )
 
     def num_params(self) -> int:
@@ -125,38 +128,6 @@ def vocab_parallel_xent(
 # -------------------------------------------------------------------- forward
 
 
-def _scan_blocks(
-    stacked: PyTree, x: jnp.ndarray, cfg: TransformerConfig, axis, sp,
-    remat: bool = False,
-):
-    from ..parallel.data_parallel import _mark_varying, _vma
-
-    # the carry's varying axes must cover the params' (e.g. pipe-sharded
-    # stacks make the block output pipe-varying even when x starts replicated)
-    want = _vma(x)
-    for leaf in jax.tree.leaves(stacked):
-        want = want | _vma(leaf)
-    missing = tuple(a for a in want if a not in _vma(x))
-    if missing:
-        x = _mark_varying(x, missing)
-
-    blk = lambda lp, h: block_forward(lp, h, cfg, axis=axis, sp=sp)
-    if remat:
-        # activation checkpointing: only block boundaries are saved; the
-        # backward recomputes each block, trading ~1 extra fwd for O(L) less
-        # activation HBM — enables 2-4x larger per-chip batch (bench.py uses
-        # this; place selectively via tools/profiler.py MB/ms ranking)
-        # prevent_cse=False: scan's loop structure already blocks CSE, so the
-        # default optimization barriers would only cost performance
-        blk = jax.checkpoint(blk, prevent_cse=False)
-
-    def body(h, lp):
-        return blk(lp, h), None
-
-    x, _ = jax.lax.scan(body, x, stacked)
-    return x
-
-
 def gpt_embed(params: Dict[str, PyTree], tokens: jnp.ndarray, axis: Optional[str] = None):
     """[B, S] ids -> [B, S, D] hidden (full sequence, replicated layout)."""
     S = tokens.shape[-1]
@@ -180,14 +151,22 @@ def gpt_forward(
     axis: Optional[str] = None,
     sp: bool = False,
     remat: bool = False,
+    dropout_key: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """tokens [B, S] -> logits [B, S, V_local].  Serial when ``axis`` is None,
     TP(/SP) inside shard_map otherwise.  ``remat`` checkpoints each block
-    (see :func:`_scan_blocks`)."""
+    (see :func:`..parallel.tensor_parallel.scan_blocks`).
+
+    ``dropout_key`` enables residual dropout at ``cfg.dropout_rate``; under a
+    mesh derive it with ``axis_unique_key(key, 'data')`` (utils/random.py) so
+    data shards draw distinct masks while TP shards stay consistent."""
     h = gpt_embed(params, tokens, axis)
     if axis is not None and sp:
         h = split_to_sp(h, axis)
-    h = _scan_blocks(params["blocks"], h, cfg.block, axis, sp, remat=remat)
+    h = scan_blocks(
+        params["blocks"], h, cfg.block, axis, sp, remat=remat,
+        dropout_key=dropout_key,
+    )
     return gpt_head(params, h, axis, sp)
 
 
@@ -198,10 +177,14 @@ def gpt_loss(
     axis: Optional[str] = None,
     sp: bool = False,
     remat: bool = False,
+    dropout_key: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """Mean next-token cross-entropy.  ``batch``: {'tokens': [B, S],
     'targets': [B, S]}."""
-    logits = gpt_forward(params, batch["tokens"], cfg, axis=axis, sp=sp, remat=remat)
+    logits = gpt_forward(
+        params, batch["tokens"], cfg, axis=axis, sp=sp, remat=remat,
+        dropout_key=dropout_key,
+    )
     return vocab_parallel_xent(logits, batch["targets"], axis)
 
 
@@ -239,7 +222,7 @@ def gpt_pipeline_loss(
         return h
 
     def stage_fn(stacked, x):
-        return _scan_blocks(stacked, x, cfg.block, tp_axis, sp)
+        return scan_blocks(stacked, x, cfg.block, tp_axis, sp)
 
     def mb_loss(y, tgt):
         logits = gpt_head(params, y, tp_axis, sp)
@@ -290,7 +273,7 @@ def gpt_pipeline_1f1b(
         return h
 
     def stage_fn(p, x):
-        return _scan_blocks(p["blocks"], x, cfg.block, tp_axis, sp, remat=remat)
+        return scan_blocks(p["blocks"], x, cfg.block, tp_axis, sp, remat=remat)
 
     def last_fn(p, y, tgt):
         logits = gpt_head(p, y, tp_axis, sp)
@@ -335,10 +318,9 @@ def gpt_param_specs(
     """PartitionSpec tree: vocab-sharded embedding/head over ``tp_axis``,
     block stack sharded over ``pipe_axis`` on the layer dim composed with the
     per-block TP specs."""
-    # block_param_specs handles tp_axis=None naturally (None entries == replicated)
-    bspecs = block_param_specs(tp_axis)
-    is_spec = lambda x: isinstance(x, P)
-    blocks = jax.tree.map(lambda s: P(pipe_axis, *tuple(s)), bspecs, is_leaf=is_spec)
+    from ..parallel.tensor_parallel import stacked_block_specs
+
+    blocks = stacked_block_specs(tp_axis, stack_axis=pipe_axis)
     return {
         "tok_emb": P(tp_axis, None) if tp_axis else P(),
         "pos_emb": P(),

@@ -44,6 +44,7 @@ class ViTConfig:
     ffn_mult: int = 4
     dtype: Any = jnp.float32
     attn_impl: str = "naive"
+    dropout_rate: float = 0.0  # residual dropout (needs a dropout_key)
 
     @property
     def num_patches(self) -> int:
@@ -59,7 +60,7 @@ class ViTConfig:
         return TransformerConfig(
             dim=self.dim, nheads=self.nheads, nlayers=self.nlayers,
             ffn_mult=self.ffn_mult, causal=False, dtype=self.dtype,
-            attn_impl=self.attn_impl,
+            attn_impl=self.attn_impl, dropout_rate=self.dropout_rate,
         )
 
 
@@ -103,10 +104,11 @@ def vit_forward(
     axis: Optional[str] = None,
     sp: bool = False,
     remat: bool = False,
+    dropout_key = None,
 ) -> jnp.ndarray:
     """[B, H, W, C] images -> [B, num_classes] logits.  TP(/SP) over ``axis``
     inside shard_map, serial when None — same contract as gpt_forward."""
-    from .gpt import _scan_blocks
+    from ..parallel.tensor_parallel import scan_blocks
 
     x = patchify(images.astype(cfg.dtype), cfg.patch_size)
     h = x @ params["patch_proj"]["w"] + params["patch_proj"]["b"]
@@ -115,7 +117,8 @@ def vit_forward(
         from ..parallel.tensor_parallel import split_to_sp
 
         h = split_to_sp(h, axis)
-    h = _scan_blocks(params["blocks"], h, cfg.block, axis, sp, remat=remat)
+    h = scan_blocks(params["blocks"], h, cfg.block, axis, sp, remat=remat,
+                    dropout_key=dropout_key)
     if axis is not None and sp:
         from ..parallel.tensor_parallel import gather_from_sp
 
@@ -132,13 +135,15 @@ def vit_loss(
     axis: Optional[str] = None,
     sp: bool = False,
     remat: bool = False,
+    dropout_key = None,
 ) -> jnp.ndarray:
     """Mean softmax cross-entropy.  ``batch``: {'images': [B,H,W,C],
     'labels': int [B]}.  Under TP the class dim of the head is sharded and
     the CE closes with the same collectives as the GPT vocab-parallel CE."""
     from .gpt import vocab_parallel_xent
 
-    logits = vit_forward(params, batch["images"], cfg, axis=axis, sp=sp, remat=remat)
+    logits = vit_forward(params, batch["images"], cfg, axis=axis, sp=sp,
+                         remat=remat, dropout_key=dropout_key)
     # static shape tells whether the head was class-sharded: a local shard is
     # narrower than num_classes (shapes are trace-time constants under XLA)
     tp = axis if logits.shape[-1] != cfg.num_classes else None

@@ -44,6 +44,16 @@ AxisName = Union[str, Tuple[str, ...]]
 PyTree = Any
 
 
+def sharding_cache_key(tree) -> tuple:
+    """Hashable cache key capturing each leaf's actual placement — two calls
+    with the same pytree STRUCTURE but different shardings (e.g. a spec tree
+    change between runs) must not reuse a compiled step built for the other."""
+    return tuple(
+        str(getattr(getattr(x, "sharding", None), "spec", None))
+        for x in jax.tree.leaves(tree)
+    )
+
+
 def _key_str(path) -> str:
     """'block1/w' style name for a tree path (for override matching)."""
     parts = []
@@ -317,6 +327,7 @@ class DataParallel:
                 jax.tree.structure(params),
                 jax.tree.structure(opt_state),
                 jax.tree.structure(batch),
+                sharding_cache_key((params, opt_state, batch)),
             )
             if key not in cache:
                 def spec_of(x):

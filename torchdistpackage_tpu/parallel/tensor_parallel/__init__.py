@@ -15,6 +15,8 @@ from .layers import (
     init_transformer_params,
     layer_norm,
     mlp_partial,
+    scan_blocks,
+    stacked_block_specs,
     transformer_forward,
     transformer_param_specs,
 )

@@ -48,6 +48,10 @@ class TransformerConfig:
     # 'naive' materializes the [S, S] score matrix; 'flash' uses the Pallas
     # blockwise kernel (ops/flash_attention.py) — preferred on TPU for long S
     attn_impl: str = "naive"
+    # residual dropout rate (after attention proj and after MLP); active only
+    # when a dropout key is threaded into the forward — see ``dropout`` and
+    # the per-axis key recipe in utils/random.py (axis_unique_key)
+    dropout_rate: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -114,6 +118,24 @@ def _close_row_parallel(
     return y + bias
 
 
+def dropout(
+    x: jnp.ndarray, rate: float, key: Optional[jax.Array]
+) -> jnp.ndarray:
+    """Inverted dropout; identity when ``key`` is None or ``rate`` is 0.
+
+    Sharding semantics under SPMD (the reference never had to solve this —
+    eager per-rank torch RNG diverges for free): the caller derives ``key``
+    with ``axis_unique_key`` (utils/random.py) so data shards draw different
+    masks while TP shards (which hold replicated activations in non-SP mode)
+    draw the SAME mask and stay consistent.  Under SP the activation is
+    seq-sharded, so each shard masking its own tokens IS the globally
+    consistent behavior (Megatron's sharded dropout states)."""
+    if key is None or rate == 0.0:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, jnp.shape(x))
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros((), x.dtype))
+
+
 # ---------------------------------------------------------------------- blocks
 
 
@@ -123,23 +145,28 @@ def block_forward(
     cfg: TransformerConfig,
     axis: Optional[str] = None,
     sp: bool = False,
+    dropout_key: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """Pre-LN transformer block (``ParallelBlock``, transformer.py:48-72):
     LN kept replicated and applied on the sequence shard; SP enters/leaves at
-    the attention/MLP boundaries.
+    the attention/MLP boundaries.  ``dropout_key`` activates residual dropout
+    at ``cfg.dropout_rate`` (distinct subkeys for the two sites).
 
     x: [B, S_local, D] when ``sp`` else [B, S, D]."""
+    k_attn = k_mlp = None
+    if dropout_key is not None and cfg.dropout_rate > 0.0:
+        k_attn, k_mlp = jax.random.split(dropout_key)
     h = layer_norm(x, p["ln1"])
     full = gather_from_sp(h, axis) if (axis and sp) else h
     y = attention_partial(p["attn"], full, cfg)
     y = _close_row_parallel(y, p["attn"]["bo"], axis, sp)
-    x = x + y
+    x = x + dropout(y, cfg.dropout_rate, k_attn)
 
     h = layer_norm(x, p["ln2"])
     full = gather_from_sp(h, axis) if (axis and sp) else h
     z = mlp_partial(p["mlp"], full)
     z = _close_row_parallel(z, p["mlp"]["b2"], axis, sp)
-    return x + z
+    return x + dropout(z, cfg.dropout_rate, k_mlp)
 
 
 def transformer_forward(
@@ -175,6 +202,7 @@ def scan_blocks(
     axis: Optional[str] = None,
     sp: bool = False,
     remat: bool = False,
+    dropout_key: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """Run ``x`` through a layer-stacked block tree with ``lax.scan`` (one
     compiled block body for L layers).  Shared by the GPT and ViT model
@@ -184,6 +212,9 @@ def scan_blocks(
     backward recomputes the block, trading ~1 extra fwd for O(L) less
     activation HBM — enables 2-4x larger per-chip batch (place selectively
     via tools/profiler.py MB/ms ranking).
+
+    ``dropout_key`` enables residual dropout (``cfg.dropout_rate``); each
+    layer folds its index into the key so layers draw distinct masks.
     """
     from ..data_parallel import _mark_varying, _vma
 
@@ -196,16 +227,26 @@ def scan_blocks(
     if missing:
         x = _mark_varying(x, missing)
 
-    blk = lambda lp, h: block_forward(lp, h, cfg, axis=axis, sp=sp)
+    def blk(lp, h, i):
+        k = (
+            jax.random.fold_in(dropout_key, i)
+            if dropout_key is not None
+            else None
+        )
+        return block_forward(lp, h, cfg, axis=axis, sp=sp, dropout_key=k)
+
     if remat:
         # prevent_cse=False: scan's loop structure already blocks CSE, so the
         # default optimization barriers would only cost performance
         blk = jax.checkpoint(blk, prevent_cse=False)
 
-    def body(h, lp):
-        return blk(lp, h), None
+    L = jax.tree.leaves(stacked)[0].shape[0]
 
-    x, _ = jax.lax.scan(body, x, stacked)
+    def body(h, xs):
+        lp, i = xs
+        return blk(lp, h, i), None
+
+    x, _ = jax.lax.scan(body, x, (stacked, jnp.arange(L)))
     return x
 
 

@@ -257,7 +257,13 @@ class ZeroOptimizer:
         cache = {}
 
         def jitted(params, state, batch):
-            key = (jax.tree.structure(params), jax.tree.structure(batch))
+            from .data_parallel import sharding_cache_key
+
+            key = (
+                jax.tree.structure(params),
+                jax.tree.structure(batch),
+                sharding_cache_key((params, state, batch)),
+            )
             if key not in cache:
                 p_specs, zero_specs, shard_dims = self._specs_for(params)
                 state_specs = {

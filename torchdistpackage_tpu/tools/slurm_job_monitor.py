@@ -97,13 +97,18 @@ def monitor_job(
             raise RuntimeError(
                 f"job failed {relaunches + 1} times (last state {state}); giving up"
             )
+        # one dead job consumes exactly one relaunch from the budget; a failed
+        # *submission* (transient sbatch/control-plane outage) retries below
+        # without consuming more — otherwise an outage while a job is down
+        # would burn the whole budget with zero real job failures.
         relaunches += 1
-        try:
-            job_id = launch_job(sbatch_script, *sbatch_args)
-        except (subprocess.CalledProcessError, OSError, RuntimeError) as e:
-            # transient sbatch failure: retry at the next poll tick
-            print(f"[slurm-monitor] relaunch failed ({e}); will retry")
-            continue
+        while True:
+            try:
+                job_id = launch_job(sbatch_script, *sbatch_args)
+                break
+            except (subprocess.CalledProcessError, OSError, RuntimeError) as e:
+                print(f"[slurm-monitor] relaunch submission failed ({e}); retrying")
+                time.sleep(poll_interval_s)
         print(f"[slurm-monitor] relaunched as job {job_id}")
 
 

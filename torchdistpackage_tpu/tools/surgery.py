@@ -8,7 +8,9 @@ kernels).
 TPU-native design: a JAX "module" is a param subtree + an apply function, so
 *surgery is a pytree transform*: :func:`replace_params` rewrites leaves (or
 whole subtrees) selected by a key-path predicate.  The int8 path needs no
-external CUDA kernels — the MXU multiplies int8 natively, and XLA fuses the
+external CUDA kernels — weights are stored int8 in HBM and upcast in-register
+on the way into the MXU (weight-only quantization: compute stays bf16/fp32;
+what int8 buys here is halved/quartered HBM weight traffic), and XLA fuses the
 dequant scale into the matmul epilogue:
 
 - :func:`quantize_int8` — symmetric per-output-channel weight quantization,
