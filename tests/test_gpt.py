@@ -2,6 +2,7 @@
 must match the serial model — the reference's golden-comparison discipline
 (SURVEY.md §4) applied to a full LM with vocab-parallel embedding/CE."""
 
+import dataclasses
 import functools
 
 import jax

@@ -120,6 +120,108 @@ def test_hybrid_zero(devices8):
         )
 
 
+def test_zero_1f1b_hybrid(devices8):
+    """North-star composition (VERDICT r2 item 3): hybrid ZeRO x 1F1B
+    pipeline x DP.  Mesh data=4 (hybrid intra=2) x pipe=2; the 1F1B schedule
+    supplies (loss, grads) via ``value_and_grad_fn`` and ZeRO scatters them
+    to ``data_intra`` owner shards — the reference's Bf16ZeroOptimizer under
+    PP+DP training (zero_optim.py:98-287 composed per Readme.md:56).
+    Trajectory must match serial Adam for 3 steps."""
+    from torchdistpackage_tpu.models import (
+        GPTConfig,
+        gpt_loss,
+        gpt_param_specs,
+        gpt_pipeline_1f1b,
+        init_gpt_params,
+    )
+
+    cfg = GPTConfig(vocab_size=64, dim=32, nheads=4, nlayers=4, max_seq=16, ffn_mult=2)
+    M, mbs, S = 4, 2, 16
+    tpc.setup_process_groups([("data", 4), ("pipe", 2)], devices=devices8)
+    view = tpc.build_hybrid_mesh(intra_size=2)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    specs = gpt_param_specs(cfg, tp_axis=None, pipe_axis="pipe")
+    opt = optax.adam(1e-2)
+
+    def vg_fn(p, batch):
+        return gpt_pipeline_1f1b(p, batch, cfg, num_microbatches=M)
+
+    zero = ZeroOptimizer(
+        opt,
+        mesh=view,
+        shard_axis="data_intra",
+        grad_reduce_axes=("data_inter", "data_intra"),
+        param_specs=specs,
+    )
+    zp = zero.place_params(params)
+    zs = zero.init(zp)
+    # a pipe-stacked block weight gets its master sharded over BOTH pipe
+    # (stage slab) and data_intra (zero shard)
+    wqkv_spec = zs["master"]["blocks"]["attn"]["wqkv"].sharding.spec
+    assert "pipe" in jax.tree.leaves(tuple(wqkv_spec)) or wqkv_spec[0] == "pipe"
+    assert any("data_intra" in (e if isinstance(e, tuple) else (e,))
+               for e in wqkv_spec if e is not None)
+    step = zero.make_train_step(
+        value_and_grad_fn=vg_fn,
+        batch_spec={
+            "tokens": P(None, ("data_inter", "data_intra")),
+            "targets": P(None, ("data_inter", "data_intra")),
+        },
+    )
+
+    sparams, sstate = params, opt.init(params)
+
+    def serial_loss(p, batch):
+        losses = [
+            gpt_loss(
+                p,
+                {"tokens": batch["tokens"][m], "targets": batch["targets"][m]},
+                cfg,
+            )
+            for m in range(M)
+        ]
+        return jnp.mean(jnp.stack(losses))
+
+    @jax.jit
+    def serial_step(p, s, b):
+        loss, g = jax.value_and_grad(serial_loss)(p, b)
+        u, s = opt.update(g, s, p)
+        return jax.tree.map(jnp.add, p, u), s, loss
+
+    from jax.sharding import NamedSharding
+
+    for i in range(3):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(30 + i))
+        batch = {
+            "tokens": jax.random.randint(k1, (M, mbs * 4, S), 0, cfg.vocab_size),
+            "targets": jax.random.randint(k2, (M, mbs * 4, S), 0, cfg.vocab_size),
+        }
+        sparams, sstate, sloss = serial_step(sparams, sstate, batch)
+        dbatch = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(view, P(None, ("data_inter", "data_intra")))
+            ),
+            batch,
+        )
+        zp, zs, dloss = step(zp, zs, dbatch)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
+
+    for name in ["tok_emb", "pos_emb", "head"]:
+        np.testing.assert_allclose(
+            np.asarray(zp[name]),
+            np.asarray(sparams[name]),
+            rtol=1e-3,
+            atol=1e-5,
+            err_msg=f"param divergence at {name}",
+        )
+    np.testing.assert_allclose(
+        np.asarray(zp["blocks"]["mlp"]["w1"]),
+        np.asarray(sparams["blocks"]["mlp"]["w1"]),
+        rtol=1e-3,
+        atol=1e-5,
+    )
+
+
 def test_zero_with_tp(devices8):
     """ZeRO over data axis composed with TP=2 sharded transformer params."""
     import functools

@@ -24,9 +24,9 @@ from jax.sharding import PartitionSpec as P
 from ..parallel.tensor_parallel import (
     TransformerConfig,
     block_forward,
-    block_param_specs,
     init_block_params,
     layer_norm,
+    stacked_block_specs,
 )
 
 PyTree = Any
@@ -155,9 +155,7 @@ def vit_param_specs(cfg: ViTConfig, tp_axis: Optional[str] = None) -> Dict[str, 
     with a leading None for the layer-stack dim; class-sharded head when the
     class count divides the TP size (else keep the head replicated by passing
     specs with ``head`` overridden to P())."""
-    bspecs = block_param_specs(tp_axis)
-    is_spec = lambda x: isinstance(x, P)
-    blocks = jax.tree.map(lambda s: P(None, *tuple(s)), bspecs, is_leaf=is_spec)
+    blocks = stacked_block_specs(tp_axis, stack_axis=None)
     head_w = P(None, tp_axis) if tp_axis else P()
     head_b = P(tp_axis) if tp_axis else P()
     return {

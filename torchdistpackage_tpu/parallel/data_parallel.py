@@ -54,6 +54,21 @@ def sharding_cache_key(tree) -> tuple:
     )
 
 
+def step_cache_key(*trees) -> tuple:
+    """Structure + shape/dtype + placement key for lazily-compiled train
+    steps — shared by DataParallel / ZeroOptimizer / FSDP so every step cache
+    keys on the same thing.  Shapes matter beyond structure: derived specs
+    (e.g. zero_partition_spec) depend on leaf shapes, so a same-structure
+    tree with different shapes must not reuse a compiled step."""
+    return tuple(jax.tree.structure(t) for t in trees) + (
+        tuple(
+            (jnp.shape(x), str(getattr(x, "dtype", type(x))))
+            for x in jax.tree.leaves(trees)
+        ),
+        sharding_cache_key(trees),
+    )
+
+
 def _key_str(path) -> str:
     """'block1/w' style name for a tree path (for override matching)."""
     parts = []
@@ -75,6 +90,10 @@ def _vma(x) -> frozenset:
 
 
 def _mark_varying(x, axes: Tuple[str, ...]):
+    # idempotent: pcast rejects varying->varying, so only mark what's missing
+    axes = tuple(a for a in axes if a not in _vma(x))
+    if not axes:
+        return x
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axes, to="varying")
     return jax.lax.pvary(x, axes)
@@ -84,11 +103,7 @@ def pvary_params(params: PyTree, axes: Tuple[str, ...]) -> PyTree:
     """Mark params varying over ``axes`` (where not already) so in-step AD
     yields local per-shard grads instead of implicitly psum-ing them."""
 
-    def mark(p):
-        missing = tuple(a for a in axes if a not in _vma(p))
-        return _mark_varying(p, missing) if missing else p
-
-    return jax.tree.map(mark, params)
+    return jax.tree.map(lambda p: _mark_varying(p, axes), params)
 
 
 def reduce_gradients(
@@ -323,12 +338,7 @@ class DataParallel:
         cache = {}
 
         def jitted(params, opt_state, batch):
-            key = (
-                jax.tree.structure(params),
-                jax.tree.structure(opt_state),
-                jax.tree.structure(batch),
-                sharding_cache_key((params, opt_state, batch)),
-            )
+            key = step_cache_key(params, opt_state, batch)
             if key not in cache:
                 def spec_of(x):
                     sh = getattr(x, "sharding", None)

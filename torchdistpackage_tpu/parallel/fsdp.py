@@ -79,6 +79,11 @@ class FSDP:
         fsdp2_offload_test.py:32-75 — one call, no per-block wrapping)."""
         specs = self.fsdp_specs(params, param_specs)
         self._specs = specs
+        # derived specs are a function of (base specs, leaf shapes): remember
+        # both so make_train_step's cached-spec reuse gates on the shapes and
+        # a forced re-derive keeps the same TP base instead of dropping it
+        self._base_specs = param_specs if param_specs is not None else self.param_specs
+        self._specs_shapes = jax.tree.map(lambda p: np.shape(p), params)
         return jax.tree.map(
             lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)), params, specs
         )
@@ -99,6 +104,14 @@ class FSDP:
         all-gathers and grad reduce-scatters and overlaps them with compute.
         """
         mesh = self.mesh
+        # snapshot the specs context NOW: a later shard_params call for a
+        # different tree must not clobber what this step derives specs from
+        cap_specs = getattr(self, "_specs", None) if param_specs is None else None
+        cap_shapes = getattr(self, "_specs_shapes", None)
+        cap_base = (
+            param_specs if param_specs is not None
+            else getattr(self, "_base_specs", None)
+        )
 
         def step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -111,15 +124,34 @@ class FSDP:
         compiled: dict = {}
 
         def jitted(params, opt_state, batch):
-            if "fn" not in compiled:
-                # explicit param_specs wins over any cached shard_params specs
+            from .data_parallel import step_cache_key
+
+            # keyed on structure + actual placement: a second call with a
+            # different params pytree or batch sharding must not silently
+            # reuse shardings derived from the first call's specs
+            key = step_cache_key(params, opt_state, batch)
+            if key not in compiled:
+                # explicit param_specs wins over any cached shard_params specs;
+                # cached specs are reused only for the SAME shapes they were
+                # derived from (fsdp_specs depends on leaf shapes — a
+                # same-structure different-shape tree would get wrong specs)
+                shapes = jax.tree.map(lambda p: jnp.shape(p), params)
                 if param_specs is not None:
                     specs = self.fsdp_specs(params, param_specs)
+                elif cap_specs is not None and cap_shapes == shapes:
+                    # the shard_params specs captured at step creation, for
+                    # the same shapes they were derived from
+                    specs = cap_specs
                 else:
-                    specs = getattr(self, "_specs", None)
-                    if specs is None:
+                    # re-derive, keeping the base (TP) specs this step was
+                    # created with — falling back to None would silently drop
+                    # the TP composition
+                    try:
+                        specs = self.fsdp_specs(params, cap_base)
+                    except Exception:
+                        # captured base belongs to a different tree shape —
+                        # derive from the instance default only
                         specs = self.fsdp_specs(params, None)
-                self._specs = specs
                 p_sh = jax.tree.map(
                     lambda s: NamedSharding(mesh, s), specs,
                     is_leaf=lambda x: isinstance(x, P),
@@ -131,13 +163,13 @@ class FSDP:
                 )
                 # opt state mirrors whatever sharding its leaves already
                 # carry; pin params so XLA cannot keep them gathered.
-                compiled["fn"] = jax.jit(
+                compiled[key] = jax.jit(
                     step,
                     in_shardings=(p_sh, None, b_sh),
                     out_shardings=(p_sh, None, None),
                     donate_argnums=(0, 1),
                 )
-            return compiled["fn"](params, opt_state, batch)
+            return compiled[key](params, opt_state, batch)
 
         return jitted
 

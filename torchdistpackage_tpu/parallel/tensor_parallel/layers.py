@@ -218,14 +218,17 @@ def scan_blocks(
     """
     from ..data_parallel import _mark_varying, _vma
 
-    # the carry's varying axes must cover the params' (e.g. pipe-sharded
-    # stacks make the block output pipe-varying even when x starts replicated)
+    # the carry's varying axes must cover every value entering the block body:
+    # the params' (e.g. pipe-sharded stacks make the block output pipe-varying
+    # even when x starts replicated) AND the dropout key's (an
+    # axis_unique_key-derived key makes the masks — hence the output —
+    # data-varying, and lax.scan requires a fixed carry type across steps)
     want = _vma(x)
     for leaf in jax.tree.leaves(stacked):
         want = want | _vma(leaf)
-    missing = tuple(a for a in want if a not in _vma(x))
-    if missing:
-        x = _mark_varying(x, missing)
+    if dropout_key is not None:
+        want = want | _vma(dropout_key)
+    x = _mark_varying(x, tuple(want))  # idempotent: only missing axes added
 
     def blk(lp, h, i):
         k = (

@@ -244,26 +244,41 @@ class ZeroOptimizer:
 
     def make_train_step(
         self,
-        loss_fn: Callable[[PyTree, PyTree], jnp.ndarray],
+        loss_fn: Optional[Callable[[PyTree, PyTree], jnp.ndarray]] = None,
         grad_accum_iters: int = 1,
         batch_spec: Optional[PyTree] = None,
         donate: bool = True,
+        value_and_grad_fn: Optional[Callable] = None,
     ):
         """Jitted SPMD train step with the ZeRO update.  ``loss_fn`` sees the
-        local batch shard, as in :class:`DataParallel`."""
+        local batch shard, as in :class:`DataParallel`.
+
+        ``value_and_grad_fn(params, batch) -> (loss, grads)`` replaces
+        ``loss_fn`` for schedules whose backward cannot be expressed as outer
+        AD — the 1F1B pipeline (``pipeline_parallel.pipeline_1f1b`` /
+        ``gpt_pipeline_1f1b``) interleaves its backward with its forward
+        inside one scan.  This is what makes the north-star composition
+        (hybrid ZeRO × 1F1B × TP × DP, the reference's zero_optim.py:98-287
+        under Readme.md:56's PP+DP recipe) buildable: the pipeline produces
+        the local grads, ZeRO scatters them to owner shards and updates the
+        sharded fp32 masters exactly as in the loss_fn path."""
+        if (loss_fn is None) == (value_and_grad_fn is None):
+            raise ValueError("pass exactly one of loss_fn / value_and_grad_fn")
+        if value_and_grad_fn is not None and grad_accum_iters != 1:
+            raise ValueError(
+                "grad_accum_iters applies to the loss_fn path only; a "
+                "value_and_grad_fn (e.g. pipeline_1f1b) owns its own "
+                "microbatching"
+            )
         mesh = self.mesh
         data_axes = self.grad_reduce_axes
 
         cache = {}
 
         def jitted(params, state, batch):
-            from .data_parallel import sharding_cache_key
+            from .data_parallel import step_cache_key
 
-            key = (
-                jax.tree.structure(params),
-                jax.tree.structure(batch),
-                sharding_cache_key((params, state, batch)),
-            )
+            key = step_cache_key(params, state, batch)
             if key not in cache:
                 p_specs, zero_specs, shard_dims = self._specs_for(params)
                 state_specs = {
@@ -279,9 +294,12 @@ class ZeroOptimizer:
                 def core(params, state, batch):
                     """shard_map body: local grads -> scatter -> shard update."""
                     p_local = pvary_params(params, data_axes)
-                    loss, grads = local_value_and_grad(
-                        loss_fn, p_local, batch, grad_accum_iters
-                    )
+                    if value_and_grad_fn is not None:
+                        loss, grads = value_and_grad_fn(p_local, batch)
+                    else:
+                        loss, grads = local_value_and_grad(
+                            loss_fn, p_local, batch, grad_accum_iters
+                        )
                     grads, other = normalize_model_axis_grads(
                         loss, grads, mesh, data_axes
                     )
