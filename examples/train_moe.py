@@ -1,0 +1,118 @@
+"""End-to-end example: train the MoE GPT with EP + MoE-DP (+ optional TP).
+
+The BASELINE.md MoE milestone: an 8-expert transformer trained with expert
+parallelism (experts sharded over the 'moe_ep' sub-axis, token dispatch via
+all_to_all) and MoE data parallelism (same-expert replicas average grads
+over 'moe_dp' only — the reference's MoEDP hook split,
+torchdistpackage/ddp/naive_ddp.py:233-441 + ddp/moe_dp.md — expressed here
+as a grad-reduce override).
+
+- real TPU chips:      python examples/train_moe.py
+- 8-device CPU sim:    TDP_CPU_SIM=8 python examples/train_moe.py
+"""
+
+import os
+
+if os.environ.get("TDP_CPU_SIM"):
+    n = os.environ["TDP_CPU_SIM"]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={n}"
+    )
+
+import jax
+
+if os.environ.get("TDP_CPU_SIM"):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchdistpackage_tpu import setup_distributed, tpc
+from torchdistpackage_tpu.models import (
+    GPTConfig,
+    gpt_moe_loss,
+    gpt_moe_param_specs,
+    init_gpt_moe_params,
+)
+from torchdistpackage_tpu.parallel import DataParallel
+from torchdistpackage_tpu.parallel.moe import moe_grad_reduce_overrides
+
+SMOKE = bool(os.environ.get("TDP_SMOKE"))
+
+
+def main():
+    setup_distributed()
+    ndev = len(jax.devices())
+    # all devices on the data axis; the moe view splits it into
+    # moe_dp x moe_ep with EP innermost (ICI-adjacent), the reference's
+    # contiguous-EP layout (process_topo.py:118-143)
+    tpc.setup_process_groups([("data", ndev)])
+    ep = min(4, ndev) if ndev > 1 else 1
+    tpc.build_moe_mesh(moe_ep_size=ep)
+    mesh = tpc.get_view("moe")
+
+    cfg = GPTConfig(
+        vocab_size=512,
+        dim=128,
+        nheads=4,
+        nlayers=4,
+        max_seq=256,
+        ffn_mult=2,
+        moe_experts=8,
+        moe_top_k=2,
+        moe_every=2,  # expert FFN on blocks 1 and 3
+        moe_aux_weight=1e-2,
+    )
+    steps = 3 if SMOKE else 20
+    B = max(8, ndev)
+
+    params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+    specs = gpt_moe_param_specs(cfg, tp_axis=None, ep_axis="moe_ep")
+    opt = optax.adam(1e-3)
+
+    dp = DataParallel(
+        mesh=mesh,
+        axis=("moe_dp", "moe_ep"),
+        grad_reduce_overrides=moe_grad_reduce_overrides(),
+    )
+    sharded = dp.broadcast_params(params, param_specs=specs)
+    state = opt.init(sharded)
+    step = dp.make_train_step(
+        lambda p, b: gpt_moe_loss(p, b, cfg, ep_axis="moe_ep"),
+        opt,
+        param_specs=specs,
+        batch_spec={
+            "tokens": P(("moe_dp", "moe_ep")),
+            "targets": P(("moe_dp", "moe_ep")),
+        },
+    )
+
+    bsh = NamedSharding(mesh, P(("moe_dp", "moe_ep")))
+    losses = []
+    for i in range(steps):
+        k1, _ = jax.random.split(jax.random.PRNGKey(100 + i))
+        tokens = jax.random.randint(k1, (B, cfg.max_seq), 0, cfg.vocab_size)
+        # copy task: target[i] = tokens[i-1] — needs attention through the
+        # expert blocks, so the loss decrease exercises real routing
+        targets = jnp.concatenate([tokens[:, :1], tokens[:, :-1]], axis=1)
+        batch = jax.device_put({"tokens": tokens, "targets": targets}, bsh)
+        sharded, state, loss = step(sharded, state, batch)
+        losses.append(float(loss))
+        print(f"step {i}: loss={losses[-1]:.4f}  (experts={cfg.moe_experts}, ep={ep})")
+
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], "training must reduce the loss"
+    # each device holds only num_experts/ep experts' weights
+    w1 = sharded["blocks"][1]["moe"]["experts"]["w1"]
+    local_experts = w1.addressable_shards[0].data.shape[0]
+    print(
+        f"trained {cfg.moe_experts}-expert MoE GPT over moe_dp={ndev//ep} x "
+        f"moe_ep={ep}: loss {losses[0]:.4f} -> {losses[-1]:.4f}; "
+        f"experts resident per device: {local_experts}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -180,6 +180,29 @@ def test_fsdp_step_recompute_keeps_tp_base(devices8):
     assert pa["w2"].sharding.spec == P("tensor", "data")
 
 
+def test_fsdp_step_created_before_shard_params(devices8):
+    """The step-then-shard order adopts the instance's base specs lazily:
+    make_train_step BEFORE shard_params(tp_specs) must still produce
+    TP-composed shardings at first call."""
+    tpc.setup_process_groups([("data", 4), ("tensor", 2)], devices=devices8)
+    opt = optax.sgd(1e-2)
+    fsdp = FSDP()
+    step = fsdp.make_train_step(
+        _loss, opt, batch_spec={"x": P("data"), "y": P("data")}
+    )
+    tp_specs = {"w1": P(None, "tensor"), "w2": P("tensor", None), "b": P(), "ln": P()}
+    params = fsdp.shard_params(_init_params(jax.random.PRNGKey(0)), tp_specs)
+    state = opt.init(params)
+    batch = jax.tree.map(
+        lambda a: jax.device_put(a, tpc.sharding("data")),
+        _make_batch(jax.random.PRNGKey(1)),
+    )
+    p2, s2, loss = step(params, state, batch)
+    assert np.isfinite(float(loss))
+    assert p2["w1"].sharding.spec == P("data", "tensor")
+    assert p2["w2"].sharding.spec == P("tensor", "data")
+
+
 def test_offload_roundtrip(devices8):
     tpc.setup_process_groups([("data", 8)], devices=devices8)
     fsdp = FSDP()

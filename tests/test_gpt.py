@@ -248,6 +248,167 @@ def test_gpt_1f1b_training_matches_serial(devices8, params):
     )
 
 
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_gpt_context_parallel_matches_serial(devices8, params, impl):
+    """Context parallelism wired into the MODEL family (VERDICT r2 item 4):
+    a GPT with ``attn_impl='ring'|'ulysses'`` + ``context_axis`` runs with
+    the sequence sharded over the context axis end-to-end (CP tokens in,
+    CP activations through every block, pos-emb at the shard's global
+    offset) and must match the serial model's loss AND grads."""
+    cp = 4
+    cfg_cp = dataclasses.replace(CFG, attn_impl=impl, context_axis="context")
+    tpc.setup_process_groups([("context", cp)], devices=devices8[:cp])
+    mesh = tpc.get_view()
+    batch = _data(jax.random.PRNGKey(1))
+
+    def cp_loss(p, b):
+        # loss is the mean over LOCAL tokens -> close with pmean over context
+        return jax.lax.pmean(gpt_loss(p, b, cfg_cp), "context")
+
+    bspec = {"tokens": P(None, "context"), "targets": P(None, "context")}
+    sm = shard_map(cp_loss, mesh=mesh, in_specs=(P(), bspec), out_specs=P())
+    got = jax.jit(sm)(params, batch)
+    want = gpt_loss(params, batch, CFG)
+    np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+
+    g_got = jax.jit(jax.grad(lambda p, b: sm(p, b)))(params, batch)
+    g_want = jax.grad(lambda p, b: gpt_loss(p, b, CFG))(params, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        g_got,
+        g_want,
+    )
+
+
+def test_gpt_ring_training_matches_serial(devices8, params):
+    """Train the ring-CP GPT over a data x context mesh with DataParallel
+    treating BOTH axes as data axes (grads pmean over data AND context);
+    two optimizer steps must track the serial model."""
+    cfg_cp = dataclasses.replace(CFG, attn_impl="ring", context_axis="context")
+    tpc.setup_process_groups([("data", 2), ("context", 4)], devices=devices8)
+    mesh = tpc.get_view()
+    opt = optax.adam(1e-2)
+
+    dp = DataParallel(mesh=mesh, axis=("data", "context"))
+    sharded = dp.broadcast_params(params)
+    state = opt.init(sharded)
+    step = dp.make_train_step(
+        lambda p, b: gpt_loss(p, b, cfg_cp),
+        opt,
+        batch_spec={"tokens": P("data", "context"), "targets": P("data", "context")},
+    )
+
+    sparams, sstate = params, opt.init(params)
+
+    @jax.jit
+    def serial_step(p, s, b):
+        loss, g = jax.value_and_grad(lambda p, b: gpt_loss(p, b, CFG))(p, b)
+        u, s = opt.update(g, s, p)
+        return jax.tree.map(jnp.add, p, u), s, loss
+
+    for i in range(2):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(40 + i))
+        batch = {
+            "tokens": jax.random.randint(k1, (B, S), 0, CFG.vocab_size),
+            "targets": jax.random.randint(k2, (B, S), 0, CFG.vocab_size),
+        }
+        sparams, sstate, sloss = serial_step(sparams, sstate, batch)
+        dbatch = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(mesh, P("data", "context"))
+            ),
+            batch,
+        )
+        sharded, state, dloss = step(sharded, state, dbatch)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
+
+    for name in ["tok_emb", "pos_emb", "head"]:
+        np.testing.assert_allclose(
+            np.asarray(sharded[name]),
+            np.asarray(sparams[name]),
+            rtol=1e-3,
+            atol=1e-5,
+            err_msg=f"param divergence at {name}",
+        )
+
+
+def test_gpt_1f1b_with_ring_cp_matches_serial(devices8, params):
+    """DP x PP x CP: the 1F1B pipeline with ring-attention stages — sequence
+    sharded over 'context' THROUGH the pipeline (stage 0 embeds local chunks
+    at their global offsets, every stage's blocks run ring attention over the
+    context ring, last stage's CE closes per-chunk) — must track serial."""
+    cfg_cp = dataclasses.replace(CFG, attn_impl="ring", context_axis="context")
+    M, mbs = 4, 2
+    tpc.setup_process_groups(
+        [("data", 2), ("pipe", 2), ("context", 2)], devices=devices8
+    )
+    mesh = tpc.get_view()
+    specs = gpt_param_specs(CFG, tp_axis=None, pipe_axis="pipe")
+
+    def vg_fn(p, batch):
+        return gpt_pipeline_1f1b(p, batch, cfg_cp, num_microbatches=M)
+
+    opt = optax.sgd(1e-1)
+    dp = DataParallel(mesh=mesh, axis=("data", "context"))
+    sharded = dp.broadcast_params(params, param_specs=specs)
+    state = opt.init(sharded)
+    step = dp.make_train_step(
+        value_and_grad_fn=vg_fn,
+        optimizer=opt,
+        param_specs=specs,
+        batch_spec={
+            "tokens": P(None, "data", "context"),
+            "targets": P(None, "data", "context"),
+        },
+    )
+
+    sparams, sstate = params, opt.init(params)
+
+    def serial_loss(p, batch):
+        losses = [
+            gpt_loss(
+                p,
+                {"tokens": batch["tokens"][m], "targets": batch["targets"][m]},
+                CFG,
+            )
+            for m in range(M)
+        ]
+        return jnp.mean(jnp.stack(losses))
+
+    @jax.jit
+    def serial_step(p, s, b):
+        loss, g = jax.value_and_grad(serial_loss)(p, b)
+        u, s = opt.update(g, s, p)
+        return jax.tree.map(jnp.add, p, u), s, loss
+
+    for i in range(2):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(70 + i))
+        batch = {
+            "tokens": jax.random.randint(k1, (M, mbs * 2, S), 0, CFG.vocab_size),
+            "targets": jax.random.randint(k2, (M, mbs * 2, S), 0, CFG.vocab_size),
+        }
+        sparams, sstate, sloss = serial_step(sparams, sstate, batch)
+        dbatch = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(mesh, P(None, "data", "context"))
+            ),
+            batch,
+        )
+        sharded, state, dloss = step(sharded, state, dbatch)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
+
+    for name in ["tok_emb", "pos_emb", "head"]:
+        np.testing.assert_allclose(
+            np.asarray(sharded[name]),
+            np.asarray(sparams[name]),
+            rtol=1e-4,
+            atol=1e-5,
+            err_msg=f"param divergence at {name}",
+        )
+
+
 def test_dropout_sharded_rng(devices8):
     """The SURVEY §7 'per-axis sharded RNG' hard part, exercised in a real
     model: with ``dropout_key = axis_unique_key(key, 'data')``, DATA shards

@@ -9,6 +9,15 @@ from .gpt import (
     vocab_parallel_embed,
     vocab_parallel_xent,
 )
+from .gpt_moe import (
+    gpt_moe_forward,
+    gpt_moe_loss,
+    gpt_moe_param_specs,
+    init_gpt_moe_params,
+    is_moe_block,
+    moe_block_forward,
+    moe_layer_config,
+)
 from .vit import (
     ViTConfig,
     init_vit_params,

@@ -55,8 +55,20 @@ class GPTConfig:
     ffn_mult: int = 4
     causal: bool = True
     dtype: Any = jnp.float32
-    attn_impl: str = "naive"  # 'naive' | 'flash' (Pallas kernel)
+    # 'naive' | 'flash' (Pallas kernel) | 'ring' | 'ulysses' (context
+    # parallel — sequence sharded over ``context_axis``, see ops/ring_attention)
+    attn_impl: str = "naive"
+    context_axis: Optional[str] = None  # mesh axis for 'ring'/'ulysses'
     dropout_rate: float = 0.0  # residual dropout (needs a dropout_key)
+    # Mixture-of-Experts (0 = dense model).  With ``moe_experts > 0`` every
+    # ``moe_every``-th block's FFN becomes an expert layer (Switch-style
+    # alternation); use the gpt_moe_* family (models/gpt_moe.py) which
+    # handles the heterogeneous block list and the aux load-balance loss.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
 
     @property
     def block(self) -> TransformerConfig:
@@ -68,6 +80,7 @@ class GPTConfig:
             causal=self.causal,
             dtype=self.dtype,
             attn_impl=self.attn_impl,
+            context_axis=self.context_axis,
             dropout_rate=self.dropout_rate,
         )
 
@@ -128,11 +141,22 @@ def vocab_parallel_xent(
 # -------------------------------------------------------------------- forward
 
 
-def gpt_embed(params: Dict[str, PyTree], tokens: jnp.ndarray, axis: Optional[str] = None):
-    """[B, S] ids -> [B, S, D] hidden (full sequence, replicated layout)."""
+def gpt_embed(
+    params: Dict[str, PyTree],
+    tokens: jnp.ndarray,
+    axis: Optional[str] = None,
+    context_axis: Optional[str] = None,
+):
+    """[B, S] ids -> [B, S, D] hidden.  With ``context_axis`` the tokens are
+    the context-LOCAL chunk [B, S/cp] (shard i owns global positions
+    [i*S_loc, (i+1)*S_loc)) and the position embedding is sliced at the
+    shard's global offset."""
     S = tokens.shape[-1]
     h = vocab_parallel_embed(params["tok_emb"], tokens, axis)
-    return h + params["pos_emb"][:S]
+    if context_axis is None:
+        return h + params["pos_emb"][:S]
+    off = jax.lax.axis_index(context_axis) * S
+    return h + jax.lax.dynamic_slice_in_dim(params["pos_emb"], off, S, axis=0)
 
 
 def gpt_head(params: Dict[str, PyTree], h: jnp.ndarray, axis: Optional[str] = None, sp: bool = False):
@@ -159,8 +183,17 @@ def gpt_forward(
 
     ``dropout_key`` enables residual dropout at ``cfg.dropout_rate``; under a
     mesh derive it with ``axis_unique_key(key, 'data')`` (utils/random.py) so
-    data shards draw distinct masks while TP shards stay consistent."""
-    h = gpt_embed(params, tokens, axis)
+    data shards draw distinct masks while TP shards stay consistent.
+
+    Context parallelism (``cfg.attn_impl`` 'ring'/'ulysses' +
+    ``cfg.context_axis``): pass the context-LOCAL token chunk [B, S/cp]
+    (in_spec ``P(None, context_axis)``); activations stay sequence-sharded
+    end-to-end and only the attention op communicates over the context ring.
+    The mean CE over local tokens then needs a ``pmean`` over the context
+    axis, which the train step performs when the context axis is included in
+    its data axes (the context axis IS a data axis for loss/grad purposes:
+    equal shards make the global mean the mean of shard means)."""
+    h = gpt_embed(params, tokens, axis, context_axis=cfg.context_axis)
     if axis is not None and sp:
         h = split_to_sp(h, axis)
     h = scan_blocks(
@@ -216,7 +249,7 @@ def gpt_pipeline_loss(
     tokens, targets = batch["tokens"], batch["targets"]
 
     def first_fn(p, toks):
-        h = gpt_embed(p, toks, tp_axis)
+        h = gpt_embed(p, toks, tp_axis, context_axis=cfg.context_axis)
         if tp_axis is not None and sp:
             h = split_to_sp(h, tp_axis)
         return h
@@ -267,7 +300,7 @@ def gpt_pipeline_1f1b(
     """
 
     def first_fn(p, toks):
-        h = gpt_embed(p, toks, tp_axis)
+        h = gpt_embed(p, toks, tp_axis, context_axis=cfg.context_axis)
         if tp_axis is not None and sp:
             h = split_to_sp(h, tp_axis)
         return h

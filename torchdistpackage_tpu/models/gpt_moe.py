@@ -1,0 +1,239 @@
+"""MoE GPT — the BASELINE.md MoE milestone: an N-expert transformer trained
+with EP (expert parallelism) + MoE-DP (replicated-expert data parallelism),
+optionally composed with TP(+SP).
+
+Reference capability being matched end-to-end: MoE-DP over a real MoE
+network — ``MoEDP``/``create_moe_dp_hooks``
+(torchdistpackage/ddp/naive_ddp.py:233-441 + ddp/moe_dp.md) over the
+``moe_dp``/``moe_ep`` groups (dist/process_topo.py:118-143), with the token
+dispatch the reference delegates to DeepSpeed/fastmoe forks
+(explore/moe/ds_fmoe_main.py:19-25) implemented natively here
+(parallel/moe.py: dense GShard dispatch + ``all_to_all`` over the EP axis).
+
+Design: every ``cfg.moe_every``-th block's FFN is an expert layer
+(Switch-style alternation); blocks are a heterogeneous Python LIST of
+per-block param dicts (dense blocks carry ``mlp``, MoE blocks ``moe``), so
+the forward unrolls the stack instead of ``lax.scan``-ing stacked params —
+the uniform-scan trick requires homogeneous layers.  Everything else (vocab-
+parallel embed/head/CE, TP/SP layout rules) is shared with the dense GPT.
+
+Training composition: the EP axis is a sub-axis of the data axis
+(``tpc.build_moe_mesh``), so the train step treats ('moe_dp', 'moe_ep') as
+its data axes and routes expert grads through
+``moe_grad_reduce_overrides`` — expert grads psum over ``moe_dp`` only
+(each EP shard owns different experts) with the full-data-group mean
+normalization that corrects the all_to_all transpose's EP overcount
+(parallel/data_parallel.py reduce_gradients docstring).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_forward,
+    moe_param_specs,
+)
+from ..parallel.tensor_parallel.layers import (
+    _close_row_parallel,
+    attention_partial,
+    block_forward,
+    block_param_specs,
+    dropout,
+    init_block_params,
+    layer_norm,
+)
+from ..parallel.tensor_parallel.tp_utils import gather_from_sp, split_to_sp
+from .gpt import (
+    GPTConfig,
+    gpt_embed,
+    gpt_head,
+    vocab_parallel_xent,
+)
+
+PyTree = Any
+
+
+def moe_layer_config(cfg: GPTConfig) -> MoEConfig:
+    """The MoEConfig for cfg's expert layers (ffn width = the dense FFN's)."""
+    return MoEConfig(
+        dim=cfg.dim,
+        ffn_dim=cfg.dim * cfg.ffn_mult,
+        num_experts=cfg.moe_experts,
+        top_k=cfg.moe_top_k,
+        capacity_factor=cfg.moe_capacity_factor,
+        aux_loss_weight=cfg.moe_aux_weight,
+        dtype=cfg.dtype,
+    )
+
+
+def is_moe_block(cfg: GPTConfig, i: int) -> bool:
+    """Block i carries an expert FFN: blocks moe_every-1, 2*moe_every-1, ...
+    (with moe_every=2 the odd blocks, the Switch placement)."""
+    return cfg.moe_experts > 0 and i % cfg.moe_every == cfg.moe_every - 1
+
+
+# -------------------------------------------------------------------- forward
+
+
+def moe_block_forward(
+    p: Dict[str, PyTree],
+    x: jnp.ndarray,
+    cfg: GPTConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    ep_axis: Optional[str] = None,
+    dropout_key: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pre-LN block whose FFN is the MoE layer.  Attention half is identical
+    to ``block_forward``; the MoE half runs on the gathered (full-seq) tokens
+    — expert params are replicated over ``tensor`` and EP-sharded over
+    ``ep_axis``, so every TP rank computes the identical expert output
+    (sliced back to the SP layout with a split, NOT a psum: there are no
+    partial sums to reduce).  Returns (y, aux_loss)."""
+    bcfg = cfg.block
+    mcfg = moe_layer_config(cfg)
+    k_attn = k_mlp = None
+    if dropout_key is not None and bcfg.dropout_rate > 0.0:
+        k_attn, k_mlp = jax.random.split(dropout_key)
+
+    h = layer_norm(x, p["ln1"])
+    full = gather_from_sp(h, axis) if (axis and sp) else h
+    y = attention_partial(p["attn"], full, bcfg)
+    y = _close_row_parallel(y, p["attn"]["bo"], axis, sp)
+    x = x + dropout(y, bcfg.dropout_rate, k_attn)
+
+    h = layer_norm(x, p["ln2"])
+    full = gather_from_sp(h, axis) if (axis and sp) else h
+    z, aux = moe_forward(p["moe"], full, mcfg, ep_axis=ep_axis)
+    if axis and sp:
+        z = split_to_sp(z, axis)
+    return x + dropout(z, bcfg.dropout_rate, k_mlp), aux
+
+
+def gpt_moe_forward(
+    params: Dict[str, PyTree],
+    tokens: jnp.ndarray,
+    cfg: GPTConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    ep_axis: Optional[str] = None,
+    dropout_key: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens [B, S] -> (logits [B, S, V_local], mean aux loss over MoE
+    blocks).  ``params['blocks']`` is the heterogeneous per-block list from
+    :func:`init_gpt_moe_params`."""
+    h = gpt_embed(params, tokens, axis, context_axis=cfg.context_axis)
+    if axis is not None and sp:
+        h = split_to_sp(h, axis)
+    aux_total = jnp.zeros((), jnp.float32)
+    n_moe = 0
+    for i, bp in enumerate(params["blocks"]):
+        k = (
+            jax.random.fold_in(dropout_key, i)
+            if dropout_key is not None
+            else None
+        )
+        if is_moe_block(cfg, i):
+            h, aux = moe_block_forward(
+                bp, h, cfg, axis=axis, sp=sp, ep_axis=ep_axis, dropout_key=k
+            )
+            aux_total = aux_total + aux
+            n_moe += 1
+        else:
+            h = block_forward(bp, h, cfg.block, axis=axis, sp=sp, dropout_key=k)
+    aux_mean = aux_total / max(n_moe, 1)
+    return gpt_head(params, h, axis, sp), aux_mean
+
+
+def gpt_moe_loss(
+    params: Dict[str, PyTree],
+    batch: Dict[str, jnp.ndarray],
+    cfg: GPTConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    ep_axis: Optional[str] = None,
+    dropout_key: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Mean next-token CE + ``cfg.moe_aux_weight`` x mean load-balance aux
+    (the Switch recipe: aux summed into the task loss)."""
+    logits, aux = gpt_moe_forward(
+        params, batch["tokens"], cfg, axis=axis, sp=sp, ep_axis=ep_axis,
+        dropout_key=dropout_key,
+    )
+    ce = vocab_parallel_xent(logits, batch["targets"], axis)
+    return ce + cfg.moe_aux_weight * aux.astype(ce.dtype)
+
+
+# ----------------------------------------------------------------- init/specs
+
+
+def init_gpt_moe_params(key, cfg: GPTConfig) -> Dict[str, PyTree]:
+    """Like ``init_gpt_params`` but blocks are a LIST with MoE blocks'
+    ``mlp`` replaced by the expert layer params."""
+    assert cfg.moe_experts > 0, "use init_gpt_params for dense models"
+    ke, kp, kh, kb = jax.random.split(key, 4)
+    D, V, S = cfg.dim, cfg.vocab_size, cfg.max_seq
+    dt = cfg.dtype
+    mcfg = moe_layer_config(cfg)
+    blocks: List[Dict[str, PyTree]] = []
+    for i, k in enumerate(jax.random.split(kb, cfg.nlayers)):
+        bp = init_block_params(k, cfg.block)
+        if is_moe_block(cfg, i):
+            bp = {
+                "ln1": bp["ln1"],
+                "attn": bp["attn"],
+                "ln2": bp["ln2"],
+                "moe": init_moe_params(jax.random.fold_in(k, 1), mcfg),
+            }
+        blocks.append(bp)
+    return {
+        "tok_emb": (jax.random.normal(ke, (V, D)) * 0.02).astype(dt),
+        "pos_emb": (jax.random.normal(kp, (S, D)) * 0.02).astype(dt),
+        "blocks": blocks,
+        "ln_f": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
+        "head": (jax.random.normal(kh, (D, V)) * (1.0 / math.sqrt(D))).astype(dt),
+    }
+
+
+def gpt_moe_param_specs(
+    cfg: GPTConfig,
+    tp_axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
+) -> Dict[str, PyTree]:
+    """Per-block specs: dense blocks get the TP specs, MoE blocks the TP
+    attention specs + EP-sharded expert stacks (router replicated)."""
+    blocks = []
+    for i in range(cfg.nlayers):
+        bspec = block_param_specs(tp_axis)
+        if is_moe_block(cfg, i):
+            bspec = {
+                "ln1": bspec["ln1"],
+                "attn": bspec["attn"],
+                "ln2": bspec["ln2"],
+                "moe": moe_param_specs(ep_axis) if ep_axis else _replicated_moe_specs(),
+            }
+        blocks.append(bspec)
+    return {
+        "tok_emb": P(tp_axis, None) if tp_axis else P(),
+        "pos_emb": P(),
+        "blocks": blocks,
+        "ln_f": {"scale": P(), "bias": P()},
+        "head": P(None, tp_axis) if tp_axis else P(),
+    }
+
+
+def _replicated_moe_specs() -> Dict[str, PyTree]:
+    return {
+        "router": {"w": P()},
+        "experts": {
+            "w1": P(), "b1": P(), "w2": P(), "b2": P(),
+        },
+    }

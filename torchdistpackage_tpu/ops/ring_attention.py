@@ -103,12 +103,14 @@ def ring_attention(
     qpos = idx * S + jnp.arange(S)
 
     # accumulators are per-shard values: mark them varying over the ring axis
-    # so the scan carry type is stable
-    from ..parallel.data_parallel import _mark_varying
+    # AND every axis the inputs vary over (e.g. 'data' under a DP mesh), so
+    # the scan carry type matches the block-update outputs
+    from ..parallel.data_parallel import _mark_varying, _vma
 
-    m0 = _mark_varying(jnp.full((B, H, S, 1), NEG_INF, jnp.float32), (axis,))
-    l0 = _mark_varying(jnp.zeros((B, H, S, 1), jnp.float32), (axis,))
-    acc0 = _mark_varying(jnp.zeros((B, H, S, D), jnp.float32), (axis,))
+    vary = tuple(_vma(q) | _vma(k) | _vma(v) | {axis})
+    m0 = _mark_varying(jnp.full((B, H, S, 1), NEG_INF, jnp.float32), vary)
+    l0 = _mark_varying(jnp.zeros((B, H, S, 1), jnp.float32), vary)
+    acc0 = _mark_varying(jnp.zeros((B, H, S, D), jnp.float32), vary)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, t):
@@ -140,14 +142,16 @@ def ring_attention(
 def _ring_attention_flash(q, k, v, axis, causal, sm_scale, block_q, block_k):
     """Flash-kernel ring: per hop, one Pallas flash call over the KV shard in
     hand; hops combine exactly via logsumexp weights."""
-    from ..parallel.data_parallel import _mark_varying
+    from ..parallel.data_parallel import _mark_varying, _vma
 
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, H, S, D = q.shape
 
-    o0 = _mark_varying(jnp.zeros((B, H, S, D), jnp.float32), (axis,))
-    lse0 = _mark_varying(jnp.full((B, H, S), NEG_INF, jnp.float32), (axis,))
+    # carry must vary over the ring axis AND everything the inputs vary over
+    vary = tuple(_vma(q) | _vma(k) | _vma(v) | {axis})
+    o0 = _mark_varying(jnp.zeros((B, H, S, D), jnp.float32), vary)
+    lse0 = _mark_varying(jnp.full((B, H, S), NEG_INF, jnp.float32), vary)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def flash_hop(kc, vc, hop_causal):

@@ -79,11 +79,10 @@ class FSDP:
         fsdp2_offload_test.py:32-75 — one call, no per-block wrapping)."""
         specs = self.fsdp_specs(params, param_specs)
         self._specs = specs
-        # derived specs are a function of (base specs, leaf shapes): remember
-        # both so make_train_step's cached-spec reuse gates on the shapes and
-        # a forced re-derive keeps the same TP base instead of dropping it
+        # remember the BASE (TP) specs: make_train_step re-derives the full
+        # specs from (base, shapes), so the TP composition survives spec
+        # re-derivation for any tree
         self._base_specs = param_specs if param_specs is not None else self.param_specs
-        self._specs_shapes = jax.tree.map(lambda p: np.shape(p), params)
         return jax.tree.map(
             lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)), params, specs
         )
@@ -104,14 +103,15 @@ class FSDP:
         all-gathers and grad reduce-scatters and overlaps them with compute.
         """
         mesh = self.mesh
-        # snapshot the specs context NOW: a later shard_params call for a
-        # different tree must not clobber what this step derives specs from
-        cap_specs = getattr(self, "_specs", None) if param_specs is None else None
-        cap_shapes = getattr(self, "_specs_shapes", None)
+        # snapshot the base-specs context NOW so a later shard_params call
+        # for a different tree cannot clobber what this step derives specs
+        # from.  cap_base None (no shard_params yet) is adopted lazily at
+        # first call — the step-then-shard order keeps working.
         cap_base = (
             param_specs if param_specs is not None
             else getattr(self, "_base_specs", None)
         )
+        cap_was_empty = param_specs is None and cap_base is None
 
         def step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -131,25 +131,22 @@ class FSDP:
             # reuse shardings derived from the first call's specs
             key = step_cache_key(params, opt_state, batch)
             if key not in compiled:
-                # explicit param_specs wins over any cached shard_params specs;
-                # cached specs are reused only for the SAME shapes they were
-                # derived from (fsdp_specs depends on leaf shapes — a
-                # same-structure different-shape tree would get wrong specs)
-                shapes = jax.tree.map(lambda p: jnp.shape(p), params)
+                # derive specs from the base (TP) specs — a cheap
+                # deterministic function of (base, shapes) that reproduces
+                # shard_params' result exactly.  A step created BEFORE any
+                # shard_params adopts the instance's base lazily.
                 if param_specs is not None:
+                    # explicitly provided: errors must surface, not silently
+                    # degrade to an FSDP-only layout
                     specs = self.fsdp_specs(params, param_specs)
-                elif cap_specs is not None and cap_shapes == shapes:
-                    # the shard_params specs captured at step creation, for
-                    # the same shapes they were derived from
-                    specs = cap_specs
                 else:
-                    # re-derive, keeping the base (TP) specs this step was
-                    # created with — falling back to None would silently drop
-                    # the TP composition
+                    base = cap_base
+                    if cap_was_empty:
+                        base = getattr(self, "_base_specs", None)
                     try:
-                        specs = self.fsdp_specs(params, cap_base)
+                        specs = self.fsdp_specs(params, base)
                     except Exception:
-                        # captured base belongs to a different tree shape —
+                        # inherited base belongs to a different tree shape —
                         # derive from the instance default only
                         specs = self.fsdp_specs(params, None)
                 p_sh = jax.tree.map(

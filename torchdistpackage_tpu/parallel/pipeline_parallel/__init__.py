@@ -1,4 +1,5 @@
 from .pipeline_helper import (
+    balanced_stage_stack,
     flat_and_partition,
     param_count,
     partition_balanced,

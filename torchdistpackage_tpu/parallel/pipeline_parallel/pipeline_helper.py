@@ -113,6 +113,46 @@ def stack_stage_params(layer_params: List[PyTree]) -> PyTree:
     return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *layer_params)
 
 
+def balanced_stage_stack(
+    layer_params: List[PyTree],
+    weights: Sequence[float],
+    num_stages: int,
+) -> Tuple[PyTree, jnp.ndarray, List[Tuple[int, int]]]:
+    """Consume :func:`partition_balanced` in the scan-based SPMD pipeline:
+    assign layers to stages by balanced CONTIGUOUS bounds, pad every stage's
+    slab to the max stage length with zero layers, and return
+
+    - ``stacked``: [num_stages * max_len, ...] arrays — shard dim 0 over
+      'pipe' so each stage holds its (padded) slab,
+    - ``mask``: [num_stages, max_len] float32, 1 = real layer, 0 = padding —
+      inside a stage select the local row with
+      ``mask[jax.lax.axis_index(pipe_axis)]`` (a gather from a tiny
+      replicated constant) and hand it to ``scan_blocks(layer_mask=...)``,
+      whose ``lax.cond`` skips the padding layers' FLOPs and grads,
+    - ``bounds``: the [start, end) layer ranges per stage.
+
+    Padding layers are zero-initialized and receive zero grads (cond's
+    untaken branch), so they stay zero under any optimizer.  This realizes
+    the reference's param-balanced partitioner
+    (pipeline_helper.py:20-111) for pipelines whose stage slabs must be
+    equal-shaped for uniform 'pipe' sharding."""
+    if len(weights) != len(layer_params):
+        raise ValueError(
+            f"weights ({len(weights)}) and layer_params ({len(layer_params)}) "
+            f"must have one entry per layer"
+        )
+    bounds = partition_balanced([float(w) for w in weights], num_stages)
+    max_len = max(b - a for a, b in bounds)
+    zeros = jax.tree.map(jnp.zeros_like, layer_params[0])
+    slabs: List[PyTree] = []
+    mask = np.zeros((num_stages, max_len), np.float32)
+    for s, (a, b) in enumerate(bounds):
+        slabs.extend(layer_params[a:b])
+        slabs.extend([zeros] * (max_len - (b - a)))
+        mask[s, : b - a] = 1.0
+    return stack_stage_params(slabs), jnp.asarray(mask), bounds
+
+
 def unstack_stage_params(stacked: PyTree) -> List[PyTree]:
     n = jax.tree.leaves(stacked)[0].shape[0]
     return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]

@@ -525,9 +525,19 @@ def pipeline_1f1b(
         )
         mb_in_b = take_mb(inputs, m_b_c)
         opers = (x_saved, cot_state, take_mb(targets, m_b_c), mb_in_b)
-        loss_m, dp, dx = jax.lax.cond(
-            b_active, run_bwd, lambda op: _zeros_like_shapes(bwd_shapes), opers
-        )
+        # Run the bwd unit UNCONDITIONALLY and mask the accumulation, the
+        # same uniform-body rule the forward follows (line `y = stage_fn`
+        # above): ``b_active`` is pipe-varying, and a collective inside a
+        # branch-divergent cond is undefined — XLA's collective-permute in
+        # particular is a FULL-mesh rendezvous, so a ring-attention stage
+        # (ppermute over 'context') inside ``cond(b_active, ...)`` deadlocks
+        # or silently corrupts.  The extra recompute+bwd FLOPs are paid only
+        # on the 2(P-1) fill/drain ticks where b_active is false anyway.
+        loss_m, dp, dx = run_bwd(opers)
+        mask_b = lambda g: jnp.where(b_active, g, jnp.zeros((), g.dtype))
+        loss_m = mask_b(loss_m)
+        dp = jax.tree.map(mask_b, dp)
+        dx = jax.tree.map(mask_b, dx)
 
         if not first_vjp_in_cond:
             # degenerate first_fn (ignores params): its vjp contains a pipe

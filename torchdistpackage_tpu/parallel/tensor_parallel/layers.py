@@ -46,8 +46,18 @@ class TransformerConfig:
     causal: bool = True
     dtype: Any = jnp.float32
     # 'naive' materializes the [S, S] score matrix; 'flash' uses the Pallas
-    # blockwise kernel (ops/flash_attention.py) — preferred on TPU for long S
+    # blockwise kernel (ops/flash_attention.py) — preferred on TPU for long S;
+    # 'ring' / 'ulysses' are the context-parallel impls (ops/ring_attention.py):
+    # the sequence stays sharded over ``context_axis`` and KV shards rotate
+    # around the ICI ring (ring) or heads scatter via all_to_all (ulysses).
+    # Serial (context_axis=None) they fall back to the reference math, so one
+    # config runs both the golden and the distributed path.
     attn_impl: str = "naive"
+    # mesh axis the sequence is sharded over for 'ring'/'ulysses'; composes
+    # orthogonally with TP(+SP): TP splits heads, SP shards the context-LOCAL
+    # chunk over the tensor axis between blocks, CP shards the global
+    # sequence over this axis inside the attention op itself
+    context_axis: Optional[str] = None
     # residual dropout rate (after attention proj and after MLP); active only
     # when a dropout key is threaded into the forward — see ``dropout`` and
     # the per-axis key recipe in utils/random.py (axis_unique_key)
@@ -78,7 +88,10 @@ def attention_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: Transforme
     row-parallel region.  Mirrors ``TpAttention`` (attn.py:53-91) where each
     rank computes ``num_heads // tp_size`` heads.
 
-    x: [B, S, D] (full sequence).  p['wqkv']: [3, D, H_loc * hd]."""
+    x: [B, S, D] — the full sequence, or under context parallelism
+    (attn_impl 'ring'/'ulysses') the context-LOCAL chunk [B, S/cp, D]: the
+    CP op itself sees the rest of the sequence via ppermute/all_to_all over
+    ``cfg.context_axis``.  p['wqkv']: [3, D, H_loc * hd]."""
     B, S, D = x.shape
     hd = cfg.head_dim
     h_loc = p["wqkv"].shape[-1] // hd
@@ -93,6 +106,14 @@ def attention_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: Transforme
         from ...ops.flash_attention import flash_attention
 
         out = flash_attention(q, k, v, causal=cfg.causal)
+    elif cfg.attn_impl == "ring":
+        from ...ops.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v, axis=cfg.context_axis, causal=cfg.causal)
+    elif cfg.attn_impl == "ulysses":
+        from ...ops.ring_attention import ulysses_attention
+
+        out = ulysses_attention(q, k, v, axis=cfg.context_axis, causal=cfg.causal)
     else:
         from ...ops.flash_attention import mha_reference
 
@@ -203,6 +224,7 @@ def scan_blocks(
     sp: bool = False,
     remat: bool = False,
     dropout_key: Optional[jax.Array] = None,
+    layer_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Run ``x`` through a layer-stacked block tree with ``lax.scan`` (one
     compiled block body for L layers).  Shared by the GPT and ViT model
@@ -215,6 +237,11 @@ def scan_blocks(
 
     ``dropout_key`` enables residual dropout (``cfg.dropout_rate``); each
     layer folds its index into the key so layers draw distinct masks.
+
+    ``layer_mask`` ([L] floats, 1=real 0=padding) supports UNEQUAL pipeline
+    stage loads via padded slabs (``pipeline_helper.balanced_stage_stack``):
+    padding layers are masked out with ``jnp.where`` — they contribute zero
+    grads, so zero-initialized padding params stay zero under any optimizer.
     """
     from ..data_parallel import _mark_varying, _vma
 
@@ -228,6 +255,8 @@ def scan_blocks(
         want = want | _vma(leaf)
     if dropout_key is not None:
         want = want | _vma(dropout_key)
+    if layer_mask is not None:
+        want = want | _vma(layer_mask)
     x = _mark_varying(x, tuple(want))  # idempotent: only missing axes added
 
     def blk(lp, h, i):
@@ -245,11 +274,26 @@ def scan_blocks(
 
     L = jax.tree.leaves(stacked)[0].shape[0]
 
-    def body(h, xs):
-        lp, i = xs
-        return blk(lp, h, i), None
+    if layer_mask is None:
+        def body(h, xs):
+            lp, i = xs
+            return blk(lp, h, i), None
 
-    x, _ = jax.lax.scan(body, x, (stacked, jnp.arange(L)))
+        x, _ = jax.lax.scan(body, x, (stacked, jnp.arange(L)))
+    else:
+        # jnp.where, NOT lax.cond: the mask differs across pipe stages, and a
+        # collective inside a branch-divergent cond is undefined (ppermute is
+        # a full-mesh rendezvous — see pipeline_1f1b's backward unit).  The
+        # padding layers' FLOPs are paid, but their params still get exactly
+        # zero grads (where's transpose routes the cotangent to the taken
+        # branch only), so zero-initialized padding stays zero.
+        def body(h, xs):
+            lp, i, m = xs
+            return jnp.where(m > 0, blk(lp, h, i), h), None
+
+        x, _ = jax.lax.scan(
+            body, x, (stacked, jnp.arange(L), layer_mask)
+        )
     return x
 
 
