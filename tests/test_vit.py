@@ -117,3 +117,38 @@ def test_vit_dp_training_converges(devices8):
         assert np.isclose(float(rl), float(fl), rtol=1e-4, atol=1e-5)
         losses.append(float(fl))
     assert losses[-1] < losses[0]
+
+
+def test_vit_ring_cp_matches_serial(devices8):
+    """ViT with non-causal ring context parallelism over the patch tokens
+    must match the serial model (forward + grads)."""
+    import dataclasses
+
+    cfg_cp = dataclasses.replace(CFG, attn_impl="ring", context_axis="context")
+    tpc.setup_process_groups([("context", 4)], devices=devices8[:4])
+    mesh = tpc.get_view()
+    params = init_vit_params(jax.random.PRNGKey(0), CFG)
+    batch = _batch(jax.random.PRNGKey(1))
+
+    def cp_loss(p, b):
+        return vit_loss(p, b, cfg_cp)
+
+    sm = shard_map(
+        cp_loss,
+        mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(), params), P()),
+        out_specs=P(),
+    )
+    got = jax.jit(sm)(params, batch)
+    want = vit_loss(params, batch, CFG)
+    np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+
+    g_got = jax.jit(jax.grad(lambda p, b: sm(p, b)))(params, batch)
+    g_want = jax.grad(lambda p, b: vit_loss(p, b, CFG))(params, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        g_got,
+        g_want,
+    )

@@ -70,6 +70,15 @@ class GPTConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
 
+    def __post_init__(self):
+        if self.context_axis is not None and self.attn_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"context_axis={self.context_axis!r} requires attn_impl "
+                f"'ring' or 'ulysses' (got {self.attn_impl!r}): a chunk-local "
+                f"attention with per-shard position offsets would be a "
+                f"silently different model"
+            )
+
     @property
     def block(self) -> TransformerConfig:
         return TransformerConfig(

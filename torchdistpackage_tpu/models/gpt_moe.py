@@ -185,14 +185,11 @@ def init_gpt_moe_params(key, cfg: GPTConfig) -> Dict[str, PyTree]:
     mcfg = moe_layer_config(cfg)
     blocks: List[Dict[str, PyTree]] = []
     for i, k in enumerate(jax.random.split(kb, cfg.nlayers)):
-        bp = init_block_params(k, cfg.block)
         if is_moe_block(cfg, i):
-            bp = {
-                "ln1": bp["ln1"],
-                "attn": bp["attn"],
-                "ln2": bp["ln2"],
-                "moe": init_moe_params(jax.random.fold_in(k, 1), mcfg),
-            }
+            bp = init_block_params(k, cfg.block, mlp=False)
+            bp["moe"] = init_moe_params(jax.random.fold_in(k, 1), mcfg)
+        else:
+            bp = init_block_params(k, cfg.block)
         blocks.append(bp)
     return {
         "tok_emb": (jax.random.normal(ke, (V, D)) * 0.02).astype(dt),
@@ -218,7 +215,8 @@ def gpt_moe_param_specs(
                 "ln1": bspec["ln1"],
                 "attn": bspec["attn"],
                 "ln2": bspec["ln2"],
-                "moe": moe_param_specs(ep_axis) if ep_axis else _replicated_moe_specs(),
+                # moe_param_specs(None) yields P(None, ...) == replicated
+                "moe": moe_param_specs(ep_axis),
             }
         blocks.append(bspec)
     return {
@@ -230,10 +228,3 @@ def gpt_moe_param_specs(
     }
 
 
-def _replicated_moe_specs() -> Dict[str, PyTree]:
-    return {
-        "router": {"w": P()},
-        "experts": {
-            "w1": P(), "b1": P(), "w2": P(), "b2": P(),
-        },
-    }

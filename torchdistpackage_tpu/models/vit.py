@@ -43,8 +43,18 @@ class ViTConfig:
     nlayers: int = 12
     ffn_mult: int = 4
     dtype: Any = jnp.float32
+    # 'naive' | 'flash' | 'ring' | 'ulysses' — ring/ulysses run non-causal
+    # context parallelism over ``context_axis`` (patch tokens sharded)
     attn_impl: str = "naive"
+    context_axis: Optional[str] = None
     dropout_rate: float = 0.0  # residual dropout (needs a dropout_key)
+
+    def __post_init__(self):
+        if self.context_axis is not None and self.attn_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"context_axis={self.context_axis!r} requires attn_impl "
+                f"'ring' or 'ulysses' (got {self.attn_impl!r})"
+            )
 
     @property
     def num_patches(self) -> int:
@@ -60,7 +70,8 @@ class ViTConfig:
         return TransformerConfig(
             dim=self.dim, nheads=self.nheads, nlayers=self.nlayers,
             ffn_mult=self.ffn_mult, causal=False, dtype=self.dtype,
-            attn_impl=self.attn_impl, dropout_rate=self.dropout_rate,
+            attn_impl=self.attn_impl, context_axis=self.context_axis,
+            dropout_rate=self.dropout_rate,
         )
 
 
@@ -111,8 +122,20 @@ def vit_forward(
     from ..parallel.tensor_parallel import scan_blocks
 
     x = patchify(images.astype(cfg.dtype), cfg.patch_size)
-    h = x @ params["patch_proj"]["w"] + params["patch_proj"]["b"]
-    h = h + params["pos_emb"]
+    cp = cfg.context_axis if cfg.attn_impl in ("ring", "ulysses") else None
+    if cp is not None:
+        # context parallelism: slice the LOCAL patch chunk before the
+        # projection so the [B, S, D] embed activation and its matmul are
+        # O(S/cp) per device (patchify itself is a free reshape); the
+        # (non-causal) ring/all_to_all inside the blocks sees the rest
+        s_loc = x.shape[1] // jax.lax.axis_size(cp)
+        off = jax.lax.axis_index(cp) * s_loc
+        x = jax.lax.dynamic_slice_in_dim(x, off, s_loc, axis=1)
+        h = x @ params["patch_proj"]["w"] + params["patch_proj"]["b"]
+        h = h + jax.lax.dynamic_slice_in_dim(params["pos_emb"], off, s_loc, axis=0)
+    else:
+        h = x @ params["patch_proj"]["w"] + params["patch_proj"]["b"]
+        h = h + params["pos_emb"]
     if axis is not None and sp:
         from ..parallel.tensor_parallel import split_to_sp
 
@@ -124,7 +147,9 @@ def vit_forward(
 
         h = gather_from_sp(h, axis)
     h = layer_norm(h, params["ln_f"])
-    pooled = jnp.mean(h, axis=1)  # mean-pool over patches
+    pooled = jnp.mean(h, axis=1)  # mean-pool over (local) patches
+    if cp is not None:
+        pooled = jax.lax.pmean(pooled, cp)  # equal chunks: mean of means
     return pooled @ params["head"]["w"] + params["head"]["b"]
 
 

@@ -78,7 +78,6 @@ class FSDP:
         """Place params with FSDP shardings (the ``fully_shard`` analogue,
         fsdp2_offload_test.py:32-75 — one call, no per-block wrapping)."""
         specs = self.fsdp_specs(params, param_specs)
-        self._specs = specs
         # remember the BASE (TP) specs: make_train_step re-derives the full
         # specs from (base, shapes), so the TP composition survives spec
         # re-derivation for any tree

@@ -311,12 +311,14 @@ def stacked_block_specs(
 # ------------------------------------------------------------------------ init
 
 
-def init_block_params(key, cfg: TransformerConfig) -> Dict[str, PyTree]:
+def init_block_params(key, cfg: TransformerConfig, mlp: bool = True) -> Dict[str, PyTree]:
+    """``mlp=False`` skips the dense FFN weights (the largest leaves) — for
+    callers that replace the FFN, e.g. MoE expert blocks."""
     kq, ko, k1, k2 = jax.random.split(key, 4)
     D, F = cfg.dim, cfg.ffn_dim
     s = 1.0 / math.sqrt(D)
     dt = cfg.dtype
-    return {
+    out = {
         "ln1": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
         "attn": {
             "wqkv": (jax.random.normal(kq, (3, D, D)) * s).astype(dt),
@@ -325,13 +327,15 @@ def init_block_params(key, cfg: TransformerConfig) -> Dict[str, PyTree]:
             "bo": jnp.zeros((D,), dt),
         },
         "ln2": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
-        "mlp": {
+    }
+    if mlp:
+        out["mlp"] = {
             "w1": (jax.random.normal(k1, (D, F)) * s).astype(dt),
             "b1": jnp.zeros((F,), dt),
             "w2": (jax.random.normal(k2, (F, D)) * (1.0 / math.sqrt(F))).astype(dt),
             "b2": jnp.zeros((D,), dt),
-        },
-    }
+        }
+    return out
 
 
 def init_transformer_params(key, cfg: TransformerConfig) -> Dict[str, PyTree]:
