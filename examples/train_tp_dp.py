@@ -58,16 +58,24 @@ def main():
     step = dp.make_train_step(loss_fn, opt, param_specs=specs, grad_accum_iters=2)
 
     B, S = 4 * max(1, ndev // tp), 32
-    key = jax.random.PRNGKey(1)
-    t0 = time.time()
-    for i in range(10):
-        key, kx, ky = jax.random.split(key, 3)
-        batch = dp.shard_batch(
-            {
+
+    def host_batches(n):
+        key = jax.random.PRNGKey(1)
+        for _ in range(n):
+            key, kx, ky = jax.random.split(key, 3)
+            yield {
                 "x": jax.random.normal(kx, (B, S, cfg.dim)),
                 "y": jax.random.normal(ky, (B, S, cfg.dim)),
             }
-        )
+
+    from jax.sharding import PartitionSpec as P
+
+    from torchdistpackage_tpu.utils import prefetch_to_sharding
+
+    t0 = time.time()
+    # double-buffered host->HBM transfers overlap the previous step's compute
+    batches = prefetch_to_sharding(host_batches(10), dp.mesh, P("data"))
+    for i, batch in enumerate(batches):
         params, opt_state, loss = step(params, opt_state, batch)
         if i in (0, 4, 9):
             print(f"iter {i}: loss={float(loss):.5f}")

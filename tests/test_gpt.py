@@ -484,3 +484,107 @@ def test_gpt_remat_grads_match():
     g1 = jax.jit(jax.grad(lambda p: gpt_loss(p, batch, cfg, remat=True)))(params)
     for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_streamed_head_loss_matches_full():
+    """The seq-chunked streaming CE equals the full-logits CE; a chunk that
+    doesn't divide S fails loudly (silent full-logits fallback would defeat
+    the memory contract)."""
+    params = init_gpt_params(jax.random.PRNGKey(0), CFG)
+    batch = _data(jax.random.PRNGKey(1))
+    full = gpt_loss(params, batch, CFG)
+    for chunk in (4, 8, 16):
+        got = gpt_loss(params, batch, CFG, xent_chunk=chunk)
+        np.testing.assert_allclose(float(got), float(full), rtol=1e-6)
+    with pytest.raises(ValueError, match="not divisible"):
+        gpt_loss(params, batch, CFG, xent_chunk=5)
+    # grads agree too
+    g_full = jax.grad(lambda p: gpt_loss(p, batch, CFG))(params)
+    g_chunk = jax.grad(lambda p: gpt_loss(p, batch, CFG, xent_chunk=8))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        g_chunk,
+        g_full,
+    )
+
+
+def test_gpt_1f1b_dropout(devices8, params):
+    """Dropout THROUGH the 1F1B pipeline: per-(stage, microbatch, layer)
+    masks via the schedule's microbatch-index threading; deterministic for a
+    fixed key (the bwd recompute replays the same chain), different for a
+    different key, and exactly the no-dropout path when the key is None."""
+    from torchdistpackage_tpu.utils import axis_unique_key
+
+    cfg_do = dataclasses.replace(CFG, dropout_rate=0.3)
+    M, mbs = 4, 2
+    tpc.setup_process_groups(
+        [("data", 2), ("pipe", 2), ("tensor", 2)], devices=devices8
+    )
+    mesh = tpc.get_view()
+    specs = gpt_param_specs(CFG, tp_axis="tensor", pipe_axis="pipe")
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+    )
+    bspec = {"tokens": P(None, "data"), "targets": P(None, "data")}
+
+    def vg(p, b, seed):
+        key = axis_unique_key(jax.random.PRNGKey(seed), "data")
+        loss, grads = gpt_pipeline_1f1b(
+            p, b, cfg_do, num_microbatches=M, tp_axis="tensor", sp=True,
+            dropout_key=key,
+        )
+        from torchdistpackage_tpu.parallel.data_parallel import _vma
+
+        axes = tuple(a for a in ("data",) if a in _vma(loss))
+        return (jax.lax.pmean(loss, axes) if axes else loss), grads
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(90))
+    batch = {
+        "tokens": jax.random.randint(k1, (M, mbs * 2, S), 0, CFG.vocab_size),
+        "targets": jax.random.randint(k2, (M, mbs * 2, S), 0, CFG.vocab_size),
+    }
+    dbatch = jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P(None, "data"))), batch
+    )
+
+    run = jax.jit(
+        shard_map(
+            vg, mesh=mesh, in_specs=(specs, bspec, P()), out_specs=(P(), specs)
+        ),
+        static_argnums=(),
+    )
+    l_a, g_a = run(sharded, dbatch, jnp.int32(0))
+    l_a2, _ = run(sharded, dbatch, jnp.int32(0))
+    l_b, _ = run(sharded, dbatch, jnp.int32(1))
+    assert np.isfinite(float(l_a))
+    np.testing.assert_allclose(float(l_a), float(l_a2), rtol=0, atol=0,
+                               err_msg="same key must be deterministic")
+    assert abs(float(l_a) - float(l_b)) > 1e-6, "different keys must differ"
+    for leaf in jax.tree.leaves(g_a):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_streamed_head_loss_under_dp(devices8, params):
+    """The streamed CE must work INSIDE shard_map with a data-sharded batch
+    (the scan carry closes over the data-varying vma) and match serial."""
+    tpc.setup_process_groups([("data", 4)], devices=devices8[:4])
+    mesh = tpc.get_view()
+    batch = _data(jax.random.PRNGKey(1))
+
+    def dp_loss(p, b):
+        return jax.lax.pmean(
+            gpt_loss(p, b, CFG, xent_chunk=8), "data"
+        )
+
+    got = jax.jit(
+        shard_map(
+            dp_loss,
+            mesh=mesh,
+            in_specs=(P(), {"tokens": P("data"), "targets": P("data")}),
+            out_specs=P(),
+        )
+    )(params, batch)
+    want = gpt_loss(params, batch, CFG)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)

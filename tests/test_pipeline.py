@@ -224,7 +224,7 @@ def _1f1b_value_and_grad(mesh, specs, M, pp=4):
     return vg
 
 
-@pytest.mark.parametrize("pp,m", [(2, 4), (4, 4), (4, 9)])
+@pytest.mark.parametrize("pp,m", [(2, 4), (4, 4), (4, 9), (4, 2)])
 def test_pipeline_1f1b_matches_serial(devices8, pp, m):
     """The 1F1B schedule's (loss, grads) must equal serial AD exactly —
     including M not divisible by / smaller than schedule-derived constants."""

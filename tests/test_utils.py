@@ -214,3 +214,32 @@ def test_checkpoint_moe_model_roundtrip(tmp_path, devices8):
         jax.device_get(sharded),
         jax.device_get(restored),
     )
+
+
+def test_prefetch_to_sharding(devices8):
+    """Batches come out device-resident with the requested sharding, in
+    order, for prefetch depths 0/1/2 (and > the iterator length)."""
+    import numpy as np
+
+    from torchdistpackage_tpu.utils import microbatch, prefetch_to_sharding
+
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    mesh = tpc.get_view()
+    batches = [
+        {"x": np.full((16, 4), i, np.float32), "y": np.arange(16) + i}
+        for i in range(5)
+    ]
+    for depth in (0, 1, 2, 7):
+        out = list(prefetch_to_sharding(batches, mesh, P("data"), prefetch=depth))
+        assert len(out) == 5
+        for i, b in enumerate(out):
+            assert b["x"].sharding.spec == P("data")
+            assert float(b["x"][0, 0]) == i  # order preserved
+            assert int(b["y"][0]) == i
+
+    mb = microbatch(batches[0], 4)
+    assert mb["x"].shape == (4, 4, 4)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="not divisible"):
+        microbatch(batches[0], 5)

@@ -23,6 +23,7 @@ TPU-first design decisions (vs the reference's torch modules):
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Dict, Optional
 
@@ -202,14 +203,76 @@ def gpt_forward(
     axis, which the train step performs when the context axis is included in
     its data axes (the context axis IS a data axis for loss/grad purposes:
     equal shards make the global mean the mean of shard means)."""
-    h = gpt_embed(params, tokens, axis, context_axis=cfg.context_axis)
-    if axis is not None and sp:
-        h = split_to_sp(h, axis)
-    h = scan_blocks(
-        params["blocks"], h, cfg.block, axis, sp, remat=remat,
+    h = gpt_hidden(
+        params, tokens, cfg, axis=axis, sp=sp, remat=remat,
         dropout_key=dropout_key,
     )
     return gpt_head(params, h, axis, sp)
+
+
+def gpt_hidden(
+    params: Dict[str, PyTree],
+    tokens: jnp.ndarray,
+    cfg: GPTConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    remat: bool = False,
+    dropout_key: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """tokens [B, S] -> post-blocks hidden [B, S(/tp if sp), D] — the shared
+    embed + block-stack body of :func:`gpt_forward` and the streamed-CE path
+    of :func:`gpt_loss` (one implementation, no drift)."""
+    h = gpt_embed(params, tokens, axis, context_axis=cfg.context_axis)
+    if axis is not None and sp:
+        h = split_to_sp(h, axis)
+    return scan_blocks(
+        params["blocks"], h, cfg.block, axis, sp, remat=remat,
+        dropout_key=dropout_key,
+    )
+
+
+def streamed_head_loss(
+    params: Dict[str, PyTree],
+    h: jnp.ndarray,
+    targets: jnp.ndarray,
+    axis: Optional[str] = None,
+    chunk: int = 256,
+) -> jnp.ndarray:
+    """Head + CE scanned over SEQUENCE chunks: the [B, S, V] logits are never
+    materialized — each scan step computes one [B, chunk, V] slab, reduces it
+    to its lse/target-logit, and discards it.  The serial/DP-mode analogue of
+    the vocab-parallel CE's memory win (for GPT-125M at S=2048, V=32k the
+    full logits are ~2 GB of HBM traffic per step).  Equal chunks, so the
+    mean of chunk means is the token mean.  ``h``: post-blocks hidden
+    [B, S, D] (pre final-LN)."""
+    h = layer_norm(h, params["ln_f"])
+    B, S, D = h.shape
+    if S % chunk != 0:
+        raise ValueError(
+            f"sequence length {S} not divisible by xent_chunk {chunk} — "
+            f"the fallback would materialize the full logits the caller "
+            f"opted out of"
+        )
+    n = S // chunk
+    hc = h.reshape(B, n, chunk, D).transpose(1, 0, 2, 3)  # [n, B, chunk, D]
+    tc = targets.reshape(B, n, chunk).transpose(1, 0, 2)
+
+    # checkpoint the body: without it, AD stacks each slab's softmax
+    # residuals to O(B*S*V) — exactly the memory this function avoids
+    @functools.partial(jax.checkpoint, prevent_cse=False)
+    def body(acc, xt):
+        hh, tt = xt
+        return acc + vocab_parallel_xent(hh @ params["head"], tt, axis), None
+
+    # the carry must be closed over the body's varying axes (DESIGN.md §2):
+    # under a DP mesh h/targets are data-varying, so the accumulator is too
+    from ..parallel.data_parallel import _mark_varying, _vma
+
+    acc0 = _mark_varying(
+        jnp.zeros((), jnp.float32), tuple(_vma(h) | _vma(targets))
+    )
+    total, _ = jax.lax.scan(body, acc0, (hc, tc))
+    return total / n
 
 
 def gpt_loss(
@@ -220,9 +283,22 @@ def gpt_loss(
     sp: bool = False,
     remat: bool = False,
     dropout_key: Optional[jax.Array] = None,
+    xent_chunk: Optional[int] = None,
 ) -> jnp.ndarray:
     """Mean next-token cross-entropy.  ``batch``: {'tokens': [B, S],
-    'targets': [B, S]}."""
+    'targets': [B, S]}.  ``xent_chunk`` streams the head+CE over sequence
+    chunks of that size instead of materializing full logits
+    (:func:`streamed_head_loss`)."""
+    if xent_chunk is not None:
+        h = gpt_hidden(
+            params, batch["tokens"], cfg, axis=axis, sp=sp, remat=remat,
+            dropout_key=dropout_key,
+        )
+        if axis is not None and sp:
+            h = gather_from_sp(h, axis)
+        return streamed_head_loss(
+            params, h, batch["targets"], axis, chunk=xent_chunk
+        )
     logits = gpt_forward(
         params, batch["tokens"], cfg, axis=axis, sp=sp, remat=remat,
         dropout_key=dropout_key,
@@ -293,6 +369,7 @@ def gpt_pipeline_1f1b(
     pipe_axis: str = "pipe",
     sp: bool = False,
     remat: bool = True,
+    dropout_key: Optional[jax.Array] = None,
 ):
     """1F1B-scheduled GPT training step core: returns ``(loss, grads)``
     directly (do NOT wrap in ``jax.grad`` — see
@@ -306,6 +383,14 @@ def gpt_pipeline_1f1b(
     psum-ed over ``pipe`` once at the end.
 
     ``batch``: {'tokens': [M, mbs, S], 'targets': [M, mbs, S]}.
+
+    ``dropout_key`` enables residual dropout through the pipeline: the key is
+    folded with the stage index and the microbatch index (the schedule hands
+    ``stage_fn`` the latter via ``stage_takes_mb``), and scan_blocks folds
+    the local layer index — so every (stage, microbatch, layer) draws a
+    distinct mask, and the 1F1B backward's recompute replays the exact same
+    chain deterministically.  Derive the key per the usual recipe
+    (``axis_unique_key(key, 'data')``) so data shards differ too.
     """
 
     def first_fn(p, toks):
@@ -314,8 +399,14 @@ def gpt_pipeline_1f1b(
             h = split_to_sp(h, tp_axis)
         return h
 
-    def stage_fn(p, x):
-        return scan_blocks(p["blocks"], x, cfg.block, tp_axis, sp, remat=remat)
+    def stage_fn(p, x, m):
+        k = None
+        if dropout_key is not None and cfg.dropout_rate > 0.0:
+            k = jax.random.fold_in(dropout_key, jax.lax.axis_index(pipe_axis))
+            k = jax.random.fold_in(k, m)
+        return scan_blocks(
+            p["blocks"], x, cfg.block, tp_axis, sp, remat=remat, dropout_key=k
+        )
 
     def last_fn(p, y, tgt):
         logits = gpt_head(p, y, tp_axis, sp)
@@ -330,6 +421,7 @@ def gpt_pipeline_1f1b(
         last_fn=last_fn,
         num_microbatches=num_microbatches,
         pipe_axis=pipe_axis,
+        stage_takes_mb=True,
     )
 
 

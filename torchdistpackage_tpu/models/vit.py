@@ -128,7 +128,13 @@ def vit_forward(
         # projection so the [B, S, D] embed activation and its matmul are
         # O(S/cp) per device (patchify itself is a free reshape); the
         # (non-causal) ring/all_to_all inside the blocks sees the rest
-        s_loc = x.shape[1] // jax.lax.axis_size(cp)
+        n_cp = jax.lax.axis_size(cp)
+        if x.shape[1] % n_cp != 0:
+            raise ValueError(
+                f"num_patches {x.shape[1]} not divisible by context-parallel "
+                f"size {n_cp} — trailing patches would be silently dropped"
+            )
+        s_loc = x.shape[1] // n_cp
         off = jax.lax.axis_index(cp) * s_loc
         x = jax.lax.dynamic_slice_in_dim(x, off, s_loc, axis=1)
         h = x @ params["patch_proj"]["w"] + params["patch_proj"]["b"]

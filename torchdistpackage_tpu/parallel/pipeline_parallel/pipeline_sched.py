@@ -350,6 +350,7 @@ def pipeline_1f1b(
     last_fn: Callable[[PyTree, jnp.ndarray, PyTree], jnp.ndarray],
     num_microbatches: int,
     pipe_axis: str = PIPE_AXIS,
+    stage_takes_mb: bool = False,
 ):
     """One-forward-one-backward pipeline schedule: returns ``(loss, grads)``
     directly (do NOT wrap in ``jax.grad`` — the backward pipeline runs inside).
@@ -401,6 +402,15 @@ def pipeline_1f1b(
     orig_params = params
     params = pvary_params(params, (pipe_axis,))
 
+    # ``stage_takes_mb``: stage_fn(params, x, m) also receives the microbatch
+    # index m (int32, < M) — for per-microbatch stage behavior such as
+    # dropout keys.  The bwd recompute replays the same m, so key-derived
+    # masks are identical between forward and recompute.
+    if stage_takes_mb:
+        call_stage = stage_fn
+    else:
+        call_stage = lambda p, x, m: stage_fn(p, x)
+
     take_mb = lambda tree, i: jax.tree.map(
         lambda a: jax.lax.dynamic_index_in_dim(a, i, axis=0, keepdims=False), tree
     )
@@ -416,7 +426,7 @@ def pipeline_1f1b(
         missing = tuple(a for a in want_vma if a not in _vma(zero_state))
         if missing:
             zero_state = _mark_varying(zero_state, missing)
-        y_shape = jax.eval_shape(stage_fn, params, zero_state)
+        y_shape = jax.eval_shape(call_stage, params, zero_state, jnp.zeros((), jnp.int32))
         new_want = frozenset(getattr(y_shape, "vma", frozenset())) | want_vma
         if new_want == want_vma:
             break
@@ -436,8 +446,10 @@ def pipeline_1f1b(
 
     # ---- one backward unit of work (runs under lax.cond when bwd is active)
     def run_bwd(opers):
-        x_saved, cot_in, mb_tgt, mb_in = opers
-        y_, vjp_stage = jax.vjp(lambda p, xx: stage_fn(p, xx), params, x_saved)
+        x_saved, cot_in, mb_tgt, mb_in, m_b = opers
+        y_, vjp_stage = jax.vjp(
+            lambda p, xx: call_stage(p, xx, m_b), params, x_saved
+        )
 
         def last_branch(op):
             y_, mb_tgt, _ = op
@@ -489,7 +501,9 @@ def pipeline_1f1b(
         )
     )
     cot0 = zero_state
-    bwd_shapes = jax.eval_shape(run_bwd, (zero_state, cot0, mb0_tgt, mb0_in))
+    bwd_shapes = jax.eval_shape(
+        run_bwd, (zero_state, cot0, mb0_tgt, mb0_in, jnp.zeros((), jnp.int32))
+    )
     # the loss accumulator inherits the TRUE loss aval's varying axes (e.g. a
     # vocab-parallel CE has already psum-ed over 'tensor', so the loss must
     # NOT be marked tensor-varying — downstream model-axis normalization keys
@@ -507,7 +521,7 @@ def pipeline_1f1b(
         x = jax.lax.cond(
             first, lambda op: first_v(params, op[0]), lambda op: op[1], (mb_in, state)
         )
-        y = stage_fn(params, x)
+        y = call_stage(params, x, m_f_c)
         slot_f = jnp.mod(m_f_c, R)
         saved_x = jax.lax.cond(
             f_active,
@@ -524,7 +538,7 @@ def pipeline_1f1b(
             saved_x, jnp.mod(m_b_c, R), axis=0, keepdims=False
         )
         mb_in_b = take_mb(inputs, m_b_c)
-        opers = (x_saved, cot_state, take_mb(targets, m_b_c), mb_in_b)
+        opers = (x_saved, cot_state, take_mb(targets, m_b_c), mb_in_b, m_b_c)
         # Run the bwd unit UNCONDITIONALLY and mask the accumulation, the
         # same uniform-body rule the forward follows (line `y = stage_fn`
         # above): ``b_active`` is pipe-varying, and a collective inside a

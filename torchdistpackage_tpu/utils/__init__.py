@@ -5,6 +5,7 @@ Analogue of the reference's ``utils.py`` (fix_rand + partition_params) and
 inf/nan probe, master-only print).
 """
 
+from .data import microbatch, prefetch_to_sharding, shard_batch
 from .random import fix_rand, axis_unique_key, per_axis_keys
 from .partition import partition_params
 from .logging import (

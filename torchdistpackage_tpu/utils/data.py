@@ -1,0 +1,77 @@
+"""Input pipeline helpers: host batches -> mesh-sharded device arrays with
+double buffering.
+
+The reference ships no input pipeline (its examples hand-roll
+``DummyClsDataset`` tensors, SURVEY §4); on TPU the equivalent concern is
+real: per-step ``device_put`` of the next batch should overlap with the
+current step's compute, or the step time grows by the host->HBM transfer.
+``prefetch_to_sharding`` keeps ``prefetch`` batches in flight — JAX's
+``device_put`` is async, so enqueueing N+1's transfer before N's result is
+consumed gives the overlap for free.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+
+def shard_batch(batch: PyTree, mesh: Mesh, spec: PyTree) -> PyTree:
+    """Place one host batch on the mesh.  ``spec`` is either a single
+    PartitionSpec applied to every leaf or a matching pytree of specs."""
+    if isinstance(spec, P):
+        sh = NamedSharding(mesh, spec)
+        return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        batch,
+        spec,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def prefetch_to_sharding(
+    it: Iterable[PyTree],
+    mesh: Mesh,
+    spec: PyTree,
+    prefetch: int = 2,
+) -> Iterator[PyTree]:
+    """Iterate device-resident batches, keeping ``prefetch`` transfers in
+    flight ahead of the consumer (the TPU analogue of a pinned-memory
+    prefetching dataloader).  ``prefetch=0`` degrades to plain per-step
+    placement."""
+    if prefetch <= 0:
+        for b in it:
+            yield shard_batch(b, mesh, spec)
+        return
+    it = iter(it)
+    buf: collections.deque = collections.deque()
+    for b in itertools.islice(it, prefetch):
+        buf.append(shard_batch(b, mesh, spec))
+    _end = object()  # unique sentinel: a None *batch* must not end the stream
+    while buf:
+        nxt = next(it, _end)
+        if nxt is not _end:
+            buf.append(shard_batch(nxt, mesh, spec))
+        yield buf.popleft()
+
+
+def microbatch(batch: PyTree, num_microbatches: int) -> PyTree:
+    """Reshape every leaf's leading dim B into [M, B/M] — the layout the
+    pipelined losses consume (``gpt_pipeline_1f1b``'s [M, mbs, ...])."""
+
+    def split(x):
+        b = x.shape[0]
+        if b % num_microbatches != 0:
+            raise ValueError(
+                f"batch dim {b} not divisible by num_microbatches {num_microbatches}"
+            )
+        return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+    return jax.tree.map(split, batch)
