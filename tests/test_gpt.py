@@ -588,3 +588,39 @@ def test_streamed_head_loss_under_dp(devices8, params):
     )(params, batch)
     want = gpt_loss(params, batch, CFG)
     np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+def test_gpt_zigzag_ring_matches_serial(devices8, params):
+    """Zigzag (load-balanced) ring CP through the full GPT: tokens/targets
+    host-permuted to the zigzag layout, pos-emb gathered at the owned
+    positions — loss AND grads must equal the serial model (the mean CE is
+    permutation-invariant)."""
+    from torchdistpackage_tpu.ops.ring_attention import zigzag_permute
+
+    cp = 4
+    cfg_zz = dataclasses.replace(
+        CFG, attn_impl="ring", context_axis="context", cp_layout="zigzag"
+    )
+    tpc.setup_process_groups([("context", cp)], devices=devices8[:cp])
+    mesh = tpc.get_view()
+    batch = _data(jax.random.PRNGKey(1))
+    zz_batch = jax.tree.map(lambda a: zigzag_permute(a, cp, seq_dim=1), batch)
+
+    def cp_loss(p, b):
+        return jax.lax.pmean(gpt_loss(p, b, cfg_zz), "context")
+
+    bspec = {"tokens": P(None, "context"), "targets": P(None, "context")}
+    sm = shard_map(cp_loss, mesh=mesh, in_specs=(P(), bspec), out_specs=P())
+    got = jax.jit(sm)(params, zz_batch)
+    want = gpt_loss(params, batch, CFG)
+    np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+
+    g_got = jax.jit(jax.grad(lambda p, b: sm(p, b)))(params, zz_batch)
+    g_want = jax.grad(lambda p, b: gpt_loss(p, b, CFG))(params, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        g_got,
+        g_want,
+    )

@@ -60,6 +60,7 @@ class GPTConfig:
     # parallel — sequence sharded over ``context_axis``, see ops/ring_attention)
     attn_impl: str = "naive"
     context_axis: Optional[str] = None  # mesh axis for 'ring'/'ulysses'
+    cp_layout: str = "contiguous"  # 'zigzag' balances causal ring FLOPs
     dropout_rate: float = 0.0  # residual dropout (needs a dropout_key)
     # Mixture-of-Experts (0 = dense model).  With ``moe_experts > 0`` every
     # ``moe_every``-th block's FFN becomes an expert layer (Switch-style
@@ -79,6 +80,11 @@ class GPTConfig:
                 f"attention with per-shard position offsets would be a "
                 f"silently different model"
             )
+        if self.cp_layout != "contiguous" and self.attn_impl != "ring":
+            raise ValueError(
+                f"cp_layout={self.cp_layout!r} applies to attn_impl='ring' "
+                f"only (got {self.attn_impl!r})"
+            )
 
     @property
     def block(self) -> TransformerConfig:
@@ -91,6 +97,7 @@ class GPTConfig:
             dtype=self.dtype,
             attn_impl=self.attn_impl,
             context_axis=self.context_axis,
+            cp_layout=self.cp_layout,
             dropout_rate=self.dropout_rate,
         )
 
@@ -156,15 +163,23 @@ def gpt_embed(
     tokens: jnp.ndarray,
     axis: Optional[str] = None,
     context_axis: Optional[str] = None,
+    cp_layout: str = "contiguous",
 ):
     """[B, S] ids -> [B, S, D] hidden.  With ``context_axis`` the tokens are
-    the context-LOCAL chunk [B, S/cp] (shard i owns global positions
-    [i*S_loc, (i+1)*S_loc)) and the position embedding is sliced at the
-    shard's global offset."""
+    the context-LOCAL chunk [B, S/cp] and the position embedding follows the
+    shard's global positions: contiguous (shard i owns
+    [i*S_loc, (i+1)*S_loc)) or zigzag (chunks i and 2n-1-i — gather the
+    owned rows)."""
     S = tokens.shape[-1]
     h = vocab_parallel_embed(params["tok_emb"], tokens, axis)
     if context_axis is None:
         return h + params["pos_emb"][:S]
+    if cp_layout == "zigzag":
+        from ..ops.ring_attention import zigzag_positions
+
+        n = jax.lax.axis_size(context_axis)
+        pos, _ = zigzag_positions(jax.lax.axis_index(context_axis), S, n)
+        return h + jnp.take(params["pos_emb"], pos, axis=0)
     off = jax.lax.axis_index(context_axis) * S
     return h + jax.lax.dynamic_slice_in_dim(params["pos_emb"], off, S, axis=0)
 
@@ -222,7 +237,7 @@ def gpt_hidden(
     """tokens [B, S] -> post-blocks hidden [B, S(/tp if sp), D] — the shared
     embed + block-stack body of :func:`gpt_forward` and the streamed-CE path
     of :func:`gpt_loss` (one implementation, no drift)."""
-    h = gpt_embed(params, tokens, axis, context_axis=cfg.context_axis)
+    h = gpt_embed(params, tokens, axis, context_axis=cfg.context_axis, cp_layout=cfg.cp_layout)
     if axis is not None and sp:
         h = split_to_sp(h, axis)
     return scan_blocks(
@@ -334,7 +349,7 @@ def gpt_pipeline_loss(
     tokens, targets = batch["tokens"], batch["targets"]
 
     def first_fn(p, toks):
-        h = gpt_embed(p, toks, tp_axis, context_axis=cfg.context_axis)
+        h = gpt_embed(p, toks, tp_axis, context_axis=cfg.context_axis, cp_layout=cfg.cp_layout)
         if tp_axis is not None and sp:
             h = split_to_sp(h, tp_axis)
         return h
@@ -394,7 +409,7 @@ def gpt_pipeline_1f1b(
     """
 
     def first_fn(p, toks):
-        h = gpt_embed(p, toks, tp_axis, context_axis=cfg.context_axis)
+        h = gpt_embed(p, toks, tp_axis, context_axis=cfg.context_axis, cp_layout=cfg.cp_layout)
         if tp_axis is not None and sp:
             h = split_to_sp(h, tp_axis)
         return h

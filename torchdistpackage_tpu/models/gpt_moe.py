@@ -130,7 +130,7 @@ def gpt_moe_forward(
     """tokens [B, S] -> (logits [B, S, V_local], mean aux loss over MoE
     blocks).  ``params['blocks']`` is the heterogeneous per-block list from
     :func:`init_gpt_moe_params`."""
-    h = gpt_embed(params, tokens, axis, context_axis=cfg.context_axis)
+    h = gpt_embed(params, tokens, axis, context_axis=cfg.context_axis, cp_layout=cfg.cp_layout)
     if axis is not None and sp:
         h = split_to_sp(h, axis)
     aux_total = jnp.zeros((), jnp.float32)

@@ -68,6 +68,60 @@ def _lse_combine(o, lse, o_j, lse_j):
     return o * w + o_j.astype(jnp.float32) * w_j, lse_new
 
 
+def zigzag_positions(shard_idx, s_local: int, n: int):
+    """Global token positions owned by ``shard_idx`` under the ZIGZAG layout:
+    the sequence is split into 2n contiguous chunks and shard i owns chunks
+    (i, 2n-1-i) — one early + one late, so every shard carries the same
+    amount of causal-attention work (the striped/zigzag load-balancing trick;
+    under the contiguous layout shard 0 skips almost every ring hop while
+    shard n-1 computes them all).  Returns ([s_local] positions,
+    (lo_start, hi_start))."""
+    if s_local % 2 != 0:
+        raise ValueError(
+            f"zigzag needs an even local sequence length, got {s_local}"
+        )
+    c = s_local // 2
+    lo = shard_idx * c
+    hi = (2 * n - 1 - shard_idx) * c
+    return jnp.concatenate([lo + jnp.arange(c), hi + jnp.arange(c)]), (lo, hi)
+
+
+def zigzag_permute(x: jnp.ndarray, n: int, seq_dim: int = 1) -> jnp.ndarray:
+    """Host-side layout change: reorder the sequence dim so that a contiguous
+    n-way split yields the zigzag ownership (shard i = chunks i and 2n-1-i).
+    Apply to tokens AND targets before sharding over the context axis; mean
+    losses are permutation-invariant so training is unaffected."""
+    S = x.shape[seq_dim]
+    if S % (2 * n) != 0:
+        raise ValueError(
+            f"sequence length {S} not divisible by 2*n = {2 * n} — trailing "
+            f"tokens would be silently dropped"
+        )
+    c = S // (2 * n)
+    idx = jnp.concatenate(
+        [jnp.concatenate([jnp.arange(i * c, (i + 1) * c),
+                          jnp.arange((2 * n - 1 - i) * c, (2 * n - i) * c)])
+         for i in range(n)]
+    )
+    return jnp.take(x, idx, axis=seq_dim)
+
+
+def zigzag_unpermute(x: jnp.ndarray, n: int, seq_dim: int = 1) -> jnp.ndarray:
+    """Inverse of :func:`zigzag_permute` (for inspecting outputs in natural
+    order)."""
+    S = x.shape[seq_dim]
+    if S % (2 * n) != 0:
+        raise ValueError(f"sequence length {S} not divisible by 2*n = {2 * n}")
+    c = S // (2 * n)
+    idx = jnp.concatenate(
+        [jnp.concatenate([jnp.arange(i * c, (i + 1) * c),
+                          jnp.arange((2 * n - 1 - i) * c, (2 * n - i) * c)])
+         for i in range(n)]
+    )
+    inv = jnp.argsort(idx)
+    return jnp.take(x, inv, axis=seq_dim)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -78,22 +132,44 @@ def ring_attention(
     use_flash: bool = True,
     block_q: int = 256,
     block_k: int = 512,
+    layout: str = "contiguous",
 ) -> jnp.ndarray:
     """Ring attention over the ``axis`` mesh ring.  [B, H, S_local, D] layout
-    with the global sequence sharded contiguously over the axis (shard i owns
-    positions [i*S_local, (i+1)*S_local)).
+    with the global sequence sharded over the axis either contiguously
+    (shard i owns positions [i*S_local, (i+1)*S_local)) or in the ZIGZAG
+    layout (``layout='zigzag'``: shard i owns chunks i and 2n-1-i of 2n —
+    see :func:`zigzag_positions`; prepare inputs with
+    :func:`zigzag_permute`).  Zigzag balances the causal FLOPs across the
+    ring: per hop every shard computes the same past/diagonal mix, so the
+    critical path is ~half the contiguous layout's at large cp.
 
     ``use_flash=True`` runs the Pallas flash kernel per ring hop and combines
-    hops via logsumexp (:func:`_lse_combine`); the shard alignment means each
-    hop is either the diagonal (standard causal flash), entirely in the past
-    (non-causal flash), or entirely in the future (skipped).
-    ``use_flash=False`` keeps the XLA einsum online-softmax update (golden /
-    debug path — materializes [S_loc, S_loc] scores per hop).
+    hops via logsumexp (:func:`_lse_combine`); shard alignment means each
+    hop (each half-pair under zigzag) is either the diagonal (causal flash),
+    entirely in the past (non-causal flash), or entirely in the future
+    (skipped).  ``use_flash=False`` keeps the XLA einsum online-softmax
+    update (golden / debug path — materializes [S_loc, S_loc] scores per
+    hop).
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring layout {layout!r}")
     if axis is None:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    if layout == "zigzag":
+        if not causal:
+            # zigzag only rebalances the causal triangle; non-causal work is
+            # already uniform
+            return ring_attention(
+                q, k, v, axis, causal=False, sm_scale=sm_scale,
+                use_flash=use_flash, block_q=block_q, block_k=block_k,
+            )
+        if use_flash:
+            return _ring_attention_zigzag_flash(
+                q, k, v, axis, sm_scale, block_q, block_k
+            )
+        return _ring_attention_zigzag_einsum(q, k, v, axis, sm_scale)
     if use_flash:
         return _ring_attention_flash(q, k, v, axis, causal, sm_scale, block_q, block_k)
 
@@ -191,6 +267,110 @@ def _ring_attention_flash(q, k, v, axis, causal, sm_scale, block_q, block_k):
 
     (o, lse, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
     return o.astype(q.dtype)
+
+
+def _ring_attention_zigzag_einsum(q, k, v, axis, sm_scale):
+    """Zigzag golden path: the online-softmax update takes ARBITRARY global
+    position arrays, so the only difference from the contiguous path is the
+    qpos/kpos bookkeeping (and no hop skipping — every hop carries a
+    balanced past/diagonal mix by construction)."""
+    from ..parallel.data_parallel import _mark_varying, _vma
+
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    B, H, S, D = q.shape
+    qpos, _ = zigzag_positions(idx, S, n)
+
+    vary = tuple(_vma(q) | _vma(k) | _vma(v) | {axis})
+    m0 = _mark_varying(jnp.full((B, H, S, 1), NEG_INF, jnp.float32), vary)
+    l0 = _mark_varying(jnp.zeros((B, H, S, 1), jnp.float32), vary)
+    acc0 = _mark_varying(jnp.zeros((B, H, S, D), jnp.float32), vary)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        m, l, acc, kc, vc = carry
+        src = (idx - t) % n
+        kpos, _ = zigzag_positions(src, S, n)
+        m, l, acc = _block_update(q, kc, vc, m, l, acc, qpos, kpos, True, sm_scale)
+        kc = jax.lax.ppermute(kc, axis, perm)
+        vc = jax.lax.ppermute(vc, axis, perm)
+        return (m, l, acc, kc, vc), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(n))
+    return (acc / l).astype(q.dtype)
+
+
+def _ring_attention_zigzag_flash(q, k, v, axis, sm_scale, block_q, block_k):
+    """Zigzag flash path: each shard's activation is two contiguous chunks
+    (lo = chunk idx, hi = chunk 2n-1-idx), so every (q-half, kv-half) pair
+    per hop is a pure relation — same chunk (diagonal causal flash), kv
+    entirely past (non-causal flash), or kv entirely future (skipped with
+    zero softmax mass) — and hops combine exactly via logsumexp.  Four
+    half-sized flash calls per hop; per-shard work is UNIFORM across the
+    ring (the point of zigzag)."""
+    from ..parallel.data_parallel import _mark_varying, _vma
+
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    B, H, S, D = q.shape
+    c = S // 2
+
+    vary = tuple(_vma(q) | _vma(k) | _vma(v) | {axis})
+    halves_q = (q[:, :, :c], q[:, :, c:])
+    q_starts = (idx * c, (2 * n - 1 - idx) * c)
+
+    o0 = tuple(
+        _mark_varying(jnp.zeros((B, H, c, D), jnp.float32), vary) for _ in range(2)
+    )
+    lse0 = tuple(
+        _mark_varying(jnp.full((B, H, c), NEG_INF, jnp.float32), vary)
+        for _ in range(2)
+    )
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def pair(qh, kh, vh, q_start, k_start):
+        """(o, lse) of one (q-half, kv-half) pair by chunk relation."""
+
+        def skip(op):
+            return qh * 0, jnp.float32(NEG_INF) + (qh[..., 0] * 0).astype(jnp.float32)
+
+        def diag(op):
+            return flash_attention_with_lse(
+                qh, op[0], op[1], causal=True, sm_scale=sm_scale,
+                block_q=block_q, block_k=block_k,
+            )
+
+        def past(op):
+            return flash_attention_with_lse(
+                qh, op[0], op[1], causal=False, sm_scale=sm_scale,
+                block_q=block_q, block_k=block_k,
+            )
+
+        # k_start > q_start -> 0 (future: skip), == -> 1 (diag), < -> 2 (past)
+        branch = (k_start <= q_start).astype(jnp.int32) + (
+            k_start < q_start
+        ).astype(jnp.int32)
+        return jax.lax.switch(branch, [skip, diag, past], (kh, vh))
+
+    def step(carry, t):
+        o, lse, kc, vc = carry
+        src = (idx - t) % n
+        k_starts = (src * c, (2 * n - 1 - src) * c)
+        o, lse = list(o), list(lse)
+        for qi in range(2):
+            for ki in range(2):
+                o_j, lse_j = pair(
+                    halves_q[qi], kc[:, :, ki * c:(ki + 1) * c],
+                    vc[:, :, ki * c:(ki + 1) * c],
+                    q_starts[qi], k_starts[ki],
+                )
+                o[qi], lse[qi] = _lse_combine(o[qi], lse[qi], o_j, lse_j)
+        kc = jax.lax.ppermute(kc, axis, perm)
+        vc = jax.lax.ppermute(vc, axis, perm)
+        return (tuple(o), tuple(lse), kc, vc), None
+
+    (o, _, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    return jnp.concatenate([o[0], o[1]], axis=2).astype(q.dtype)
 
 
 def ulysses_attention(

@@ -266,9 +266,12 @@ class DataParallel:
         )
 
     def shard_batch(self, batch: PyTree) -> PyTree:
-        """Shard every leaf's leading dim over the data axis."""
-        sh = NamedSharding(self.mesh, P(self.axis))
-        return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+        """Shard every leaf's leading dim over the data axis (delegates to
+        the general :func:`..utils.data.shard_batch` so the placement rule
+        exists once)."""
+        from ..utils.data import shard_batch
+
+        return shard_batch(batch, self.mesh, P(self.axis))
 
     # ------------------------------------------------------------ train step
 

@@ -58,6 +58,10 @@ class TransformerConfig:
     # chunk over the tensor axis between blocks, CP shards the global
     # sequence over this axis inside the attention op itself
     context_axis: Optional[str] = None
+    # 'contiguous' | 'zigzag' (ring only): zigzag balances the causal FLOPs
+    # across the ring — shard i owns chunks i and 2n-1-i; prepare batches
+    # with ops.ring_attention.zigzag_permute
+    cp_layout: str = "contiguous"
     # residual dropout rate (after attention proj and after MLP); active only
     # when a dropout key is threaded into the forward — see ``dropout`` and
     # the per-axis key recipe in utils/random.py (axis_unique_key)
@@ -109,7 +113,10 @@ def attention_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: Transforme
     elif cfg.attn_impl == "ring":
         from ...ops.ring_attention import ring_attention
 
-        out = ring_attention(q, k, v, axis=cfg.context_axis, causal=cfg.causal)
+        out = ring_attention(
+            q, k, v, axis=cfg.context_axis, causal=cfg.causal,
+            layout=cfg.cp_layout,
+        )
     elif cfg.attn_impl == "ulysses":
         from ...ops.ring_attention import ulysses_attention
 
