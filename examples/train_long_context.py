@@ -62,6 +62,10 @@ def main():
         ffn_mult=2,
         attn_impl="ring",
         context_axis="context",
+        # zigzag: shard i owns chunks i and 2n-1-i, so the causal FLOPs are
+        # balanced across the ring (no shard idles while the last one
+        # computes the whole triangle) — batches are host-permuted below
+        cp_layout="zigzag",
     )
     B = 2
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
@@ -87,7 +91,14 @@ def main():
         # broken), so the loss decrease actually validates the ring
         tokens = jax.random.randint(k1, (B, S), 0, cfg.vocab_size)
         targets = jnp.concatenate([tokens[:, :1], tokens[:, :-1]], axis=1)
-        batch = jax.device_put({"tokens": tokens, "targets": targets}, bsh)
+        # same permutation for tokens and targets; the mean CE is invariant
+        from torchdistpackage_tpu.ops.ring_attention import zigzag_permute
+
+        batch = {
+            "tokens": zigzag_permute(tokens, ndev, seq_dim=1),
+            "targets": zigzag_permute(targets, ndev, seq_dim=1),
+        }
+        batch = jax.device_put(batch, bsh)
         sharded, state, loss = step(sharded, state, batch)
         losses.append(float(loss))
         print(f"step {i}: loss={losses[-1]:.4f}  (S={S}, context={ndev})")

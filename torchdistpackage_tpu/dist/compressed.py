@@ -1,0 +1,117 @@
+"""Quantized gradient all-reduce — an XLA-native take on EQuARX
+("Efficient Quantized AllReduce in XLA", arXiv 2506.17615, PAPERS.md): cut
+the bytes a data-parallel grad reduction moves over ICI/DCN by carrying
+int8 payloads through a manual ring, requantizing per hop exactly the way
+the paper does inside XLA's all-reduce stages.
+
+``int8_ring_pmean(g, axis)`` implements mean-all-reduce as
+
+1. ring **reduce-scatter** over ``axis``: N-1 ``ppermute`` hops; each hop
+   sends one int8-quantized chunk (1 byte/elem on the wire vs 4 for f32 /
+   2 for bf16) plus one f32 scale per chunk, dequantizes, and accumulates
+   into the local fp32 partial — per-hop requantization keeps the wire
+   format int8 while the accumulator stays full precision,
+2. ring **all-gather** of the final owner chunks, again int8 + scale.
+
+Total wire bytes ≈ 2(N-1)/N per element vs 8(N-1)/N for f32 all-reduce — a
+4x reduction, at the cost of quantization noise bounded by
+``chunk_amax / 127`` per hop (symmetric per-chunk scaling).  Gradient noise
+of this magnitude is far below SGD's own batch noise in practice; the tests
+bound the numeric error and check end-to-end training still converges.
+
+Opt in via ``DataParallel(grad_compress='int8')`` — the compressed path
+replaces the default ``pmean`` for leaves large enough to matter
+(small leaves keep the exact reduction; the scale traffic would dominate).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+GROUP = 256  # elements per quantization scale (1.5% f32-scale overhead)
+
+
+def _group_size(n: int) -> int:
+    """Largest power of two <= GROUP dividing n (n is a static chunk size)."""
+    g = 1
+    while g * 2 <= GROUP and n % (g * 2) == 0:
+        g *= 2
+    return g
+
+
+def _quant(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization with PER-GROUP scales: a single per-chunk
+    scale lets a few outlier elements wash out the rest of the chunk (quant
+    noise ~ amax/127 per element regardless of magnitude), which accumulates
+    over the ring's n-1 requantization hops into noise comparable to typical
+    gradient values.  Per-group scales keep the noise proportional to the
+    LOCAL amax.  x: [c] -> (q [c] int8, scales [c/g] f32)."""
+    c = x.shape[0]
+    g = _group_size(c)
+    grouped = x.reshape(-1, g)
+    scale = jnp.maximum(jnp.max(jnp.abs(grouped), axis=1), 1e-30) / 127.0
+    q = jnp.clip(jnp.round(grouped / scale[:, None]), -127, 127).astype(jnp.int8)
+    return q.reshape(c), scale.astype(jnp.float32)
+
+
+def _dequant(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    c = q.shape[0]
+    g = c // scale.shape[0]
+    return (q.astype(jnp.float32).reshape(-1, g) * scale[:, None]).reshape(c)
+
+
+def int8_ring_pmean(g: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Mean of ``g`` over the mesh ``axis`` with int8 wire format (traced;
+    call inside shard_map).  Falls back to exact ``pmean`` when the leading
+    dim doesn't divide by the axis size (ragged chunks) or the axis has a
+    single member."""
+    n = jax.lax.axis_size(axis)
+    if n == 1:
+        return g
+    flat = g.reshape(-1)
+    if flat.shape[0] % n != 0:
+        return jax.lax.pmean(g, axis)
+
+    idx = jax.lax.axis_index(axis)
+    chunks = flat.reshape(n, -1).astype(jnp.float32)  # chunk c owned by rank c
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    # ---- ring reduce-scatter: after N-1 hops rank r holds the full sum of
+    # chunk r.  Hop t: send the partial of chunk (idx - t) % n downstream.
+    def rs_hop(carry, t):
+        acc, send_q, send_s = carry
+        recv_q = jax.lax.ppermute(send_q, axis, fwd)
+        recv_s = jax.lax.ppermute(send_s, axis, fwd)
+        # chunk being accumulated at this rank on hop t: (idx - t - 1) % n
+        c = jnp.mod(idx - t - 1, n)
+        mine = jax.lax.dynamic_index_in_dim(acc, c, axis=0, keepdims=False)
+        part = mine + _dequant(recv_q, recv_s)
+        acc = jax.lax.dynamic_update_index_in_dim(acc, part, c, axis=0)
+        q, s = _quant(part)
+        return (acc, q, s), None
+
+    q0, s0 = _quant(
+        jax.lax.dynamic_index_in_dim(chunks, jnp.mod(idx, n), 0, keepdims=False)
+    )
+    (acc, _, _), _ = jax.lax.scan(rs_hop, (chunks, q0, s0), jnp.arange(n - 1))
+    # chunk c collects its n-1 ring additions at ranks c+1..c+n-1, finishing
+    # at rank c-1 — so THIS rank ends holding chunk idx+1 fully reduced
+    own_c = jnp.mod(idx + 1, n)
+    owned = jax.lax.dynamic_index_in_dim(acc, own_c, 0, keepdims=False) / n
+
+    # ---- all-gather of the owned (mean) chunks, int8 on the wire (XLA's
+    # native all-gather; output is replication-typed by construction, and
+    # every rank — including the owner — dequantizes the same payload, so
+    # all ranks hold bit-identical results).
+    oq, os_ = _quant(owned)
+    gq = jax.lax.all_gather(oq, axis)  # [n, c] int8
+    gs = jax.lax.all_gather(os_, axis)  # [n, c/g] f32
+    out = jax.vmap(_dequant)(gq, gs)
+    # row r carries rank r's owned chunk = chunk (r+1) mod n; roll so row c
+    # is chunk c
+    out = jnp.roll(out, shift=1, axis=0)
+    return out.reshape(g.shape).astype(g.dtype)

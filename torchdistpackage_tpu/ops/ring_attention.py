@@ -86,39 +86,35 @@ def zigzag_positions(shard_idx, s_local: int, n: int):
     return jnp.concatenate([lo + jnp.arange(c), hi + jnp.arange(c)]), (lo, hi)
 
 
-def zigzag_permute(x: jnp.ndarray, n: int, seq_dim: int = 1) -> jnp.ndarray:
-    """Host-side layout change: reorder the sequence dim so that a contiguous
-    n-way split yields the zigzag ownership (shard i = chunks i and 2n-1-i).
-    Apply to tokens AND targets before sharding over the context axis; mean
-    losses are permutation-invariant so training is unaffected."""
-    S = x.shape[seq_dim]
+def _zigzag_index(S: int, n: int) -> jnp.ndarray:
+    """The [S] gather index realizing the zigzag layout: position j of the
+    permuted sequence holds original token idx[j] (shard i = chunks i and
+    2n-1-i).  Single source of truth for permute/unpermute."""
     if S % (2 * n) != 0:
         raise ValueError(
             f"sequence length {S} not divisible by 2*n = {2 * n} — trailing "
             f"tokens would be silently dropped"
         )
     c = S // (2 * n)
-    idx = jnp.concatenate(
+    return jnp.concatenate(
         [jnp.concatenate([jnp.arange(i * c, (i + 1) * c),
                           jnp.arange((2 * n - 1 - i) * c, (2 * n - i) * c)])
          for i in range(n)]
     )
-    return jnp.take(x, idx, axis=seq_dim)
+
+
+def zigzag_permute(x: jnp.ndarray, n: int, seq_dim: int = 1) -> jnp.ndarray:
+    """Host-side layout change: reorder the sequence dim so that a contiguous
+    n-way split yields the zigzag ownership (shard i = chunks i and 2n-1-i).
+    Apply to tokens AND targets before sharding over the context axis; mean
+    losses are permutation-invariant so training is unaffected."""
+    return jnp.take(x, _zigzag_index(x.shape[seq_dim], n), axis=seq_dim)
 
 
 def zigzag_unpermute(x: jnp.ndarray, n: int, seq_dim: int = 1) -> jnp.ndarray:
     """Inverse of :func:`zigzag_permute` (for inspecting outputs in natural
     order)."""
-    S = x.shape[seq_dim]
-    if S % (2 * n) != 0:
-        raise ValueError(f"sequence length {S} not divisible by 2*n = {2 * n}")
-    c = S // (2 * n)
-    idx = jnp.concatenate(
-        [jnp.concatenate([jnp.arange(i * c, (i + 1) * c),
-                          jnp.arange((2 * n - 1 - i) * c, (2 * n - i) * c)])
-         for i in range(n)]
-    )
-    inv = jnp.argsort(idx)
+    inv = jnp.argsort(_zigzag_index(x.shape[seq_dim], n))
     return jnp.take(x, inv, axis=seq_dim)
 
 
@@ -313,6 +309,8 @@ def _ring_attention_zigzag_flash(q, k, v, axis, sm_scale, block_q, block_k):
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, H, S, D = q.shape
+    if S % 2 != 0:
+        raise ValueError(f"zigzag needs an even local sequence length, got {S}")
     c = S // 2
 
     vary = tuple(_vma(q) | _vma(k) | _vma(v) | {axis})
