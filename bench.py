@@ -18,8 +18,9 @@ MFU: model FLOPs/token = 6·N_params + 12·L·S·D (PaLM-style accounting:
 matmuls; remat recompute is hardware overhead and deliberately NOT counted —
 MFU is model FLOPs over peak). Peak bf16 FLOP/s looked up by device_kind.
 
-A/B mode: ``python bench.py --ab`` runs the candidate (batch, remat) configs
-in ONE session on the attached backend and prints one JSON line per config
+A/B mode: ``python bench.py --ab`` runs the candidate
+(batch, remat, xent_chunk) configs in ONE session on the attached backend
+and prints one JSON line per config
 (plus a "winner" line), recording each config's first measurement in the
 baselines file. Use this to choose the default config honestly.
 
@@ -32,17 +33,26 @@ If everything fails it still prints the JSON line with an ``error`` field.
 Run with ``--measure`` to execute the measurement directly in-process.
 """
 
+import functools
 import json
 import os
 import subprocess
 import sys
 import time
 
-# (batch_per_chip, remat) A/B candidates on the accelerator; module scope so
-# the parent's --ab timeout scales with the same list the child runs.  The
-# default single-config run uses the first entry — keep it set to the A/B
-# winner (docs/BENCH_AB.md).
-TPU_CANDIDATES = [(8, False), (16, True), (32, True)]
+# (batch_per_chip, remat, xent_chunk) A/B candidates on the accelerator;
+# module scope so the parent's --ab timeout scales with the same list the
+# child runs.  The default single-config run uses the first entry — keep it
+# set to the A/B winner (docs/BENCH_AB.md).  xent_chunk streams the head+CE
+# over sequence chunks (gpt_loss(xent_chunk=...)) instead of materializing
+# the ~2 GB [B, S, V] logits.
+TPU_CANDIDATES = [
+    (8, False, None),
+    (8, False, 256),
+    (16, False, 256),
+    (16, True, None),
+    (32, True, None),
+]
 
 # Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
 _PEAK_BF16 = [
@@ -111,7 +121,7 @@ def _record_baseline(baselines: dict, path: str, backend: str, config: str,
             pass  # read-only checkout: keep reporting, skip recording
 
 
-def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat):
+def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None):
     """One timed measurement; returns (tokens_per_sec_chip, global_batch,
     flops_per_token)."""
     import optax
@@ -123,7 +133,7 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat):
     state = opt.init(params)
 
     def loss_fn(p, batch):
-        return gpt_loss(p, batch, cfg, remat=remat)
+        return gpt_loss(p, batch, cfg, remat=remat, xent_chunk=xent_chunk)
 
     # DP mesh over all attached chips so per-chip throughput is honest on
     # multi-chip hosts: params replicated, batch sharded on its leading dim.
@@ -149,7 +159,10 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat):
         6 * n_matmul_params + 12 * cfg.nlayers * cfg.max_seq * cfg.dim
     )
 
-    @jax.jit
+    # donate params/opt-state: relaxes buffer lifetimes so XLA updates in
+    # place instead of holding input AND output copies of ~1.6 GB of
+    # params+moments — a pure lifetime annotation, no semantic change
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         updates, state = opt.update(grads, state, params)
@@ -209,7 +222,7 @@ def main(jax, jnp, ab: bool = False) -> None:
             vocab_size=512, dim=128, nheads=4, nlayers=4, max_seq=256,
             ffn_mult=2, dtype=jnp.float32,
         )
-        candidates = [(4, False)]
+        candidates = [(4, False, None)]
         steps, warmup = 5, 2
 
     baseline_path = os.path.join(
@@ -220,12 +233,14 @@ def main(jax, jnp, ab: bool = False) -> None:
         candidates = candidates[:1]
 
     results = []
-    for batch_size, remat in candidates:
+    for batch_size, remat, xent_chunk in candidates:
         tps, global_batch, fpt = _run_config(
-            jax, jnp, cfg, batch_size, steps, warmup, remat)
+            jax, jnp, cfg, batch_size, steps, warmup, remat,
+            xent_chunk=xent_chunk)
         config_str = (
             f"gpt d{cfg.dim} L{cfg.nlayers} seq{cfg.max_seq} b{global_batch}"
             f"{' remat' if remat else ''}"
+            f"{f' ce{xent_chunk}' if xent_chunk else ''}"
         )
         _record_baseline(baselines, baseline_path, backend, config_str, tps)
         best = max(
