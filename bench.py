@@ -19,9 +19,10 @@ matmuls; remat recompute is hardware overhead and deliberately NOT counted —
 MFU is model FLOPs over peak). Peak bf16 FLOP/s looked up by device_kind.
 
 A/B mode: ``python bench.py --ab`` runs the candidate
-(batch, remat, xent_chunk) configs in ONE session on the attached backend
-and prints one JSON line per config
-(plus a "winner" line), recording each config's first measurement in the
+(batch, remat, xent_chunk) configs ONE CHILD PROCESS EACH (fresh backend per
+candidate — an OOM/hang in one config cannot abort the others, and there is
+no allocator-fragmentation carry-over), printing one JSON line per config
+plus a "winner" line, and recording each config's first measurement in the
 baselines file. Use this to choose the default config honestly.
 
 Hang-proof structure: the accelerator backend behind the axon tunnel can
@@ -49,10 +50,13 @@ import time
 TPU_CANDIDATES = [
     (8, False, None),
     (8, False, 256),
-    (16, False, 256),
-    (16, True, None),
-    (32, True, None),
+    (16, True, 256),
 ]
+# Retired candidates (recorded in BENCH_BASELINE.json / docs/BENCH_AB.md):
+# (16, True, None) 62,546 and (32, True, None) 22,263 lose to b8 no-remat;
+# (16, False, 256) OOMs — streamed CE removes the logits but b16 no-remat
+# still saves every block activation (12 x [16, 2048, 768] bf16 + per-head
+# tensors), which exhausts v5e HBM.
 
 # Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
 _PEAK_BF16 = [
@@ -74,6 +78,15 @@ def _peak_flops(device_kind: str):
     return None
 
 
+def _only_index(argv):
+    """--only N: restrict an --ab child to candidate N (one child per
+    candidate keeps an OOM in one config from aborting the others)."""
+    for i, a in enumerate(argv):
+        if a == "--only" and i + 1 < len(argv):
+            return int(argv[i + 1])
+    return None
+
+
 def _measure() -> None:
     import jax
 
@@ -83,7 +96,7 @@ def _measure() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
 
-    main(jax, jnp, ab="--ab" in sys.argv)
+    main(jax, jnp, ab="--ab" in sys.argv, only=_only_index(sys.argv))
 
 
 def _load_baselines(path: str) -> dict:
@@ -194,7 +207,7 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
     return global_batch * cfg.max_seq * steps / dt / n_chips, global_batch, flops_per_token
 
 
-def main(jax, jnp, ab: bool = False) -> None:
+def main(jax, jnp, ab: bool = False, only=None) -> None:
     from torchdistpackage_tpu.models import GPTConfig
 
     # Backend probe with CPU fallback: an accelerator backend that errors at
@@ -229,7 +242,15 @@ def main(jax, jnp, ab: bool = False) -> None:
         os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
     baselines = _load_baselines(baseline_path)
 
-    if not ab:
+    if only is not None:
+        if only >= len(candidates):
+            # the parent sweeps TPU_CANDIDATES indices; a child that fell
+            # back to CPU has a 1-entry list — emit a marker (instead of
+            # silently printing nothing with rc 0) so the parent can stop
+            print(json.dumps({"skipped_candidate": only, "backend": backend}))
+            return
+        candidates = candidates[only:only + 1]
+    elif not ab:
         candidates = candidates[:1]
 
     results = []
@@ -259,28 +280,75 @@ def main(jax, jnp, ab: bool = False) -> None:
             line["peak_flops_est"] = peak
             line["mfu"] = round(tps * fpt / peak, 4)
         results.append(line)
-        if ab:
+        if ab or only is not None:
             print(json.dumps(line))
 
-    if ab:
+    if ab and only is None:
         winner = max(results, key=lambda r: r["value"])
         print(json.dumps({"ab_winner": winner["config"], "value": winner["value"]}))
-    else:
+    elif only is None:
         print(json.dumps(results[0]))
 
 
-def _run_child(env_extra: dict, timeout: float, extra_args=()) -> bool:
+def _run_child(env_extra: dict, timeout: float, extra_args=(), capture=False):
+    """Run bench.py --measure in a child.  Returns True/False, or (when
+    ``capture``) the child's stdout str on success / None on failure.
+    ``capture`` captures stdout ONLY — stderr stays inherited so OOM /
+    XLA tracebacks from a failing candidate remain visible."""
     env = dict(os.environ, **env_extra)
     try:
         res = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--measure", *extra_args],
             env=env,
             timeout=timeout,
+            stdout=subprocess.PIPE if capture else None,
+            text=capture,
         )
+        if capture:
+            sys.stdout.write(res.stdout)
+            sys.stdout.flush()
+            return res.stdout if res.returncode == 0 else None
         return res.returncode == 0
     except subprocess.TimeoutExpired:
         print(f"bench: child timed out after {timeout:.0f}s", file=sys.stderr)
-        return False
+        return None if capture else False
+
+
+def _ab_main(timeout: float) -> None:
+    """One child per candidate: an OOM/hang in one config cannot abort the
+    sweep (observed: b16 no-remat exhausts v5e HBM and killed the round-3
+    sweep's remaining configs), and each child gets a fresh backend — no
+    allocator fragmentation carry-over between configs.
+
+    A child that lands on CPU (explicit JAX_PLATFORMS=cpu, or accelerator
+    init failure inside the child) has a 1-entry candidate list: it emits a
+    ``skipped_candidate`` marker for out-of-range indices and the sweep
+    stops — the remaining TPU candidates are meaningless on CPU."""
+    best = None
+    for i in range(len(TPU_CANDIDATES)):
+        out = _run_child({}, timeout, ("--ab", "--only", str(i)), capture=True)
+        if out is None:
+            print(
+                f"bench: candidate {i} {TPU_CANDIDATES[i]} failed/timed out",
+                file=sys.stderr,
+            )
+            continue
+        stop = False
+        for ln in out.splitlines():
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+            if "skipped_candidate" in rec:
+                stop = True
+            if "value" in rec and (best is None or rec["value"] > best["value"]):
+                best = rec
+        if stop:
+            break
+    if best is not None:
+        print(json.dumps({"ab_winner": best["config"], "value": best["value"]}))
+    else:
+        print(json.dumps({"ab_winner": None, "error": "no candidate succeeded"}))
 
 
 if __name__ == "__main__":
@@ -288,22 +356,24 @@ if __name__ == "__main__":
         _measure()  # prints the JSON line(s) itself
         sys.exit(0)
 
-    extra = ("--ab",) if "--ab" in sys.argv else ()
     accel_timeout = float(os.environ.get("BENCH_ACCEL_TIMEOUT", "900"))
-    if extra:
-        accel_timeout *= len(TPU_CANDIDATES)  # one budget per timed config
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "600"))
 
+    if "--ab" in sys.argv:
+        on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        _ab_main(cpu_timeout if on_cpu else accel_timeout)
+        sys.exit(0)
+
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        ok = _run_child({}, cpu_timeout, extra)
+        ok = _run_child({}, cpu_timeout)
     else:
-        ok = _run_child({}, accel_timeout, extra)
+        ok = _run_child({}, accel_timeout)
         if not ok:
             print(
                 "bench: accelerator path failed or hung; re-running on CPU",
                 file=sys.stderr,
             )
-            ok = _run_child({"JAX_PLATFORMS": "cpu"}, cpu_timeout, extra)
+            ok = _run_child({"JAX_PLATFORMS": "cpu"}, cpu_timeout)
     if not ok:
         print(json.dumps({
             "metric": "gpt-train-throughput",

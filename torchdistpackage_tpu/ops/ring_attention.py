@@ -126,8 +126,8 @@ def ring_attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     use_flash: bool = True,
-    block_q: int = 256,
-    block_k: int = 512,
+    block_q: int = 1024,  # per-hop flash tiles; tuned defaults, see
+    block_k: int = 1024,  # ops/flash_attention.py + docs/FLASH_TUNE_v5e.json
     layout: str = "contiguous",
 ) -> jnp.ndarray:
     """Ring attention over the ``axis`` mesh ring.  [B, H, S_local, D] layout

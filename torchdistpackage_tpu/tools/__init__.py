@@ -27,8 +27,10 @@ from .surgery import (
     replace_params,
 )
 from .slurm_job_monitor import determine_job_is_alive, launch_job, monitor_job
+from .flash_tune import tune_flash_blocks
 
 __all__ = [
+    "tune_flash_blocks",
     "BlockProfile",
     "get_model_profile",
     "profile_blocks",

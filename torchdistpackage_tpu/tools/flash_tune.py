@@ -1,0 +1,154 @@
+"""Flash-attention block-size autotuner.
+
+The Pallas flash kernel (ops/flash_attention.py) takes ``block_q``/``block_k``
+tile sizes whose best values depend on the chip generation (VMEM size, MXU
+shape) and the problem shape.  The reference delegates kernel tuning to
+cuDNN/bitsandbytes; on TPU it is OUR kernel, so the framework ships the tuner:
+time fwd+bwd over a candidate grid on the attached backend and report the
+ranking.
+
+Usage (library)::
+
+    from torchdistpackage_tpu.tools import tune_flash_blocks
+    best, report = tune_flash_blocks(batch=8, heads=12, seq=2048, head_dim=64)
+
+or CLI: ``python -m torchdistpackage_tpu.tools.flash_tune --seq 2048``.
+
+Timing uses the same host-transfer sync discipline as bench.py: chain the
+iterations through a data dependency and fetch a scalar at the end
+(``block_until_ready`` can return early over the axon TPU tunnel).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# (block_q, block_k) candidates; clamped per-shape by the kernel's gcd rule
+DEFAULT_CANDIDATES: Tuple[Tuple[int, int], ...] = (
+    (128, 128),
+    (128, 256),
+    (128, 512),
+    (256, 256),
+    (256, 512),
+    (256, 1024),
+    (512, 512),
+    (512, 1024),
+    (1024, 1024),
+)
+
+
+def _time_config(
+    q, k, v, block_q: int, block_k: int, causal: bool, steps: int, warmup: int
+) -> float:
+    """Seconds per fwd+bwd step for one (block_q, block_k)."""
+    from ..ops.flash_attention import flash_attention
+
+    def loss(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=causal, block_q=block_q, block_k=block_k
+            ).astype(jnp.float32)
+        )
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    # chain iterations through q so the run can't dead-code or overlap past
+    # the timer; final scalar fetch bounds execution
+    def chain(q, n):
+        for _ in range(n):
+            dq, _, _ = step(q, k, v)
+            q = q + 0 * dq
+        return q
+
+    q1 = chain(q, warmup)
+    float(jnp.sum(q1[0, 0, 0].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    q2 = chain(q, steps)
+    float(jnp.sum(q2[0, 0, 0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / steps
+
+
+def tune_flash_blocks(
+    batch: int = 8,
+    heads: int = 12,
+    seq: int = 2048,
+    head_dim: int = 64,
+    causal: bool = True,
+    dtype=jnp.bfloat16,
+    candidates: Sequence[Tuple[int, int]] = DEFAULT_CANDIDATES,
+    steps: int = 10,
+    warmup: int = 2,
+    seed: int = 0,
+) -> Tuple[Tuple[int, int], List[dict]]:
+    """Measure every (block_q, block_k) candidate at the given shape.
+
+    Returns ``(best, report)`` where ``report`` is a list of
+    ``{"block_q", "block_k", "ms", "rel"}`` sorted fastest-first (``rel`` is
+    time relative to the winner).  Candidates that exceed the sequence are
+    deduped after the kernel's clamp-to-divisor rule, so the report has no
+    repeated effective configs."""
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (batch, heads, seq, head_dim)
+    q = jax.random.normal(kq, shape, dtype)
+    k = jax.random.normal(kk, shape, dtype)
+    v = jax.random.normal(kv, shape, dtype)
+
+    import math
+
+    seen = set()
+    rows = []
+    for bq, bk in candidates:
+        eff = (math.gcd(min(bq, seq), seq), math.gcd(min(bk, seq), seq))
+        if eff in seen:
+            continue
+        seen.add(eff)
+        try:
+            dt = _time_config(q, k, v, bq, bk, causal, steps, warmup)
+        except Exception as e:  # one bad tile must not kill the sweep
+            rows.append({"block_q": eff[0], "block_k": eff[1],
+                         "ms": None, "error": repr(e)[:200]})
+            continue
+        rows.append({"block_q": eff[0], "block_k": eff[1], "ms": dt * 1e3})
+    ok = [r for r in rows if r.get("ms") is not None]
+    if not ok:
+        raise RuntimeError(f"no flash block config succeeded: {rows}")
+    ok.sort(key=lambda r: r["ms"])
+    best_ms = ok[0]["ms"]
+    for r in ok:
+        r["rel"] = round(r["ms"] / best_ms, 3)
+        r["ms"] = round(r["ms"], 3)
+    report = ok + [r for r in rows if r.get("ms") is None]
+    return (ok[0]["block_q"], ok[0]["block_k"]), report
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--no-causal", action="store_true")
+    args = ap.parse_args(argv)
+    best, report = tune_flash_blocks(
+        batch=args.batch, heads=args.heads, seq=args.seq,
+        head_dim=args.head_dim, causal=not args.no_causal, steps=args.steps,
+    )
+    print(json.dumps({
+        "backend": jax.default_backend(),
+        "chip": jax.devices()[0].device_kind,
+        "shape": [args.batch, args.heads, args.seq, args.head_dim],
+        "best": {"block_q": best[0], "block_k": best[1]},
+        "report": report,
+    }, indent=1))
+
+
+if __name__ == "__main__":
+    main()
