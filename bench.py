@@ -51,12 +51,14 @@ TPU_CANDIDATES = [
     (8, False, None),
     (8, False, 256),
     (16, True, 256),
+    (16, True, None),
 ]
 # Retired candidates (recorded in BENCH_BASELINE.json / docs/BENCH_AB.md):
-# (16, True, None) 62,546 and (32, True, None) 22,263 lose to b8 no-remat;
-# (16, False, 256) OOMs — streamed CE removes the logits but b16 no-remat
-# still saves every block activation (12 x [16, 2048, 768] bf16 + per-head
-# tensors), which exhausts v5e HBM.
+# (32, True, None) 22,263 collapses (spills); (16, False, 256) OOMs —
+# streamed CE removes the logits but b16 no-remat still saves every block
+# activation (12 x [16, 2048, 768] bf16 + per-head tensors), which exhausts
+# v5e HBM.  The remat configs stay in the sweep: the flash-tile retune
+# changed the recompute price, so their pre-tune rankings are stale.
 
 # Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
 _PEAK_BF16 = [

@@ -257,6 +257,144 @@ def test_gpt_moe_training_matches_serial(devices8):
     )
 
 
+def test_gpt_moe_1f1b_matches_serial_microbatched(devices8):
+    """MoE × PP: the MoE GPT under the 1F1B schedule (EP × MoE-DP × PP) must
+    track a serial model trained on the mean of per-microbatch losses — the
+    reference's MoE-DP (naive_ddp.py:233-441) composed with its PP+DP layout
+    (Readme.md:56), which the reference never wires together.  The aux
+    (load-balance) loss is ON: it rides the scheduler's stage-aux channel,
+    so this also goldens the aux gradient path through the pipeline.
+
+    The serial golden evaluates per (microbatch, data-shard) chunk: the aux
+    term is a product of per-batch means (nonlinear in tokens), and under
+    EP×MoE-DP each device routes its LOCAL tokens — so the distributed loss
+    is the mean over M×dp chunk losses, which is what the golden computes
+    (CE is linear in equal chunks, so it is unaffected)."""
+    from torchdistpackage_tpu.models import (
+        GPTConfig,
+        gpt_moe_loss,
+        gpt_moe_pipeline_1f1b,
+        gpt_moe_pipeline_param_specs,
+        init_gpt_moe_params,
+        stack_moe_stage_params,
+    )
+    from torchdistpackage_tpu.parallel.data_parallel import DataParallel
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, nheads=4, nlayers=4, max_seq=16, ffn_mult=2,
+        moe_experts=4, moe_top_k=2, moe_every=2,
+        moe_capacity_factor=4.0,  # no drops: serial and EP routing identical
+        moe_aux_weight=1e-2,
+    )
+    M, mbs = 4, 2
+    PP = 2
+    tpc.setup_process_groups([("pipe", PP), ("data", 4)], devices=devices8)
+    tpc.build_moe_mesh(moe_ep_size=2)
+    mesh = tpc.get_view("moe")  # (pipe, moe_dp=2, moe_ep=2)
+
+    params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+    stage_params = stack_moe_stage_params(params, cfg, PP)
+    specs = gpt_moe_pipeline_param_specs(cfg, PP, ep_axis="moe_ep")
+
+    def vg_fn(p, batch):
+        return gpt_moe_pipeline_1f1b(
+            p, batch, cfg, num_microbatches=M, ep_axis="moe_ep"
+        )
+
+    opt = optax.sgd(1e-1)
+    dp = DataParallel(
+        mesh=mesh,
+        axis=("moe_dp", "moe_ep"),
+        grad_reduce_overrides=moe_grad_reduce_overrides(),
+    )
+    sharded = dp.broadcast_params(stage_params, param_specs=specs)
+    state = opt.init(sharded)
+    step = dp.make_train_step(
+        value_and_grad_fn=vg_fn,
+        optimizer=opt,
+        param_specs=specs,
+        batch_spec={
+            "tokens": P(None, ("moe_dp", "moe_ep")),
+            "targets": P(None, ("moe_dp", "moe_ep")),
+        },
+    )
+
+    sparams, sstate = params, opt.init(params)
+
+    def serial_loss(p, batch):
+        # mean over (microbatch, data-shard) chunks — the EP×MoE-DP×PP
+        # step's exact semantics (each device routes its local 2 rows)
+        losses = [
+            gpt_moe_loss(
+                p,
+                {
+                    "tokens": batch["tokens"][m, 2 * d : 2 * d + 2],
+                    "targets": batch["targets"][m, 2 * d : 2 * d + 2],
+                },
+                cfg,
+            )
+            for m in range(M)
+            for d in range(4)
+        ]
+        return jnp.mean(jnp.stack(losses))
+
+    @jax.jit
+    def serial_step(p, s, b):
+        loss, g = jax.value_and_grad(serial_loss)(p, b)
+        u, s = opt.update(g, s, p)
+        return jax.tree.map(jnp.add, p, u), s, loss
+
+    S = cfg.max_seq
+    for i in range(2):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(70 + i))
+        batch = {
+            "tokens": jax.random.randint(k1, (M, mbs * 4, S), 0, cfg.vocab_size),
+            "targets": jax.random.randint(k2, (M, mbs * 4, S), 0, cfg.vocab_size),
+        }
+        sparams, sstate, sloss = serial_step(sparams, sstate, batch)
+        dbatch = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(mesh, P(None, ("moe_dp", "moe_ep")))
+            ),
+            batch,
+        )
+        sharded, state, dloss = step(sharded, state, dbatch)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
+
+    # per-position pipelined params vs the serial block list: position i of
+    # stage s is serial block s*(L/P)+i
+    lpp = cfg.nlayers // PP
+    for i in range(lpp):
+        got = np.asarray(
+            jax.tree_util.tree_leaves(sharded["blocks"][i])[0]
+        )
+        for s_idx in range(PP):
+            want_block = sparams["blocks"][s_idx * lpp + i]
+            np.testing.assert_allclose(
+                got[s_idx],
+                np.asarray(jax.tree_util.tree_leaves(want_block)[0]),
+                rtol=1e-4, atol=1e-5,
+                err_msg=f"block position {i} stage {s_idx} diverged",
+            )
+    # expert params specifically (the aux gradient path feeds the router)
+    moe_pos = 1  # blocks 1 and 3 are expert blocks (moe_every=2)
+    np.testing.assert_allclose(
+        np.asarray(sharded["blocks"][moe_pos]["moe"]["router"]["w"])[0],
+        np.asarray(sparams["blocks"][1]["moe"]["router"]["w"]),
+        rtol=1e-4, atol=1e-5, err_msg="router diverged (aux grad path)",
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded["blocks"][moe_pos]["moe"]["experts"]["w1"])[1],
+        np.asarray(sparams["blocks"][3]["moe"]["experts"]["w1"]),
+        rtol=1e-4, atol=1e-5, err_msg="stage-1 expert w1 diverged",
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded["head"]),
+        np.asarray(sparams["head"]),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
 def test_gpt_moe_aux_trains(devices8):
     """With the load-balance aux ON (the Switch recipe), distributed EP
     training is finite and the loss decreases."""

@@ -29,6 +29,29 @@ from torchdistpackage_tpu.tools import (
 from torchdistpackage_tpu.tools import slurm_job_monitor as sjm
 
 
+# --------------------------------------------------------------- flash tune
+
+
+def test_tune_flash_blocks_ranks_and_dedupes():
+    """The autotuner must (a) run every distinct effective config after the
+    kernel's gcd clamp (the four candidates below collapse to two at S=64),
+    (b) return the fastest as best, and (c) report rel ratios vs the winner.
+    CPU interpret mode, tiny shape — this is a harness test, not a perf one."""
+    from torchdistpackage_tpu.tools import tune_flash_blocks
+
+    best, report = tune_flash_blocks(
+        batch=1, heads=2, seq=64, head_dim=8,
+        candidates=[(32, 32), (64, 64), (128, 128), (256, 512)],
+        steps=1, warmup=0,
+    )
+    ok = [r for r in report if r.get("ms") is not None]
+    # (128,128) and (256,512) both clamp to (64,64): deduped
+    assert len(ok) == 2, report
+    assert {(r["block_q"], r["block_k"]) for r in ok} == {(32, 32), (64, 64)}
+    assert best == (ok[0]["block_q"], ok[0]["block_k"])
+    assert ok[0]["rel"] == 1.0 and all(r["rel"] >= 1.0 for r in ok)
+
+
 # ---------------------------------------------------------------- profiler
 
 

@@ -13,10 +13,14 @@ from .gpt_moe import (
     gpt_moe_forward,
     gpt_moe_loss,
     gpt_moe_param_specs,
+    gpt_moe_pipeline_1f1b,
+    gpt_moe_pipeline_param_specs,
     init_gpt_moe_params,
     is_moe_block,
     moe_block_forward,
     moe_layer_config,
+    moe_stage_pattern,
+    stack_moe_stage_params,
 )
 from .vit import (
     ViTConfig,

@@ -200,6 +200,168 @@ def init_gpt_moe_params(key, cfg: GPTConfig) -> Dict[str, PyTree]:
     }
 
 
+# ------------------------------------------------------------------- pipeline
+
+
+def moe_stage_pattern(cfg: GPTConfig, pipe_size: int) -> List[bool]:
+    """Per-position dense/MoE pattern of one pipeline stage's slab.
+
+    The SPMD pipeline runs ONE program on every stage, so each stage's slab
+    of ``nlayers / pipe_size`` blocks must have the same structure (which
+    positions are expert blocks).  That holds iff ``moe_every`` divides the
+    per-stage layer count — checked here against the actual placement."""
+    L = cfg.nlayers
+    if L % pipe_size != 0:
+        raise ValueError(f"nlayers {L} not divisible by pipe size {pipe_size}")
+    lpp = L // pipe_size
+    pattern = [is_moe_block(cfg, i) for i in range(lpp)]
+    for s in range(1, pipe_size):
+        for i in range(lpp):
+            if is_moe_block(cfg, s * lpp + i) != pattern[i]:
+                raise ValueError(
+                    f"MoE block placement is not stage-invariant: block "
+                    f"{s * lpp + i} (stage {s}, position {i}) differs from "
+                    f"block {i}; choose moe_every dividing nlayers/pipe "
+                    f"({lpp}) so every stage holds the same dense/expert "
+                    f"pattern"
+                )
+    return pattern
+
+
+def stack_moe_stage_params(
+    params: Dict[str, PyTree], cfg: GPTConfig, pipe_size: int
+) -> Dict[str, PyTree]:
+    """Reorganize ``init_gpt_moe_params``'s length-L block list into the
+    pipeline layout: a length-``L/pipe`` list (position within a stage) whose
+    leaves are stacked ``[pipe, ...]`` across stages — the MoE analogue of
+    ``stack_stage_params`` (stage s's slab is blocks
+    ``[s*L/P, (s+1)*L/P)``, uniform partition, pipeline_helper.py:6-17
+    semantics).  Shard each leaf's dim 0 over the pipe axis
+    (:func:`gpt_moe_pipeline_param_specs`)."""
+    lpp = len(moe_stage_pattern(cfg, pipe_size))
+    blocks = params["blocks"]
+    new_blocks = [
+        jax.tree.map(
+            lambda *xs: jnp.stack(xs, axis=0),
+            *[blocks[s * lpp + i] for s in range(pipe_size)],
+        )
+        for i in range(lpp)
+    ]
+    return {**params, "blocks": new_blocks}
+
+
+def gpt_moe_pipeline_1f1b(
+    params: Dict[str, PyTree],
+    batch: Dict[str, jnp.ndarray],
+    cfg: GPTConfig,
+    num_microbatches: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+    ep_axis: Optional[str] = None,
+    sp: bool = False,
+    remat: bool = True,
+    dropout_key: Optional[jax.Array] = None,
+):
+    """1F1B-scheduled MoE GPT training core: returns ``(loss, grads)`` (see
+    :func:`...pipeline_parallel.pipeline_1f1b`).  The EP × MoE-DP × TP × PP
+    composition — the reference's MoE-DP (naive_ddp.py:233-441) under its
+    PP+DP training layout (Readme.md:56), which the reference itself never
+    wires together end-to-end.
+
+    ``params`` must be in the pipeline layout (:func:`stack_moe_stage_params`).
+    The per-stage aux (load-balance) losses ride the scheduler's
+    ``stage_returns_aux`` channel: stage_fn returns
+    ``(y, moe_aux_weight/n_moe * sum of its blocks' aux)``, so the returned
+    loss is ``mean_m [CE_m + moe_aux_weight * mean_blocks aux]`` — the same
+    expression :func:`gpt_moe_loss` computes per microbatch.
+
+    NB the aux (and the dispatch capacity) is computed per MICROBATCH: the
+    load-balance loss is a product of per-batch means, so its value differs
+    from the full-batch aux of a non-pipelined step — compare against a
+    microbatched serial golden (mean of per-microbatch losses)."""
+    n_moe = sum(1 for i in range(cfg.nlayers) if is_moe_block(cfg, i))
+    aux_scale = cfg.moe_aux_weight / max(n_moe, 1)
+    lpp = len(params["blocks"])
+    pattern = [("moe" in params["blocks"][i]) for i in range(lpp)]
+
+    def first_fn(p, toks):
+        h = gpt_embed(p, toks, tp_axis, context_axis=cfg.context_axis, cp_layout=cfg.cp_layout)
+        if tp_axis is not None and sp:
+            h = split_to_sp(h, tp_axis)
+        return h
+
+    def stage_fn(p, x, m):
+        aux_total = jnp.zeros((), jnp.float32)
+        for i, stacked in enumerate(p["blocks"]):
+            bp = jax.tree.map(lambda a: a[0], stacked)  # local [1, ...] slab
+            k = None
+            if dropout_key is not None and cfg.dropout_rate > 0.0:
+                k = jax.random.fold_in(dropout_key, jax.lax.axis_index(pipe_axis))
+                k = jax.random.fold_in(k, m)
+                k = jax.random.fold_in(k, i)
+            if pattern[i]:
+                body = lambda bp, x, k: moe_block_forward(
+                    bp, x, cfg, axis=tp_axis, sp=sp, ep_axis=ep_axis,
+                    dropout_key=k,
+                )
+                if remat:
+                    body = jax.checkpoint(body, static_argnums=())
+                x, aux = body(bp, x, k)
+                aux_total = aux_total + aux
+            else:
+                body = lambda bp, x, k: block_forward(
+                    bp, x, cfg.block, axis=tp_axis, sp=sp, dropout_key=k
+                )
+                if remat:
+                    body = jax.checkpoint(body)
+                x = body(bp, x, k)
+        return x, aux_scale * aux_total
+
+    def last_fn(p, y, tgt):
+        logits = gpt_head(p, y, tp_axis, sp)
+        return vocab_parallel_xent(logits, tgt, tp_axis)
+
+    from ..parallel.pipeline_parallel import pipeline_1f1b
+
+    return pipeline_1f1b(
+        params,
+        batch["tokens"],
+        batch["targets"],
+        first_fn=first_fn,
+        stage_fn=stage_fn,
+        last_fn=last_fn,
+        num_microbatches=num_microbatches,
+        pipe_axis=pipe_axis,
+        stage_takes_mb=True,
+        stage_returns_aux=True,
+    )
+
+
+def gpt_moe_pipeline_param_specs(
+    cfg: GPTConfig,
+    pipe_size: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+    ep_axis: Optional[str] = None,
+) -> Dict[str, PyTree]:
+    """Specs for the :func:`stack_moe_stage_params` layout: every block leaf
+    gains a leading pipe dim; expert stacks keep their EP sharding on what is
+    now dim 1.  Derived from :func:`gpt_moe_param_specs` (one spec source):
+    position i's spec equals block i's, since the pattern is stage-invariant
+    (:func:`moe_stage_pattern` checks)."""
+    lpp = len(moe_stage_pattern(cfg, pipe_size))
+    base = gpt_moe_param_specs(cfg, tp_axis=tp_axis, ep_axis=ep_axis)
+
+    def prepend(tree):
+        return jax.tree.map(
+            lambda s: P(pipe_axis, *s),
+            tree,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+
+    return {**base, "blocks": [prepend(base["blocks"][i]) for i in range(lpp)]}
+
+
 def gpt_moe_param_specs(
     cfg: GPTConfig,
     tp_axis: Optional[str] = None,

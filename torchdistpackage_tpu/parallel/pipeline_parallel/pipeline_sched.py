@@ -351,6 +351,7 @@ def pipeline_1f1b(
     num_microbatches: int,
     pipe_axis: str = PIPE_AXIS,
     stage_takes_mb: bool = False,
+    stage_returns_aux: bool = False,
 ):
     """One-forward-one-backward pipeline schedule: returns ``(loss, grads)``
     directly (do NOT wrap in ``jax.grad`` — the backward pipeline runs inside).
@@ -384,6 +385,19 @@ def pipeline_1f1b(
     read on the first / last stage respectively).  ``last_fn(params, y, tgt)``
     returns the microbatch's mean loss.  Returns the mean loss over all M
     (identical on every stage) and a grads pytree matching ``params``.
+
+    ``stage_returns_aux``: ``stage_fn`` returns ``(y, aux)`` where ``aux`` is
+    a scalar **auxiliary loss term produced mid-pipeline** (e.g. the MoE
+    load-balance loss, which arises on every stage that holds expert blocks
+    — it cannot be computed in ``last_fn``, which only sees the final
+    activation).  The schedule adds each microbatch's aux to the loss once
+    (forward unit, masked to real microbatches) and backpropagates it with a
+    unit cotangent through the stage's vjp (backward unit) — so aux
+    gradients flow into the stage's params AND upstream through ``dx``
+    exactly as if ``total = last_fn_loss + sum_stages aux`` had been
+    differentiated as one expression.  ``aux`` must already carry whatever
+    weight the caller wants (the returned loss is ``mean_m [CE_m +
+    sum_stages aux_{s,m}]``).
     """
     from ..data_parallel import _mark_varying, _vma, pvary_params
 
@@ -421,12 +435,14 @@ def pipeline_1f1b(
     x_shape = jax.eval_shape(first_fn, params, mb0_in)
     want_vma = frozenset(getattr(x_shape, "vma", frozenset())) | {pipe_axis}
     zero_state = None
+    aux_shape = None
     for _ in range(8):  # bounded by the number of mesh axes
         zero_state = _zeros_like_shapes(x_shape)
         missing = tuple(a for a in want_vma if a not in _vma(zero_state))
         if missing:
             zero_state = _mark_varying(zero_state, missing)
-        y_shape = jax.eval_shape(call_stage, params, zero_state, jnp.zeros((), jnp.int32))
+        out_shape = jax.eval_shape(call_stage, params, zero_state, jnp.zeros((), jnp.int32))
+        y_shape, aux_shape = out_shape if stage_returns_aux else (out_shape, None)
         new_want = frozenset(getattr(y_shape, "vma", frozenset())) | want_vma
         if new_want == want_vma:
             break
@@ -447,9 +463,14 @@ def pipeline_1f1b(
     # ---- one backward unit of work (runs under lax.cond when bwd is active)
     def run_bwd(opers):
         x_saved, cot_in, mb_tgt, mb_in, m_b = opers
-        y_, vjp_stage = jax.vjp(
-            lambda p, xx: call_stage(p, xx, m_b), params, x_saved
-        )
+        if stage_returns_aux:
+            (y_, aux_), vjp_stage = jax.vjp(
+                lambda p, xx: call_stage(p, xx, m_b), params, x_saved
+            )
+        else:
+            y_, vjp_stage = jax.vjp(
+                lambda p, xx: call_stage(p, xx, m_b), params, x_saved
+            )
 
         def last_branch(op):
             y_, mb_tgt, _ = op
@@ -472,7 +493,17 @@ def pipeline_1f1b(
             last, last_branch, mid_branch, (y_, mb_tgt, cot_in)
         )
 
-        dp_stage, dx = vjp_stage(g)
+        if stage_returns_aux:
+            # unit cotangent on the stage's aux loss term: total loss holds
+            # +aux per (stage, microbatch), so d total / d aux = 1 (the
+            # schedule's b_active mask zeroes fill/drain ticks afterwards)
+            one_aux = jnp.ones(jnp.shape(aux_), jnp.result_type(aux_))
+            miss = tuple(a for a in _vma(aux_) if a not in _vma(one_aux))
+            dp_stage, dx = vjp_stage(
+                (g, _mark_varying(one_aux, miss) if miss else one_aux)
+            )
+        else:
+            dp_stage, dx = vjp_stage(g)
 
         if first_vjp_in_cond:
             def first_branch(op):
@@ -509,6 +540,12 @@ def pipeline_1f1b(
     # NOT be marked tensor-varying — downstream model-axis normalization keys
     # on the loss vma)
     loss0, grads0, _ = _zeros_like_shapes(bwd_shapes)
+    if stage_returns_aux:
+        # the fwd units also add per-stage aux terms into the accumulator
+        aux_vma = frozenset(getattr(aux_shape, "vma", frozenset()))
+        miss = tuple(a for a in aux_vma if a not in _vma(loss0))
+        if miss:
+            loss0 = _mark_varying(loss0, miss)
 
     def tick(carry, t):
         state, cot_state, saved_x, grads_acc, loss_sum = carry
@@ -521,7 +558,10 @@ def pipeline_1f1b(
         x = jax.lax.cond(
             first, lambda op: first_v(params, op[0]), lambda op: op[1], (mb_in, state)
         )
-        y = call_stage(params, x, m_f_c)
+        if stage_returns_aux:
+            y, aux_f = call_stage(params, x, m_f_c)
+        else:
+            y, aux_f = call_stage(params, x, m_f_c), None
         slot_f = jnp.mod(m_f_c, R)
         saved_x = jax.lax.cond(
             f_active,
@@ -572,6 +612,12 @@ def pipeline_1f1b(
 
         grads_acc = jax.tree.map(jnp.add, grads_acc, dp)
         loss_sum = loss_sum + loss_m
+        if aux_f is not None:
+            # each real microbatch's per-stage aux counts once, at its fwd
+            # unit (the bwd recompute only carries its gradient)
+            loss_sum = loss_sum + jnp.where(
+                f_active, aux_f.astype(loss_sum.dtype), jnp.zeros((), loss_sum.dtype)
+            )
         return (shift_right(y), shift_left(dx), saved_x, grads_acc, loss_sum), None
 
     (_, _, _, grads, loss_sum), _ = jax.lax.scan(
