@@ -624,3 +624,125 @@ def test_gpt_zigzag_ring_matches_serial(devices8, params):
         g_got,
         g_want,
     )
+
+
+def test_gpt_interleaved_1f1b_matches_serial(devices8, params):
+    """INTERLEAVED 1F1B (virtual pipeline stages, num_chunks=2): chunk v of
+    stage s holds layer slab v*P+s, transfers ride CIRCULAR ppermutes (the
+    wrap edge advances a microbatch to its next chunk), and the whole
+    DP=2 x PP=2 x TP=2(+SP) x V=2 composition must trajectory-match the
+    serial model — the scheduler generalization reduces exactly to the
+    classic schedule at V=1, and this goldens the V>1 index math
+    (sigma(v,m) order, mirrored backward, ring slots min(VM, 2PV-1))."""
+    from torchdistpackage_tpu.models import (
+        gpt_interleaved_param_specs,
+        interleave_stage_params,
+    )
+
+    M, mbs, VC = 4, 2, 2
+    tpc.setup_process_groups(
+        [("data", 2), ("pipe", 2), ("tensor", 2)], devices=devices8
+    )
+    mesh = tpc.get_view()
+    iparams = interleave_stage_params(params, VC, 2)
+    specs = gpt_interleaved_param_specs(CFG, tp_axis="tensor")
+
+    def vg_fn(p, batch):
+        return gpt_pipeline_1f1b(
+            p, batch, CFG, num_microbatches=M, tp_axis="tensor", sp=True,
+            num_chunks=VC,
+        )
+
+    opt = optax.sgd(1e-1)
+    dp = DataParallel(mesh=mesh)
+    sharded = dp.broadcast_params(iparams, param_specs=specs)
+    state = opt.init(sharded)
+    step = dp.make_train_step(
+        value_and_grad_fn=vg_fn,
+        optimizer=opt,
+        param_specs=specs,
+        batch_spec={"tokens": P(None, "data"), "targets": P(None, "data")},
+    )
+
+    sparams, sstate = params, opt.init(params)
+
+    def serial_loss(p, batch):
+        losses = [
+            gpt_loss(
+                p,
+                {"tokens": batch["tokens"][m], "targets": batch["targets"][m]},
+                CFG,
+            )
+            for m in range(M)
+        ]
+        return jnp.mean(jnp.stack(losses))
+
+    @jax.jit
+    def serial_step(p, s, b):
+        loss, g = jax.value_and_grad(serial_loss)(p, b)
+        u, s = opt.update(g, s, p)
+        return jax.tree.map(jnp.add, p, u), s, loss
+
+    for i in range(2):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(40 + i))
+        batch = {
+            "tokens": jax.random.randint(k1, (M, mbs * 2, S), 0, CFG.vocab_size),
+            "targets": jax.random.randint(k2, (M, mbs * 2, S), 0, CFG.vocab_size),
+        }
+        sparams, sstate, sloss = serial_step(sparams, sstate, batch)
+        dbatch = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P(None, "data"))), batch
+        )
+        sharded, state, dloss = step(sharded, state, dbatch)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
+
+    # compare per-slab: interleaved blocks [V, P, 1, ...] hold serial layer
+    # v*P + s at [v, s, 0]
+    sblocks = sparams["blocks"]
+    iblocks = sharded["blocks"]
+    for v in range(VC):
+        for st in range(2):
+            g = v * 2 + st
+            np.testing.assert_allclose(
+                np.asarray(iblocks["mlp"]["w1"])[v, st, 0],
+                np.asarray(sblocks["mlp"]["w1"])[g],
+                rtol=1e-4, atol=1e-5,
+                err_msg=f"slab {g} (chunk {v} stage {st}) diverged",
+            )
+    for name in ["tok_emb", "pos_emb", "head"]:
+        np.testing.assert_allclose(
+            np.asarray(sharded[name]), np.asarray(sparams[name]),
+            rtol=1e-4, atol=1e-5, err_msg=f"param divergence at {name}",
+        )
+
+
+def test_gpt_interleaved_requires_divisible_microbatches(devices8, params):
+    """M % P != 0 must be rejected up front (the sigma spacing breaks)."""
+    tpc.setup_process_groups([("pipe", 2)], devices=devices8[:2])
+    mesh = tpc.get_view()
+    from torchdistpackage_tpu.models import (
+        gpt_interleaved_param_specs,
+        interleave_stage_params,
+    )
+
+    iparams = interleave_stage_params(params, 2, 2)
+    specs = gpt_interleaved_param_specs(CFG)
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), iparams, specs
+    )
+    M = 3
+    batch = {
+        "tokens": jnp.zeros((M, 2, S), jnp.int32),
+        "targets": jnp.zeros((M, 2, S), jnp.int32),
+    }
+    with pytest.raises(ValueError, match="divisible by pipe size"):
+        jax.jit(
+            shard_map(
+                lambda p, b: gpt_pipeline_1f1b(
+                    p, b, CFG, num_microbatches=M, num_chunks=2
+                ),
+                mesh=mesh,
+                in_specs=(specs, P()),
+                out_specs=(P(), specs),
+            )
+        )(sharded, batch)

@@ -621,3 +621,134 @@ def test_balanced_stage_stack_with_ring_cp(devices8):
 
     ref_loss = serial_loss(serial_stacked, x, y)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+
+
+def _interleaved_specs(itree, pipe_axis="pipe"):
+    """[V, P, Lc, ...] leaves: shard dim 1 (the stage dim) over pipe."""
+    return jax.tree.map(
+        lambda a: P(None, pipe_axis, *([None] * (a.ndim - 2))), itree
+    )
+
+
+def _interleave(stacked, vv, pp):
+    return jax.tree.map(
+        lambda a: a.reshape(vv, pp, a.shape[0] // (vv * pp), *a.shape[1:]),
+        stacked,
+    )
+
+
+def _interleaved_vg(mesh, specs, M, vv):
+    """shard_map-wrapped (loss, grads) for the INTERLEAVED stage-only 1F1B —
+    identity first_fn, so this also covers the degenerate
+    (first_vjp_in_cond=False) path under V > 1."""
+
+    def first_fn(params, mb):
+        return mb
+
+    def last_fn(params, yy, tgt):
+        return jnp.mean((yy - tgt) ** 2)
+
+    def stage_fn(params, h, m, v):
+        slab = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, v, 0, keepdims=False)[0],
+            params,
+        )
+
+        def body(h, lp):
+            return block_forward(lp, h, CFG), None
+
+        out, _ = jax.lax.scan(body, h, slab)
+        return out
+
+    def vg(params, xx, yy):
+        return shard_map(
+            functools.partial(
+                pipeline_1f1b,
+                first_fn=first_fn,
+                stage_fn=stage_fn,
+                last_fn=last_fn,
+                num_microbatches=M,
+                num_chunks=vv,
+            ),
+            mesh=mesh,
+            in_specs=(specs, P(), P()),
+            out_specs=(P(), specs),
+        )(params, xx, yy)
+
+    return vg
+
+
+@pytest.mark.parametrize("pp,vv,m", [(2, 2, 4), (2, 2, 2), (4, 2, 4), (2, 4, 6)])
+def test_interleaved_1f1b_matches_serial(devices8, pp, vv, m):
+    """The interleaved (virtual-chunk) schedule's (loss, grads) must equal
+    serial AD exactly for every (P, V, M) shape — chunk v of stage s holds
+    layer slab v*P+s, so the round-robin reassembly must reproduce the
+    serial layer order.  The stack is built with L = P*V layers (one per
+    slab) so deep-pipeline cases run too."""
+    tpc.setup_process_groups([("pipe", pp)], devices=devices8[:pp])
+    mesh = tpc.get_view()
+    keys = jax.random.split(jax.random.PRNGKey(0), pp * vv)
+    layers = [init_block_params(k, CFG) for k in keys]
+    stacked = stack_stage_params(layers)
+    itree = _interleave(stacked, vv, pp)
+    specs = _interleaved_specs(itree)
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), itree, specs
+    )
+    x = jax.random.normal(jax.random.PRNGKey(1), (m, MBS, S, CFG.dim))
+    y = jax.random.normal(jax.random.PRNGKey(2), (m, MBS, S, CFG.dim))
+
+    loss, grads = jax.jit(_interleaved_vg(mesh, specs, m, vv))(sharded, x, y)
+
+    def serial_loss(stacked_flat, xx, yy):
+        def one(xm, ym):
+            h = xm
+            def body(h, lp):
+                return block_forward(lp, h, CFG), None
+            out, _ = jax.lax.scan(body, h, stacked_flat)
+            return jnp.mean((out - ym) ** 2)
+
+        return jnp.mean(jax.vmap(one)(xx, yy))
+
+    want_loss, want_g = jax.value_and_grad(serial_loss)(stacked, x, y)
+    np.testing.assert_allclose(float(loss), float(want_loss), rtol=2e-5, atol=1e-6)
+    got_flat = jax.tree.map(
+        lambda a: np.asarray(a).reshape(-1, *a.shape[3:]), grads
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        got_flat,
+        want_g,
+    )
+
+
+def test_interleaved_1f1b_ring_memory_bounded(devices8):
+    """Interleaved memory guarantee: the scan carries ring_slots(M, P, V) =
+    min(VM, 2PV-1) chunk inputs — NOT V*M of them."""
+    pp, vv, m = 2, 2, 8
+    R = ring_slots(m, pp, vv)
+    assert R == 7 < vv * m
+    tpc.setup_process_groups([("pipe", pp)], devices=devices8[:pp])
+    mesh = tpc.get_view()
+    _, stacked = _layers_and_stack()
+    itree = _interleave(stacked, vv, pp)
+    specs = _interleaved_specs(itree)
+    stacked_shapes = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), itree
+    )
+    x = jax.ShapeDtypeStruct((m, MBS, S, CFG.dim), jnp.float32)
+    y = jax.ShapeDtypeStruct((m, MBS, S, CFG.dim), jnp.float32)
+
+    jaxpr = jax.make_jaxpr(_interleaved_vg(mesh, specs, m, vv))(
+        stacked_shapes, x, y
+    ).jaxpr
+    carries = _scan_carry_avals(jaxpr)
+    ring = [a for a in carries if a.shape == (R, MBS, S, CFG.dim)]
+    assert ring, f"expected a ring-buffer carry of shape {(R, MBS, S, CFG.dim)}"
+    leaked = [
+        a for a in carries
+        if jnp.issubdtype(a.dtype, jnp.floating) and a.shape[:1] == (vv * m,)
+    ]
+    assert not leaked, f"O(VM) float buffers carried through the scan: {leaked}"

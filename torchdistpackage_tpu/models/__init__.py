@@ -1,11 +1,13 @@
 from .gpt import (
     GPTConfig,
     gpt_forward,
+    gpt_interleaved_param_specs,
     gpt_loss,
     gpt_param_specs,
     gpt_pipeline_1f1b,
     gpt_pipeline_loss,
     init_gpt_params,
+    interleave_stage_params,
     vocab_parallel_embed,
     vocab_parallel_xent,
 )

@@ -375,6 +375,46 @@ def gpt_pipeline_loss(
     )
 
 
+def interleave_stage_params(
+    params: Dict[str, PyTree], num_chunks: int, pipe_size: int
+) -> Dict[str, PyTree]:
+    """Reshape the ``[L, ...]``-stacked block leaves into the interleaved
+    pipeline layout ``[V, P, L/(P*V), ...]``: chunk v of stage s holds global
+    layer slab ``v*P + s`` (round-robin — exactly the reshape's index
+    decomposition, v major).  Shard dim 1 over the pipe axis
+    (:func:`gpt_interleaved_param_specs`)."""
+
+    def r(a):
+        L = a.shape[0]
+        if L % (num_chunks * pipe_size) != 0:
+            raise ValueError(
+                f"nlayers {L} not divisible by num_chunks*pipe "
+                f"({num_chunks}*{pipe_size})"
+            )
+        return a.reshape(
+            num_chunks, pipe_size, L // (num_chunks * pipe_size), *a.shape[1:]
+        )
+
+    return {**params, "blocks": jax.tree.map(r, params["blocks"])}
+
+
+def gpt_interleaved_param_specs(
+    cfg: GPTConfig,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+) -> Dict[str, PyTree]:
+    """Specs for the :func:`interleave_stage_params` layout: block leaves are
+    ``[V, P, Lc, ...]`` with dim 1 (the stage dim) sharded over ``pipe``."""
+    base = gpt_param_specs(cfg, tp_axis=tp_axis, pipe_axis=None)
+    blocks = jax.tree.map(
+        # [L, ...] spec (None, *dims) -> [V, P, Lc, ...] spec
+        lambda s: P(None, pipe_axis, None, *tuple(s)[1:]),
+        base["blocks"],
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return {**base, "blocks": blocks}
+
+
 def gpt_pipeline_1f1b(
     params: Dict[str, PyTree],
     batch: Dict[str, jnp.ndarray],
@@ -385,6 +425,7 @@ def gpt_pipeline_1f1b(
     sp: bool = False,
     remat: bool = True,
     dropout_key: Optional[jax.Array] = None,
+    num_chunks: int = 1,
 ):
     """1F1B-scheduled GPT training step core: returns ``(loss, grads)``
     directly (do NOT wrap in ``jax.grad`` — see
@@ -406,6 +447,11 @@ def gpt_pipeline_1f1b(
     distinct mask, and the 1F1B backward's recompute replays the exact same
     chain deterministically.  Derive the key per the usual recipe
     (``axis_unique_key(key, 'data')``) so data shards differ too.
+
+    ``num_chunks`` (V > 1) runs the INTERLEAVED schedule (virtual pipeline
+    stages — see ``pipeline_1f1b``): pass params in the
+    :func:`interleave_stage_params` layout with
+    :func:`gpt_interleaved_param_specs`; requires ``M % pipe == 0``.
     """
 
     def first_fn(p, toks):
@@ -414,14 +460,37 @@ def gpt_pipeline_1f1b(
             h = split_to_sp(h, tp_axis)
         return h
 
-    def stage_fn(p, x, m):
+    def fold_key(m, extra):
         k = None
         if dropout_key is not None and cfg.dropout_rate > 0.0:
             k = jax.random.fold_in(dropout_key, jax.lax.axis_index(pipe_axis))
             k = jax.random.fold_in(k, m)
-        return scan_blocks(
-            p["blocks"], x, cfg.block, tp_axis, sp, remat=remat, dropout_key=k
-        )
+            if extra is not None:
+                k = jax.random.fold_in(k, extra)
+        return k
+
+    if num_chunks == 1:
+
+        def stage_fn(p, x, m):
+            return scan_blocks(
+                p["blocks"], x, cfg.block, tp_axis, sp, remat=remat,
+                dropout_key=fold_key(m, None),
+            )
+
+    else:
+
+        def stage_fn(p, x, m, v):
+            # local leaves are [V, 1, Lc, ...]; select chunk v's slab
+            slab = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, v, axis=0, keepdims=False
+                )[0],
+                p["blocks"],
+            )
+            return scan_blocks(
+                slab, x, cfg.block, tp_axis, sp, remat=remat,
+                dropout_key=fold_key(m, v),
+            )
 
     def last_fn(p, y, tgt):
         logits = gpt_head(p, y, tp_axis, sp)
@@ -437,6 +506,7 @@ def gpt_pipeline_1f1b(
         num_microbatches=num_microbatches,
         pipe_axis=pipe_axis,
         stage_takes_mb=True,
+        num_chunks=num_chunks,
     )
 
 

@@ -127,20 +127,31 @@ def last_stage_value(x, pipe_axis: str = PIPE_AXIS):
     return jax.lax.psum(jnp.where(is_last_stage(pipe_axis), x, jnp.zeros_like(x)), pipe_axis)
 
 
-def shift_right(x, pipe_axis: str = PIPE_AXIS):
-    """Send to the next stage (non-circular): stage s's value arrives at s+1;
-    stage 0 receives zeros.  The ppermute analogue of
-    send_forward/recv_forward (comm.py:362-435)."""
+def shift_right(x, pipe_axis: str = PIPE_AXIS, circular: bool = False):
+    """Send to the next stage: stage s's value arrives at s+1.  Non-circular
+    (default): stage 0 receives zeros — the ppermute analogue of
+    send_forward/recv_forward (comm.py:362-435).  ``circular``: stage 0
+    receives stage P-1's value — the wrap edge of the interleaved (virtual
+    chunk) schedule, carrying a finished chunk's activation back to stage 0
+    as the next chunk's input."""
     n = jax.lax.axis_size(pipe_axis)
-    return jax.lax.ppermute(x, pipe_axis, [(i, i + 1) for i in range(n - 1)])
+    last_edge = [(n - 1, 0)] if circular else []
+    return jax.lax.ppermute(
+        x, pipe_axis, [(i, i + 1) for i in range(n - 1)] + last_edge
+    )
 
 
-def shift_left(x, pipe_axis: str = PIPE_AXIS):
-    """Send to the previous stage (non-circular): stage s's value arrives at
-    s-1; the last stage receives zeros.  The cotangent channel of the 1F1B
-    schedule — analogue of send_backward/recv_backward (comm.py:362-435)."""
+def shift_left(x, pipe_axis: str = PIPE_AXIS, circular: bool = False):
+    """Send to the previous stage: stage s's value arrives at s-1.  The
+    cotangent channel of the 1F1B schedule — analogue of
+    send_backward/recv_backward (comm.py:362-435).  ``circular``: stage P-1
+    receives stage 0's value (the wrap cotangent from chunk v+1 back to
+    chunk v under the interleaved schedule)."""
     n = jax.lax.axis_size(pipe_axis)
-    return jax.lax.ppermute(x, pipe_axis, [(i, i - 1) for i in range(1, n)])
+    wrap_edge = [(0, n - 1)] if circular else []
+    return jax.lax.ppermute(
+        x, pipe_axis, [(i, i - 1) for i in range(1, n)] + wrap_edge
+    )
 
 
 def _pipeline_scan(
@@ -329,16 +340,22 @@ def pipeline_loss(
 # --------------------------------------------------------------------- 1F1B
 
 
-def ring_slots(num_microbatches: int, pipe_size: int) -> int:
-    """Stage-input slots the 1F1B schedule keeps live: ``min(M, 2P-1)``.
+def ring_slots(num_microbatches: int, pipe_size: int, num_chunks: int = 1) -> int:
+    """Stage-input slots the 1F1B schedule keeps live:
+    ``min(V*M, 2*P*V - 1)`` (``V = num_chunks``; classic ``min(M, 2P-1)`` at
+    V=1).
 
     This is the schedule's memory guarantee — peak in-flight activations are
-    bounded by the pipeline depth, NOT the microbatch count (the property the
-    reference's steady-state 1F1B interleave exists for,
-    pipeline_parallel/pipeline_sched.py:163-211).  Stage s holds at most
-    ``2*(P-1-s)+1`` inputs; the SPMD program sizes the buffer for the worst
-    stage."""
-    return min(num_microbatches, 2 * pipe_size - 1)
+    bounded by the pipeline depth (x the chunk count under interleaving),
+    NOT the microbatch count (the property the reference's steady-state 1F1B
+    interleave exists for, pipeline_parallel/pipeline_sched.py:163-211).
+    Derivation: unit k's slot may be overwritten only after unit ``k - R``'s
+    backward, and ``t_f(k) - t_b(k-R)`` >= 0 for every (stage, chunk) iff
+    ``R >= (P-1-2s) + (V-1-2v)P + PV``, maximized at s=0, v=0 as
+    ``2PV - 1``."""
+    return min(
+        num_microbatches * num_chunks, 2 * pipe_size * num_chunks - 1
+    )
 
 
 def pipeline_1f1b(
@@ -352,6 +369,7 @@ def pipeline_1f1b(
     pipe_axis: str = PIPE_AXIS,
     stage_takes_mb: bool = False,
     stage_returns_aux: bool = False,
+    num_chunks: int = 1,
 ):
     """One-forward-one-backward pipeline schedule: returns ``(loss, grads)``
     directly (do NOT wrap in ``jax.grad`` — the backward pipeline runs inside).
@@ -398,16 +416,45 @@ def pipeline_1f1b(
     differentiated as one expression.  ``aux`` must already carry whatever
     weight the caller wants (the returned loss is ``mean_m [CE_m +
     sum_stages aux_{s,m}]``).
+
+    ``num_chunks`` (V > 1): the **interleaved schedule** (virtual pipeline
+    stages, the Megatron-style bubble reduction): each physical stage holds
+    V model chunks — chunk v of stage s is global layer-slab ``v*P + s``
+    (round-robin) — and ``stage_fn(params, x, m, v)`` additionally receives
+    the chunk index to select its slab.  Forward unit order per stage is
+    ``sigma(v, m) = (m // P)*P*V + v*P + (m % P)`` (groups of P microbatches
+    sweep all chunks before the next group — requires ``M % P == 0``, as
+    Megatron's interleaved schedule does); the backward mirrors it with the
+    chunk order reversed.  Inter-stage transfer becomes a CIRCULAR ppermute:
+    the P-1 -> 0 wrap edge carries a finished chunk's activation back as the
+    next chunk's input (and stage 0's cotangent back to stage P-1), and the
+    schedule arithmetic guarantees each wrap payload arrives exactly one
+    tick before its consumer.  Total ticks ``VM + PV + P - 2`` of 1/V-sized
+    units vs ``V(M + 2P - 2)`` chunk-equivalents non-interleaved — the
+    fill/drain bubble shrinks whenever ``P + 2V - 2 < PV`` (any P >= 3); the
+    price is the deeper ring buffer, ``min(VM, 2PV-1)`` slots of 1 chunk's
+    activation each (:func:`ring_slots`).  At V=1 every formula reduces to
+    the classic schedule above.
     """
     from ..data_parallel import _mark_varying, _vma, pvary_params
 
     M = num_microbatches
+    V = num_chunks
     P_ = jax.lax.axis_size(pipe_axis)
-    R = ring_slots(M, P_)
-    T = M + 2 * (P_ - 1)
+    if V < 1:
+        raise ValueError(f"num_chunks must be >= 1, got {V}")
+    if V > 1 and M % P_ != 0:
+        raise ValueError(
+            f"the interleaved schedule requires num_microbatches ({M}) "
+            f"divisible by pipe size ({P_}): the last microbatch group would "
+            f"otherwise break the sigma(v, m) dependency spacing"
+        )
+    R = ring_slots(M, P_, V)
+    T = V * M + P_ * V + P_ - 2  # == M + 2(P-1) at V=1
     s = jax.lax.axis_index(pipe_axis)
     first = is_first_stage(pipe_axis)
     last = is_last_stage(pipe_axis)
+    circular = V > 1
 
     # Mark params pipe-varying so every vjp below yields LOCAL per-stage
     # grads (no implicit psum inside the scan's conds, where a pipe
@@ -419,11 +466,14 @@ def pipeline_1f1b(
     # ``stage_takes_mb``: stage_fn(params, x, m) also receives the microbatch
     # index m (int32, < M) — for per-microbatch stage behavior such as
     # dropout keys.  The bwd recompute replays the same m, so key-derived
-    # masks are identical between forward and recompute.
-    if stage_takes_mb:
-        call_stage = stage_fn
+    # masks are identical between forward and recompute.  With V > 1 the
+    # stage fn must take (p, x, m, v) — v selects the chunk's param slab.
+    if V > 1:
+        call_stage = stage_fn  # (p, x, m, v)
+    elif stage_takes_mb:
+        call_stage = lambda p, x, m, v: stage_fn(p, x, m)
     else:
-        call_stage = lambda p, x, m: stage_fn(p, x)
+        call_stage = lambda p, x, m, v: stage_fn(p, x)
 
     take_mb = lambda tree, i: jax.tree.map(
         lambda a: jax.lax.dynamic_index_in_dim(a, i, axis=0, keepdims=False), tree
@@ -441,7 +491,10 @@ def pipeline_1f1b(
         missing = tuple(a for a in want_vma if a not in _vma(zero_state))
         if missing:
             zero_state = _mark_varying(zero_state, missing)
-        out_shape = jax.eval_shape(call_stage, params, zero_state, jnp.zeros((), jnp.int32))
+        out_shape = jax.eval_shape(
+            call_stage, params, zero_state,
+            jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+        )
         y_shape, aux_shape = out_shape if stage_returns_aux else (out_shape, None)
         new_want = frozenset(getattr(y_shape, "vma", frozenset())) | want_vma
         if new_want == want_vma:
@@ -462,14 +515,14 @@ def pipeline_1f1b(
 
     # ---- one backward unit of work (runs under lax.cond when bwd is active)
     def run_bwd(opers):
-        x_saved, cot_in, mb_tgt, mb_in, m_b = opers
+        x_saved, cot_in, mb_tgt, mb_in, m_b, v_b = opers
         if stage_returns_aux:
             (y_, aux_), vjp_stage = jax.vjp(
-                lambda p, xx: call_stage(p, xx, m_b), params, x_saved
+                lambda p, xx: call_stage(p, xx, m_b, v_b), params, x_saved
             )
         else:
             y_, vjp_stage = jax.vjp(
-                lambda p, xx: call_stage(p, xx, m_b), params, x_saved
+                lambda p, xx: call_stage(p, xx, m_b, v_b), params, x_saved
             )
 
         def last_branch(op):
@@ -489,8 +542,11 @@ def pipeline_1f1b(
             zl, zp, _ = _zeros_like_shapes(last_shapes)
             return zl, zp, cot_in
 
+        # the loss seed lives on the LAST chunk of the last stage (chunk
+        # V-1 is the model's tail under the round-robin slab assignment)
         loss_m, dp_last, g = jax.lax.cond(
-            last, last_branch, mid_branch, (y_, mb_tgt, cot_in)
+            jnp.logical_and(last, v_b == V - 1),
+            last_branch, mid_branch, (y_, mb_tgt, cot_in)
         )
 
         if stage_returns_aux:
@@ -513,8 +569,10 @@ def pipeline_1f1b(
                 return dp_first
 
             first_shapes = jax.eval_shape(first_branch, (mb_in, dx))
+            # the embed's vjp belongs to stage 0's CHUNK-0 units only (the
+            # model's head-end slab); wrap units (v > 0) pass dx upstream
             dp_first = jax.lax.cond(
-                first,
+                jnp.logical_and(first, v_b == 0),
                 first_branch,
                 lambda op: _zeros_like_shapes(first_shapes),
                 (mb_in, dx),
@@ -533,7 +591,9 @@ def pipeline_1f1b(
     )
     cot0 = zero_state
     bwd_shapes = jax.eval_shape(
-        run_bwd, (zero_state, cot0, mb0_tgt, mb0_in, jnp.zeros((), jnp.int32))
+        run_bwd,
+        (zero_state, cot0, mb0_tgt, mb0_in,
+         jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)),
     )
     # the loss accumulator inherits the TRUE loss aval's varying axes (e.g. a
     # vocab-parallel CE has already psum-ed over 'tensor', so the loss must
@@ -550,19 +610,25 @@ def pipeline_1f1b(
     def tick(carry, t):
         state, cot_state, saved_x, grads_acc, loss_sum = carry
 
-        # -------- forward unit
-        m_f = t - s
-        f_active = (m_f >= 0) & (m_f < M)
-        m_f_c = jnp.clip(m_f, 0, M - 1)
+        # -------- forward unit: stage s runs its k-th fwd unit at tick s+k,
+        # with (chunk, microbatch) = sigma^-1(k); V=1 degenerates to the
+        # classic wavefront m_f = t - s
+        k_f = t - s
+        f_active = (k_f >= 0) & (k_f < V * M)
+        k_f_c = jnp.clip(k_f, 0, V * M - 1)
+        r_f = jnp.remainder(k_f_c, P_ * V)
+        v_f = r_f // P_
+        m_f_c = (k_f_c // (P_ * V)) * P_ + jnp.remainder(r_f, P_)
         mb_in = take_mb(inputs, m_f_c)
         x = jax.lax.cond(
-            first, lambda op: first_v(params, op[0]), lambda op: op[1], (mb_in, state)
+            jnp.logical_and(first, v_f == 0),
+            lambda op: first_v(params, op[0]), lambda op: op[1], (mb_in, state)
         )
         if stage_returns_aux:
-            y, aux_f = call_stage(params, x, m_f_c)
+            y, aux_f = call_stage(params, x, m_f_c, v_f)
         else:
-            y, aux_f = call_stage(params, x, m_f_c), None
-        slot_f = jnp.mod(m_f_c, R)
+            y, aux_f = call_stage(params, x, m_f_c, v_f), None
+        slot_f = jnp.remainder(k_f_c, R)
         saved_x = jax.lax.cond(
             f_active,
             lambda b: jax.lax.dynamic_update_index_in_dim(b, x, slot_f, axis=0),
@@ -570,15 +636,21 @@ def pipeline_1f1b(
             saved_x,
         )
 
-        # -------- backward unit
-        m_b = t - 2 * (P_ - 1) + s
-        b_active = (m_b >= 0) & (m_b < M)
-        m_b_c = jnp.clip(m_b, 0, M - 1)
+        # -------- backward unit: mirrored order (chunks reversed), delayed
+        # by the first microbatch's full-model forward (PV - 1 ticks)
+        k_b = t - (P_ - 1 - s) - (P_ * V - 1)
+        b_active = (k_b >= 0) & (k_b < V * M)
+        k_b_c = jnp.clip(k_b, 0, V * M - 1)
+        r_b = jnp.remainder(k_b_c, P_ * V)
+        v_b = (V - 1) - r_b // P_
+        m_b_c = (k_b_c // (P_ * V)) * P_ + jnp.remainder(r_b, P_)
+        # the unit's own fwd counter locates its ring-buffer slot
+        k_unit = (k_b_c // (P_ * V)) * (P_ * V) + v_b * P_ + jnp.remainder(r_b, P_)
         x_saved = jax.lax.dynamic_index_in_dim(
-            saved_x, jnp.mod(m_b_c, R), axis=0, keepdims=False
+            saved_x, jnp.remainder(k_unit, R), axis=0, keepdims=False
         )
         mb_in_b = take_mb(inputs, m_b_c)
-        opers = (x_saved, cot_state, take_mb(targets, m_b_c), mb_in_b, m_b_c)
+        opers = (x_saved, cot_state, take_mb(targets, m_b_c), mb_in_b, m_b_c, v_b)
         # Run the bwd unit UNCONDITIONALLY and mask the accumulation, the
         # same uniform-body rule the forward follows (line `y = stage_fn`
         # above): ``b_active`` is pipe-varying, and a collective inside a
@@ -586,7 +658,8 @@ def pipeline_1f1b(
         # particular is a FULL-mesh rendezvous, so a ring-attention stage
         # (ppermute over 'context') inside ``cond(b_active, ...)`` deadlocks
         # or silently corrupts.  The extra recompute+bwd FLOPs are paid only
-        # on the 2(P-1) fill/drain ticks where b_active is false anyway.
+        # on the PV+P-2 fill/drain ticks (2(P-1) at V=1) where b_active is
+        # false anyway.
         loss_m, dp, dx = run_bwd(opers)
         mask_b = lambda g: jnp.where(b_active, g, jnp.zeros((), g.dtype))
         loss_m = mask_b(loss_m)
@@ -599,7 +672,7 @@ def pipeline_1f1b(
             # unconditionally.  Mask the cotangent to stage 0's bwd window
             # before, and the (pipe-replicated) grad after, so the final sync
             # psum yields exactly stage 0's contribution.
-            gate = jnp.logical_and(first, b_active)
+            gate = jnp.logical_and(jnp.logical_and(first, v_b == 0), b_active)
             dxm = jax.tree.map(
                 lambda a: jnp.where(gate, a, jnp.zeros((), a.dtype)), dx
             )
@@ -618,7 +691,11 @@ def pipeline_1f1b(
             loss_sum = loss_sum + jnp.where(
                 f_active, aux_f.astype(loss_sum.dtype), jnp.zeros((), loss_sum.dtype)
             )
-        return (shift_right(y), shift_left(dx), saved_x, grads_acc, loss_sum), None
+        return (
+            shift_right(y, pipe_axis, circular=circular),
+            shift_left(dx, pipe_axis, circular=circular),
+            saved_x, grads_acc, loss_sum,
+        ), None
 
     (_, _, _, grads, loss_sum), _ = jax.lax.scan(
         tick, (zero_state, cot0, saved0, grads0, loss0), jnp.arange(T)
