@@ -3,11 +3,13 @@ TP(+SP) GPT training.
 
 Each physical pipeline stage holds ``V = 2`` model chunks (chunk v of stage
 s = layer slab ``v*P + s``); transfers ride circular ppermutes whose wrap
-edge advances a microbatch to its next chunk, shrinking the fill/drain
-bubble from ``2(P-1)V`` to ``PV+P-2`` chunk-ticks (see
-``parallel/pipeline_parallel/pipeline_sched.py``).  A capability BEYOND the
-reference, whose scheduler is classic single-chunk 1F1B
-(pipeline_parallel/pipeline_sched.py:94-228).
+edge advances a microbatch to its next chunk.  The fill/drain bubble is
+``PV+P-2`` chunk-ticks vs ``2(P-1)V`` for classic 1F1B — a reduction for
+P >= 3 (at this demo's P=2 both equal 4: the example shows the MECHANICS
+on a small mesh; the bubble win needs deeper pipelines — see
+``parallel/pipeline_parallel/pipeline_sched.py`` and docs/parallelism.md).
+A capability BEYOND the reference, whose scheduler is classic single-chunk
+1F1B (pipeline_parallel/pipeline_sched.py:94-228).
 
 - real TPU chips:      python examples/train_interleaved_pipeline.py
 - 8-device CPU sim:    TDP_CPU_SIM=8 python examples/train_interleaved_pipeline.py
