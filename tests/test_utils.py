@@ -243,3 +243,33 @@ def test_prefetch_to_sharding(devices8):
 
     with _pytest.raises(ValueError, match="not divisible"):
         microbatch(batches[0], 5)
+
+
+def test_global_batch_from_local_single_process(devices8):
+    """Single-process degenerate case: global_batch_from_local must produce
+    exactly what shard_batch does (same values, same shardings) — the
+    multi-host path's contract is 'identical result, no full-batch host
+    copy', which single-process CI can check for the value half."""
+    import numpy as np
+
+    from torchdistpackage_tpu.utils import global_batch_from_local, shard_batch
+
+    tpc.setup_process_groups([("data", 4), ("tensor", 2)], devices=devices8)
+    mesh = tpc.get_view()
+    batch = {
+        "x": np.arange(8 * 4, dtype=np.float32).reshape(8, 4),
+        "y": np.arange(8, dtype=np.int32),
+    }
+    got = global_batch_from_local(batch, mesh, P("data"))
+    want = shard_batch(batch, mesh, P("data"))
+    for k in batch:
+        assert got[k].sharding == want[k].sharding, k
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(want[k]))
+
+    # per-leaf spec tree variant
+    got2 = global_batch_from_local(
+        batch, mesh, {"x": P(("data", "tensor")), "y": P()}
+    )
+    assert got2["x"].sharding.spec == P(("data", "tensor"))
+    assert got2["y"].sharding.spec == P()
+    np.testing.assert_array_equal(np.asarray(got2["x"]), batch["x"])

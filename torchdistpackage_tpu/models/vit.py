@@ -108,19 +108,14 @@ def init_vit_params(key, cfg: ViTConfig) -> Dict[str, PyTree]:
     }
 
 
-def vit_forward(
+def vit_embed(
     params: Dict[str, PyTree],
     images: jnp.ndarray,
     cfg: ViTConfig,
-    axis: Optional[str] = None,
-    sp: bool = False,
-    remat: bool = False,
-    dropout_key = None,
 ) -> jnp.ndarray:
-    """[B, H, W, C] images -> [B, num_classes] logits.  TP(/SP) over ``axis``
-    inside shard_map, serial when None — same contract as gpt_forward."""
-    from ..parallel.tensor_parallel import scan_blocks
-
+    """[B, H, W, C] images -> [B, N(/cp), D] patch embedding — shared by
+    :func:`vit_forward` and the pipeline's stage-0 ``first_fn`` (one
+    implementation, no drift)."""
     x = patchify(images.astype(cfg.dtype), cfg.patch_size)
     cp = cfg.context_axis if cfg.attn_impl in ("ring", "ulysses") else None
     if cp is not None:
@@ -138,25 +133,54 @@ def vit_forward(
         off = jax.lax.axis_index(cp) * s_loc
         x = jax.lax.dynamic_slice_in_dim(x, off, s_loc, axis=1)
         h = x @ params["patch_proj"]["w"] + params["patch_proj"]["b"]
-        h = h + jax.lax.dynamic_slice_in_dim(params["pos_emb"], off, s_loc, axis=0)
-    else:
-        h = x @ params["patch_proj"]["w"] + params["patch_proj"]["b"]
-        h = h + params["pos_emb"]
-    if axis is not None and sp:
-        from ..parallel.tensor_parallel import split_to_sp
+        return h + jax.lax.dynamic_slice_in_dim(params["pos_emb"], off, s_loc, axis=0)
+    h = x @ params["patch_proj"]["w"] + params["patch_proj"]["b"]
+    return h + params["pos_emb"]
 
-        h = split_to_sp(h, axis)
-    h = scan_blocks(params["blocks"], h, cfg.block, axis, sp, remat=remat,
-                    dropout_key=dropout_key)
+
+def vit_pool_logits(
+    params: Dict[str, PyTree],
+    h: jnp.ndarray,
+    cfg: ViTConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+) -> jnp.ndarray:
+    """Post-blocks hidden -> [B, num_classes(/tp)] logits (SP gather, final
+    LN, patch mean-pool with the CP mean-of-means, class head) — shared by
+    :func:`vit_forward` and the pipeline's last stage."""
     if axis is not None and sp:
         from ..parallel.tensor_parallel import gather_from_sp
 
         h = gather_from_sp(h, axis)
     h = layer_norm(h, params["ln_f"])
     pooled = jnp.mean(h, axis=1)  # mean-pool over (local) patches
+    cp = cfg.context_axis if cfg.attn_impl in ("ring", "ulysses") else None
     if cp is not None:
         pooled = jax.lax.pmean(pooled, cp)  # equal chunks: mean of means
     return pooled @ params["head"]["w"] + params["head"]["b"]
+
+
+def vit_forward(
+    params: Dict[str, PyTree],
+    images: jnp.ndarray,
+    cfg: ViTConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    remat: bool = False,
+    dropout_key = None,
+) -> jnp.ndarray:
+    """[B, H, W, C] images -> [B, num_classes] logits.  TP(/SP) over ``axis``
+    inside shard_map, serial when None — same contract as gpt_forward."""
+    from ..parallel.tensor_parallel import scan_blocks
+
+    h = vit_embed(params, images, cfg)
+    if axis is not None and sp:
+        from ..parallel.tensor_parallel import split_to_sp
+
+        h = split_to_sp(h, axis)
+    h = scan_blocks(params["blocks"], h, cfg.block, axis, sp, remat=remat,
+                    dropout_key=dropout_key)
+    return vit_pool_logits(params, h, cfg, axis=axis, sp=sp)
 
 
 def vit_loss(
@@ -181,12 +205,17 @@ def vit_loss(
     return vocab_parallel_xent(logits, batch["labels"], tp)
 
 
-def vit_param_specs(cfg: ViTConfig, tp_axis: Optional[str] = None) -> Dict[str, PyTree]:
+def vit_param_specs(
+    cfg: ViTConfig,
+    tp_axis: Optional[str] = None,
+    pipe_axis: Optional[str] = None,
+) -> Dict[str, PyTree]:
     """PartitionSpec tree matching :func:`init_vit_params`: per-block TP specs
-    with a leading None for the layer-stack dim; class-sharded head when the
-    class count divides the TP size (else keep the head replicated by passing
-    specs with ``head`` overridden to P())."""
-    blocks = stacked_block_specs(tp_axis, stack_axis=None)
+    with a leading stack-dim entry (``pipe_axis`` shards the stack for
+    pipelining, None replicates it); class-sharded head when the class count
+    divides the TP size (else keep the head replicated by passing specs with
+    ``head`` overridden to P())."""
+    blocks = stacked_block_specs(tp_axis, stack_axis=pipe_axis)
     head_w = P(None, tp_axis) if tp_axis else P()
     head_b = P(tp_axis) if tp_axis else P()
     return {
@@ -196,3 +225,71 @@ def vit_param_specs(cfg: ViTConfig, tp_axis: Optional[str] = None) -> Dict[str, 
         "ln_f": {"scale": P(), "bias": P()},
         "head": {"w": head_w, "b": head_b},
     }
+
+
+def vit_pipeline_1f1b(
+    params: Dict[str, PyTree],
+    batch: Dict[str, jnp.ndarray],
+    cfg: ViTConfig,
+    num_microbatches: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+    sp: bool = False,
+    remat: bool = True,
+    dropout_key: Optional[jax.Array] = None,
+):
+    """1F1B-scheduled ViT training core: returns ``(loss, grads)`` (see
+    ``parallel.pipeline_parallel.pipeline_1f1b``).  The reference's PP
+    example pipelines a VISION classifier
+    (examples/model_parallel/test_pipeline.py:54-123, DummyClsDataset) — this
+    is that capability on the native ViT: stage 0 embeds
+    (:func:`vit_embed`), the block stack is the pipelined region, the last
+    stage pools + classifies (:func:`vit_pool_logits`).
+
+    ``batch``: {'images': [M, mbs, H, W, C], 'labels': int [M, mbs]}.
+    Params use :func:`vit_param_specs` with ``pipe_axis`` set.
+    ``dropout_key`` threads residual dropout through the pipeline with
+    per-(stage, microbatch, layer) masks, same recipe as
+    ``gpt_pipeline_1f1b``."""
+    from ..parallel.pipeline_parallel import pipeline_1f1b
+    from ..parallel.tensor_parallel import scan_blocks, split_to_sp
+    from .gpt import vocab_parallel_xent
+
+    if cfg.context_axis is not None:
+        raise NotImplementedError(
+            "vit_pipeline_1f1b does not compose with context parallelism "
+            "yet: stage 0 would need per-CP-rank patch slicing inside the "
+            "schedule (the GPT family supports CPxPP via gpt_pipeline_1f1b)"
+        )
+
+    def first_fn(p, images):
+        h = vit_embed(p, images, cfg)
+        if tp_axis is not None and sp:
+            h = split_to_sp(h, tp_axis)
+        return h
+
+    def stage_fn(p, x, m):
+        k = None
+        if dropout_key is not None and cfg.dropout_rate > 0.0:
+            k = jax.random.fold_in(dropout_key, jax.lax.axis_index(pipe_axis))
+            k = jax.random.fold_in(k, m)
+        return scan_blocks(
+            p["blocks"], x, cfg.block, tp_axis, sp, remat=remat, dropout_key=k
+        )
+
+    def last_fn(p, y, labels):
+        logits = vit_pool_logits(p, y, cfg, axis=tp_axis, sp=sp)
+        tp = tp_axis if logits.shape[-1] != cfg.num_classes else None
+        return vocab_parallel_xent(logits, labels, tp)
+
+    return pipeline_1f1b(
+        params,
+        batch["images"],
+        batch["labels"],
+        first_fn=first_fn,
+        stage_fn=stage_fn,
+        last_fn=last_fn,
+        num_microbatches=num_microbatches,
+        pipe_axis=pipe_axis,
+        stage_takes_mb=True,
+    )

@@ -5,7 +5,12 @@ Analogue of the reference's ``utils.py`` (fix_rand + partition_params) and
 inf/nan probe, master-only print).
 """
 
-from .data import microbatch, prefetch_to_sharding, shard_batch
+from .data import (
+    global_batch_from_local,
+    microbatch,
+    prefetch_to_sharding,
+    shard_batch,
+)
 from .random import fix_rand, axis_unique_key, per_axis_keys
 from .partition import partition_params
 from .logging import (
@@ -29,6 +34,10 @@ from .checkpoint import (
 )
 
 __all__ = [
+    "global_batch_from_local",
+    "microbatch",
+    "prefetch_to_sharding",
+    "shard_batch",
     "fix_rand",
     "axis_unique_key",
     "per_axis_keys",

@@ -62,6 +62,36 @@ def prefetch_to_sharding(
         yield buf.popleft()
 
 
+def global_batch_from_local(
+    local_batch: PyTree, mesh: Mesh, spec: PyTree
+) -> PyTree:
+    """Assemble a GLOBAL jax.Array batch from each process's LOCAL shard —
+    the multi-host input path (``jax.make_array_from_process_local_data``).
+
+    On a multi-host mesh every process loads only the rows its own devices
+    will consume (1/process_count of the global batch) and calls this with
+    the same ``spec``; the result is a global array identical to what
+    :func:`shard_batch` would produce from full-batch host data, without any
+    host ever materializing the full batch.  Single-process (tests, one
+    chip): degenerates to :func:`shard_batch` semantics exactly.
+
+    The reference has no analogue — its DataLoader duty is delegated to
+    torch DataLoader with a DistributedSampler per rank; this is the
+    SPMD-global-array equivalent of that per-rank sharding.
+    """
+    import numpy as np
+
+    def one(x, s):
+        sh = NamedSharding(mesh, s if isinstance(s, P) else P())
+        return jax.make_array_from_process_local_data(sh, np.asarray(x))
+
+    if isinstance(spec, P):
+        return jax.tree.map(lambda x: one(x, spec), local_batch)
+    return jax.tree.map(
+        one, local_batch, spec, is_leaf=lambda x: x is None
+    )
+
+
 def microbatch(batch: PyTree, num_microbatches: int) -> PyTree:
     """Reshape every leaf's leading dim B into [M, B/M] — the layout the
     pipelined losses consume (``gpt_pipeline_1f1b``'s [M, mbs, ...])."""
