@@ -451,3 +451,123 @@ def test_gpt_moe_aux_trains(devices8):
         losses.append(float(loss))
     assert np.all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_gpt_moe_interleaved_1f1b_matches_serial(devices8):
+    """MoE x INTERLEAVED PP: the MoE GPT under the V=2 virtual-chunk 1F1B
+    schedule (EP x MoE-DP x PP x V) — L=8 so each of the 4 slabs carries the
+    same [dense, expert] pattern; aux ON through the stage-aux channel with
+    the chunk index folded into its grads' recompute.  Golden vs the
+    per-(microbatch, data-shard) serial chunk mean, like the V=1 test."""
+    from torchdistpackage_tpu.models import (
+        GPTConfig,
+        gpt_moe_loss,
+        gpt_moe_pipeline_1f1b,
+        gpt_moe_pipeline_param_specs,
+        init_gpt_moe_params,
+        stack_moe_stage_params,
+    )
+    from torchdistpackage_tpu.parallel.data_parallel import DataParallel
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, nheads=4, nlayers=8, max_seq=16, ffn_mult=2,
+        moe_experts=4, moe_top_k=2, moe_every=2,
+        moe_capacity_factor=4.0, moe_aux_weight=1e-2,
+    )
+    M, mbs, PP, VC = 4, 2, 2, 2
+    tpc.setup_process_groups([("pipe", PP), ("data", 4)], devices=devices8)
+    tpc.build_moe_mesh(moe_ep_size=2)
+    mesh = tpc.get_view("moe")
+
+    params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+    stage_params = stack_moe_stage_params(params, cfg, PP, num_chunks=VC)
+    # [V, P, ...] leaves, stage dim sharded
+    assert stage_params["blocks"][0]["attn"]["wqkv"].shape[:2] == (VC, PP)
+    specs = gpt_moe_pipeline_param_specs(cfg, PP, ep_axis="moe_ep", num_chunks=VC)
+
+    def vg_fn(p, batch):
+        return gpt_moe_pipeline_1f1b(
+            p, batch, cfg, num_microbatches=M, ep_axis="moe_ep", num_chunks=VC
+        )
+
+    opt = optax.sgd(1e-1)
+    dp = DataParallel(
+        mesh=mesh,
+        axis=("moe_dp", "moe_ep"),
+        grad_reduce_overrides=moe_grad_reduce_overrides(),
+    )
+    sharded = dp.broadcast_params(stage_params, param_specs=specs)
+    state = opt.init(sharded)
+    step = dp.make_train_step(
+        value_and_grad_fn=vg_fn,
+        optimizer=opt,
+        param_specs=specs,
+        batch_spec={
+            "tokens": P(None, ("moe_dp", "moe_ep")),
+            "targets": P(None, ("moe_dp", "moe_ep")),
+        },
+    )
+
+    sparams, sstate = params, opt.init(params)
+
+    def serial_loss(p, batch):
+        losses = [
+            gpt_moe_loss(
+                p,
+                {
+                    "tokens": batch["tokens"][m, 2 * d : 2 * d + 2],
+                    "targets": batch["targets"][m, 2 * d : 2 * d + 2],
+                },
+                cfg,
+            )
+            for m in range(M)
+            for d in range(4)
+        ]
+        return jnp.mean(jnp.stack(losses))
+
+    @jax.jit
+    def serial_step(p, s, b):
+        loss, g = jax.value_and_grad(serial_loss)(p, b)
+        u, s = opt.update(g, s, p)
+        return jax.tree.map(jnp.add, p, u), s, loss
+
+    S = cfg.max_seq
+    for i in range(2):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(90 + i))
+        batch = {
+            "tokens": jax.random.randint(k1, (M, mbs * 4, S), 0, cfg.vocab_size),
+            "targets": jax.random.randint(k2, (M, mbs * 4, S), 0, cfg.vocab_size),
+        }
+        sparams, sstate, sloss = serial_step(sparams, sstate, batch)
+        dbatch = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(mesh, P(None, ("moe_dp", "moe_ep")))
+            ),
+            batch,
+        )
+        sharded, state, dloss = step(sharded, state, dbatch)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
+
+    # position i of slab (v, s) is serial block (v*P + s)*Lc + i; Lc=2 here,
+    # position 1 is the expert block of each slab
+    lc = cfg.nlayers // (PP * VC)
+    for v in range(VC):
+        for s_idx in range(PP):
+            g = (v * PP + s_idx) * lc
+            np.testing.assert_allclose(
+                np.asarray(sharded["blocks"][0]["attn"]["wqkv"])[v, s_idx],
+                np.asarray(sparams["blocks"][g]["attn"]["wqkv"]),
+                rtol=1e-4, atol=1e-5,
+                err_msg=f"slab (chunk {v}, stage {s_idx}) dense attn diverged",
+            )
+            np.testing.assert_allclose(
+                np.asarray(sharded["blocks"][1]["moe"]["experts"]["w1"])[v, s_idx],
+                np.asarray(sparams["blocks"][g + 1]["moe"]["experts"]["w1"]),
+                rtol=1e-4, atol=1e-5,
+                err_msg=f"slab (chunk {v}, stage {s_idx}) experts diverged",
+            )
+    np.testing.assert_allclose(
+        np.asarray(sharded["blocks"][1]["moe"]["router"]["w"])[0, 0],
+        np.asarray(sparams["blocks"][1]["moe"]["router"]["w"]),
+        rtol=1e-4, atol=1e-5, err_msg="router diverged (aux grad path)",
+    )

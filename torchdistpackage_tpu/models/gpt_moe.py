@@ -203,50 +203,73 @@ def init_gpt_moe_params(key, cfg: GPTConfig) -> Dict[str, PyTree]:
 # ------------------------------------------------------------------- pipeline
 
 
-def moe_stage_pattern(cfg: GPTConfig, pipe_size: int) -> List[bool]:
-    """Per-position dense/MoE pattern of one pipeline stage's slab.
+def moe_stage_pattern(
+    cfg: GPTConfig, pipe_size: int, num_chunks: int = 1
+) -> List[bool]:
+    """Per-position dense/MoE pattern of one pipeline slab.
 
-    The SPMD pipeline runs ONE program on every stage, so each stage's slab
-    of ``nlayers / pipe_size`` blocks must have the same structure (which
-    positions are expert blocks).  That holds iff ``moe_every`` divides the
-    per-stage layer count — checked here against the actual placement."""
+    The SPMD pipeline runs ONE program on every stage (and, interleaved,
+    every chunk), so each slab of ``nlayers / (pipe * V)`` blocks must have
+    the same structure (which positions are expert blocks).  That holds iff
+    ``moe_every`` divides the per-slab layer count — checked here against
+    the actual placement across ALL P*V slabs."""
     L = cfg.nlayers
-    if L % pipe_size != 0:
-        raise ValueError(f"nlayers {L} not divisible by pipe size {pipe_size}")
-    lpp = L // pipe_size
+    nslabs = pipe_size * num_chunks
+    if L % nslabs != 0:
+        raise ValueError(
+            f"nlayers {L} not divisible by pipe*chunks ({pipe_size}*{num_chunks})"
+        )
+    lpp = L // nslabs
     pattern = [is_moe_block(cfg, i) for i in range(lpp)]
-    for s in range(1, pipe_size):
+    for g in range(1, nslabs):
         for i in range(lpp):
-            if is_moe_block(cfg, s * lpp + i) != pattern[i]:
+            if is_moe_block(cfg, g * lpp + i) != pattern[i]:
                 raise ValueError(
-                    f"MoE block placement is not stage-invariant: block "
-                    f"{s * lpp + i} (stage {s}, position {i}) differs from "
-                    f"block {i}; choose moe_every dividing nlayers/pipe "
-                    f"({lpp}) so every stage holds the same dense/expert "
+                    f"MoE block placement is not slab-invariant: block "
+                    f"{g * lpp + i} (slab {g}, position {i}) differs from "
+                    f"block {i}; choose moe_every dividing nlayers/(pipe*V) "
+                    f"({lpp}) so every slab holds the same dense/expert "
                     f"pattern"
                 )
     return pattern
 
 
 def stack_moe_stage_params(
-    params: Dict[str, PyTree], cfg: GPTConfig, pipe_size: int
+    params: Dict[str, PyTree],
+    cfg: GPTConfig,
+    pipe_size: int,
+    num_chunks: int = 1,
 ) -> Dict[str, PyTree]:
     """Reorganize ``init_gpt_moe_params``'s length-L block list into the
-    pipeline layout: a length-``L/pipe`` list (position within a stage) whose
-    leaves are stacked ``[pipe, ...]`` across stages — the MoE analogue of
-    ``stack_stage_params`` (stage s's slab is blocks
-    ``[s*L/P, (s+1)*L/P)``, uniform partition, pipeline_helper.py:6-17
-    semantics).  Shard each leaf's dim 0 over the pipe axis
-    (:func:`gpt_moe_pipeline_param_specs`)."""
-    lpp = len(moe_stage_pattern(cfg, pipe_size))
+    pipeline layout: a length-``L/(P*V)`` list (position within a slab) whose
+    leaves are stacked ``[pipe, ...]`` across stages (classic, V=1) or
+    ``[V, pipe, ...]`` across (chunk, stage) slabs (interleaved: chunk v of
+    stage s = slab ``v*P + s``, matching ``interleave_stage_params``).  The
+    MoE analogue of ``stack_stage_params`` (uniform partition,
+    pipeline_helper.py:6-17 semantics).  Shard the stage dim over the pipe
+    axis (:func:`gpt_moe_pipeline_param_specs`)."""
+    lpp = len(moe_stage_pattern(cfg, pipe_size, num_chunks))
     blocks = params["blocks"]
-    new_blocks = [
-        jax.tree.map(
-            lambda *xs: jnp.stack(xs, axis=0),
-            *[blocks[s * lpp + i] for s in range(pipe_size)],
-        )
-        for i in range(lpp)
-    ]
+    if num_chunks == 1:
+        new_blocks = [
+            jax.tree.map(
+                lambda *xs: jnp.stack(xs, axis=0),
+                *[blocks[s * lpp + i] for s in range(pipe_size)],
+            )
+            for i in range(lpp)
+        ]
+    else:
+        def stack_vp(i):
+            per_chunk = [
+                jax.tree.map(
+                    lambda *xs: jnp.stack(xs, axis=0),
+                    *[blocks[(v * pipe_size + s) * lpp + i] for s in range(pipe_size)],
+                )
+                for v in range(num_chunks)
+            ]
+            return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_chunk)
+
+        new_blocks = [stack_vp(i) for i in range(lpp)]
     return {**params, "blocks": new_blocks}
 
 
@@ -261,6 +284,7 @@ def gpt_moe_pipeline_1f1b(
     sp: bool = False,
     remat: bool = True,
     dropout_key: Optional[jax.Array] = None,
+    num_chunks: int = 1,
 ):
     """1F1B-scheduled MoE GPT training core: returns ``(loss, grads)`` (see
     :func:`...pipeline_parallel.pipeline_1f1b`).  The EP × MoE-DP × TP × PP
@@ -278,7 +302,13 @@ def gpt_moe_pipeline_1f1b(
     NB the aux (and the dispatch capacity) is computed per MICROBATCH: the
     load-balance loss is a product of per-batch means, so its value differs
     from the full-batch aux of a non-pipelined step — compare against a
-    microbatched serial golden (mean of per-microbatch losses)."""
+    microbatched serial golden (mean of per-microbatch losses).
+
+    ``num_chunks`` (V > 1) runs the INTERLEAVED schedule over
+    ``stack_moe_stage_params(..., num_chunks=V)``-layout params ([V, P, ...]
+    leaves): the dense/expert pattern must be slab-invariant
+    (``moe_stage_pattern`` checks) and the stage body selects chunk v's slab
+    before the block loop."""
     n_moe = sum(1 for i in range(cfg.nlayers) if is_moe_block(cfg, i))
     aux_scale = cfg.moe_aux_weight / max(n_moe, 1)
     lpp = len(params["blocks"])
@@ -290,22 +320,26 @@ def gpt_moe_pipeline_1f1b(
             h = split_to_sp(h, tp_axis)
         return h
 
-    def stage_fn(p, x, m):
+    def run_blocks(p, x, m, select, v=None):
+        """One slab's block loop; ``select`` maps a stacked leaf to the
+        slab-local array (closes over the chunk index when interleaved)."""
         aux_total = jnp.zeros((), jnp.float32)
         for i, stacked in enumerate(p["blocks"]):
-            bp = jax.tree.map(lambda a: a[0], stacked)  # local [1, ...] slab
+            bp = jax.tree.map(select, stacked)
             k = None
             if dropout_key is not None and cfg.dropout_rate > 0.0:
                 k = jax.random.fold_in(dropout_key, jax.lax.axis_index(pipe_axis))
                 k = jax.random.fold_in(k, m)
                 k = jax.random.fold_in(k, i)
+                if v is not None:  # distinct masks per chunk slab
+                    k = jax.random.fold_in(k, v)
             if pattern[i]:
                 body = lambda bp, x, k: moe_block_forward(
                     bp, x, cfg, axis=tp_axis, sp=sp, ep_axis=ep_axis,
                     dropout_key=k,
                 )
                 if remat:
-                    body = jax.checkpoint(body, static_argnums=())
+                    body = jax.checkpoint(body)
                 x, aux = body(bp, x, k)
                 aux_total = aux_total + aux
             else:
@@ -316,6 +350,18 @@ def gpt_moe_pipeline_1f1b(
                     body = jax.checkpoint(body)
                 x = body(bp, x, k)
         return x, aux_scale * aux_total
+
+    if num_chunks == 1:
+        def stage_fn(p, x, m):
+            return run_blocks(p, x, m, lambda a: a[0])  # local [1, ...] slab
+    else:
+        def stage_fn(p, x, m, v):
+            # local leaves are [V, 1, ...]; pick chunk v's slab
+            return run_blocks(
+                p, x, m,
+                lambda a: jax.lax.dynamic_index_in_dim(a, v, 0, keepdims=False)[0],
+                v=v,
+            )
 
     def last_fn(p, y, tgt):
         logits = gpt_head(p, y, tp_axis, sp)
@@ -334,6 +380,7 @@ def gpt_moe_pipeline_1f1b(
         pipe_axis=pipe_axis,
         stage_takes_mb=True,
         stage_returns_aux=True,
+        num_chunks=num_chunks,
     )
 
 
@@ -343,18 +390,21 @@ def gpt_moe_pipeline_param_specs(
     tp_axis: Optional[str] = None,
     pipe_axis: str = "pipe",
     ep_axis: Optional[str] = None,
+    num_chunks: int = 1,
 ) -> Dict[str, PyTree]:
     """Specs for the :func:`stack_moe_stage_params` layout: every block leaf
-    gains a leading pipe dim; expert stacks keep their EP sharding on what is
-    now dim 1.  Derived from :func:`gpt_moe_param_specs` (one spec source):
-    position i's spec equals block i's, since the pattern is stage-invariant
+    gains a leading pipe dim (V=1) or ``(None, pipe)`` dims (interleaved);
+    expert stacks keep their EP sharding on the following dim.  Derived from
+    :func:`gpt_moe_param_specs` (one spec source): position i's spec equals
+    block i's, since the pattern is slab-invariant
     (:func:`moe_stage_pattern` checks)."""
-    lpp = len(moe_stage_pattern(cfg, pipe_size))
+    lpp = len(moe_stage_pattern(cfg, pipe_size, num_chunks))
     base = gpt_moe_param_specs(cfg, tp_axis=tp_axis, ep_axis=ep_axis)
+    lead = (pipe_axis,) if num_chunks == 1 else (None, pipe_axis)
 
     def prepend(tree):
         return jax.tree.map(
-            lambda s: P(pipe_axis, *s),
+            lambda s: P(*lead, *s),
             tree,
             is_leaf=lambda s: isinstance(s, P),
         )
