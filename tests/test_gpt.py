@@ -746,3 +746,40 @@ def test_gpt_interleaved_requires_divisible_microbatches(devices8, params):
                 out_specs=(P(), specs),
             )
         )(sharded, batch)
+
+
+def test_interleave_roundtrip_and_vit_cp_pp_guard(devices8, params):
+    """Layout portability: interleave -> deinterleave is the identity (a
+    checkpoint from either pipelined layout resumes in the other), and the
+    unsupported ViT CP x PP combination fails loudly with the grad-semantics
+    explanation rather than silently mis-scaling gradients."""
+    from torchdistpackage_tpu.models import (
+        ViTConfig,
+        deinterleave_stage_params,
+        init_vit_params,
+        interleave_stage_params,
+        vit_pipeline_1f1b,
+    )
+
+    ip = interleave_stage_params(params, 2, 2)
+    back = deinterleave_stage_params(ip, 2, 2)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params,
+        back,
+    )
+    with pytest.raises(ValueError, match="not an interleaved layout"):
+        deinterleave_stage_params(ip, 4, 2)
+
+    cp_cfg = ViTConfig(
+        image_size=32, patch_size=8, channels=3, num_classes=16,
+        dim=64, nheads=4, nlayers=2, ffn_mult=2,
+        attn_impl="ring", context_axis="context",
+    )
+    vparams = init_vit_params(jax.random.PRNGKey(0), cp_cfg)
+    batch = {
+        "images": jnp.zeros((2, 2, 32, 32, 3)),
+        "labels": jnp.zeros((2, 2), jnp.int32),
+    }
+    with pytest.raises(NotImplementedError, match="sum \\(not mean\\)"):
+        vit_pipeline_1f1b(vparams, batch, cp_cfg, num_microbatches=2)

@@ -398,6 +398,26 @@ def interleave_stage_params(
     return {**params, "blocks": jax.tree.map(r, params["blocks"])}
 
 
+def deinterleave_stage_params(
+    params: Dict[str, PyTree], num_chunks: int, pipe_size: int
+) -> Dict[str, PyTree]:
+    """Inverse of :func:`interleave_stage_params`: ``[V, P, Lc, ...]`` block
+    leaves back to the ``[L, ...]`` stacked layout (serial layer order).
+    Lets a checkpoint written from interleaved training resume classic
+    pipelined (or serial) training and vice versa — the layouts are pure
+    reshapes of each other."""
+
+    def r(a):
+        if a.shape[:2] != (num_chunks, pipe_size):
+            raise ValueError(
+                f"leaf leading dims {a.shape[:2]} != (V={num_chunks}, "
+                f"P={pipe_size}) — not an interleaved layout"
+            )
+        return a.reshape(num_chunks * pipe_size * a.shape[2], *a.shape[3:])
+
+    return {**params, "blocks": jax.tree.map(r, params["blocks"])}
+
+
 def gpt_interleaved_param_specs(
     cfg: GPTConfig,
     tp_axis: Optional[str] = None,

@@ -250,26 +250,23 @@ def stack_moe_stage_params(
     axis (:func:`gpt_moe_pipeline_param_specs`)."""
     lpp = len(moe_stage_pattern(cfg, pipe_size, num_chunks))
     blocks = params["blocks"]
-    if num_chunks == 1:
+    nslabs = pipe_size * num_chunks
+    # stack position i over all slabs g = v*P + s (v-major, matching
+    # interleave_stage_params), then split the slab dim into (V, P)
+    new_blocks = [
+        jax.tree.map(
+            lambda *xs: jnp.stack(xs, axis=0),
+            *[blocks[g * lpp + i] for g in range(nslabs)],
+        )
+        for i in range(lpp)
+    ]
+    if num_chunks > 1:
         new_blocks = [
             jax.tree.map(
-                lambda *xs: jnp.stack(xs, axis=0),
-                *[blocks[s * lpp + i] for s in range(pipe_size)],
+                lambda a: a.reshape(num_chunks, pipe_size, *a.shape[1:]), b
             )
-            for i in range(lpp)
+            for b in new_blocks
         ]
-    else:
-        def stack_vp(i):
-            per_chunk = [
-                jax.tree.map(
-                    lambda *xs: jnp.stack(xs, axis=0),
-                    *[blocks[(v * pipe_size + s) * lpp + i] for s in range(pipe_size)],
-                )
-                for v in range(num_chunks)
-            ]
-            return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_chunk)
-
-        new_blocks = [stack_vp(i) for i in range(lpp)]
     return {**params, "blocks": new_blocks}
 
 

@@ -257,9 +257,13 @@ def vit_pipeline_1f1b(
 
     if cfg.context_axis is not None:
         raise NotImplementedError(
-            "vit_pipeline_1f1b does not compose with context parallelism "
-            "yet: stage 0 would need per-CP-rank patch slicing inside the "
-            "schedule (the GPT family supports CPxPP via gpt_pipeline_1f1b)"
+            "vit_pipeline_1f1b does not compose with context parallelism: "
+            "unlike the GPT CE (a mean over context-LOCAL tokens, which "
+            "makes the context axis a plain data axis — gpt_pipeline_1f1b "
+            "supports CPxPP), the ViT loss pools patches with a pmean over "
+            "the context axis, so its per-rank param grads are SHARES whose "
+            "sum (not mean) is the full gradient — the train step's "
+            "data-axis mean reduction would silently scale grads by 1/cp"
         )
 
     def first_fn(p, images):
