@@ -571,3 +571,129 @@ def test_gpt_moe_interleaved_1f1b_matches_serial(devices8):
         np.asarray(sparams["blocks"][1]["moe"]["router"]["w"]),
         rtol=1e-4, atol=1e-5, err_msg="router diverged (aux grad path)",
     )
+
+
+def test_expert_choice_serial_matches_dense_golden():
+    """Expert-choice routing: each expert picks its top-C tokens.  Golden =
+    dense per-(expert, token) mixture with the same selection computed by
+    hand; also: every expert is EXACTLY full (the balance-by-construction
+    property) and the aux loss is identically zero."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, router="expert_choice", capacity_factor=1.0)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, cfg.dim))
+    y, aux = moe_forward(params, x, cfg)
+    assert float(aux) == 0.0
+
+    B, S, D = x.shape
+    T, E = B * S, cfg.num_experts
+    t = x.reshape(T, D)
+    probs = np.asarray(
+        jax.nn.softmax((t @ params["router"]["w"]).astype(jnp.float32), axis=-1)
+    )
+    import math as _math
+
+    C = max(1, int(_math.ceil(T * cfg.top_k * cfg.capacity_factor / E)))
+    w = np.zeros((T, E))
+    for e in range(E):
+        picks = np.argsort(-probs[:, e], kind="stable")[:C]
+        w[picks, e] = probs[picks, e]
+    e_p = params["experts"]
+    h = jax.nn.gelu(jnp.einsum("td,edf->etf", t, e_p["w1"]) + e_p["b1"][:, None, :])
+    out = jnp.einsum("etf,efd->etd", h, e_p["w2"]) + e_p["b2"][:, None, :]
+    want = jnp.einsum("te,etd->td", jnp.asarray(w, x.dtype), out).reshape(B, S, D)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_expert_choice_ep_matches_serial(devices8):
+    """EC routing under EP=4 must equal the serial EC layer (the dispatch
+    tensors feed the same all_to_all machinery as token-choice)."""
+    import dataclasses
+
+    # capacity_factor=1.0 -> C = ceil(8*2/4) = 4 < T=8 local tokens, so the
+    # top-C SELECTION (not just dense routing) is exercised under EP
+    cfg = dataclasses.replace(CFG, router="expert_choice", capacity_factor=1.0)
+    mesh = _moe_view(devices8)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, cfg.dim))
+
+    specs = moe_param_specs("moe_ep")
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+    )
+    xspec = P(("moe_dp", "moe_ep"))
+    x_sh = jax.device_put(x, NamedSharding(mesh, xspec))
+
+    def fwd(p, xx):
+        y, aux = moe_forward(p, xx, cfg, ep_axis="moe_ep")
+        return y
+
+    out = jax.jit(
+        shard_map(fwd, mesh=mesh, in_specs=(specs, xspec), out_specs=xspec)
+    )(sharded, x_sh)
+    # EC is per-device-batch routing: each device picks over ITS tokens, so
+    # compare against the serial layer applied per device-chunk
+    chunks = []
+    for d in range(8):
+        yd, _ = moe_forward(params, x[d : d + 1], cfg)
+        chunks.append(yd)
+    want = jnp.concatenate(chunks, axis=0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_gpt_moe_expert_choice_trains(devices8):
+    """Model-level EC: the MoE GPT with expert-choice routing trains under
+    EP x MoE-DP (finite, decreasing) — no aux loss needed."""
+    from torchdistpackage_tpu.models import (
+        GPTConfig,
+        gpt_moe_loss,
+        gpt_moe_param_specs,
+        init_gpt_moe_params,
+    )
+    from torchdistpackage_tpu.parallel.data_parallel import DataParallel
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, nheads=4, nlayers=2, max_seq=16, ffn_mult=2,
+        moe_experts=4, moe_top_k=2, moe_every=2,
+        moe_capacity_factor=1.0, moe_router="expert_choice",
+    )
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    tpc.build_moe_mesh(moe_ep_size=4)
+    mesh = tpc.get_view("moe")
+    params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+    specs = gpt_moe_param_specs(cfg, tp_axis=None, ep_axis="moe_ep")
+    opt = optax.adam(1e-2)
+
+    dp = DataParallel(
+        mesh=mesh,
+        axis=("moe_dp", "moe_ep"),
+        grad_reduce_overrides=moe_grad_reduce_overrides(),
+    )
+    sharded = dp.broadcast_params(params, param_specs=specs)
+    state = opt.init(sharded)
+    step = dp.make_train_step(
+        lambda p, b: gpt_moe_loss(p, b, cfg, ep_axis="moe_ep"),
+        opt,
+        param_specs=specs,
+        batch_spec={
+            "tokens": P(("moe_dp", "moe_ep")),
+            "targets": P(("moe_dp", "moe_ep")),
+        },
+    )
+
+    losses = []
+    for i in range(4):
+        k1, _ = jax.random.split(jax.random.PRNGKey(60 + i))
+        tokens = jax.random.randint(k1, (8, 16), 0, cfg.vocab_size)
+        targets = jnp.concatenate([tokens[:, :1], tokens[:, :-1]], axis=1)
+        batch = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(mesh, P(("moe_dp", "moe_ep")))
+            ),
+            {"tokens": tokens, "targets": targets},
+        )
+        sharded, state, loss = step(sharded, state, batch)
+        losses.append(float(loss))
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0]

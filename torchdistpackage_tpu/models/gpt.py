@@ -71,6 +71,7 @@ class GPTConfig:
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
+    moe_router: str = "topk"  # 'topk' | 'expert_choice' (see MoEConfig)
 
     def __post_init__(self):
         if self.context_axis is not None and self.attn_impl not in ("ring", "ulysses"):

@@ -71,6 +71,7 @@ def moe_layer_config(cfg: GPTConfig) -> MoEConfig:
         capacity_factor=cfg.moe_capacity_factor,
         aux_loss_weight=cfg.moe_aux_weight,
         dtype=cfg.dtype,
+        router=cfg.moe_router,
     )
 
 

@@ -49,6 +49,15 @@ class MoEConfig:
     # jitter / z-loss knobs kept minimal; aux load-balance loss is standard
     aux_loss_weight: float = 1e-2
     dtype: Any = jnp.float32
+    # 'topk' (token-choice, GShard/Switch: each token picks top_k experts,
+    # overflow dropped, aux loss balances) | 'expert_choice' (EC: each
+    # EXPERT picks its top-capacity tokens — perfectly balanced by
+    # construction, no drops, aux loss identically 0; Zhou et al. 2022)
+    router: str = "topk"
+
+    def __post_init__(self):
+        if self.router not in ("topk", "expert_choice"):
+            raise ValueError(f"unknown MoE router {self.router!r}")
 
 
 # ------------------------------------------------------------------ dispatch
@@ -88,6 +97,27 @@ def _top_k_dispatch(
     # dispatch[t, e, c] = any kept choice of t mapping to (e, c)
     dispatch = jnp.einsum("tke,tkc->tec", keep, slot_oh)
     combine = jnp.einsum("tk,tke,tkc->tec", gate_vals, keep, slot_oh)
+    return dispatch, combine
+
+
+def _expert_choice_dispatch(
+    probs: jnp.ndarray, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-choice dispatch/combine (Zhou et al., "Mixture-of-Experts with
+    Expert Choice Routing", 2022): each EXPERT selects its top-``capacity``
+    tokens by router probability.  Every expert is exactly full (perfect
+    load balance, nothing dropped by overflow), at the price of a token
+    possibly being picked by 0 or many experts — fine under the residual
+    use ``y = x + moe(x)``.
+
+    probs: [T, E].  Returns ``dispatch``/``combine`` [T, E, C] like
+    :func:`_top_k_dispatch`; combine carries the raw router prob of each
+    pick (EC does not renormalize per token)."""
+    T = probs.shape[0]
+    gate_vals, tok_idx = jax.lax.top_k(probs.T, capacity)  # [E, C] over tokens
+    tok_oh = jax.nn.one_hot(tok_idx, T, dtype=probs.dtype)  # [E, C, T]
+    dispatch = tok_oh.transpose(2, 0, 1)  # [T, E, C]
+    combine = (tok_oh * gate_vals[..., None]).transpose(2, 0, 1)
     return dispatch, combine
 
 
@@ -131,8 +161,14 @@ def moe_forward(
         (tokens @ params["router"]["w"]).astype(jnp.float32), axis=-1
     )  # [T, E] in fp32 for routing stability
     capacity = max(1, int(math.ceil(T * cfg.top_k * cfg.capacity_factor / E)))
-    dispatch, combine = _top_k_dispatch(probs, cfg.top_k, capacity)
-    aux = _load_balance_loss(probs, dispatch)
+    if cfg.router == "expert_choice":
+        capacity = min(capacity, T)  # an expert cannot pick more than T tokens
+        dispatch, combine = _expert_choice_dispatch(probs, capacity)
+        # every expert exactly full: balanced by construction, no aux needed
+        aux = jnp.zeros((), jnp.float32)
+    else:
+        dispatch, combine = _top_k_dispatch(probs, cfg.top_k, capacity)
+        aux = _load_balance_loss(probs, dispatch)
     dispatch = dispatch.astype(x.dtype)
     combine = combine.astype(x.dtype)
 
