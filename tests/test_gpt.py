@@ -510,11 +510,18 @@ def test_streamed_head_loss_matches_full():
     )
 
 
-def test_gpt_1f1b_dropout(devices8, params):
+@pytest.mark.parametrize("num_chunks", [1, 2])
+def test_gpt_1f1b_dropout(devices8, params, num_chunks):
     """Dropout THROUGH the 1F1B pipeline: per-(stage, microbatch, layer)
     masks via the schedule's microbatch-index threading; deterministic for a
     fixed key (the bwd recompute replays the same chain), different for a
-    different key, and exactly the no-dropout path when the key is None."""
+    different key, and exactly the no-dropout path when the key is None.
+    num_chunks=2 checks the same determinism under the INTERLEAVED schedule
+    (the chunk index is folded into the key and replayed by the recompute)."""
+    from torchdistpackage_tpu.models import (
+        gpt_interleaved_param_specs,
+        interleave_stage_params,
+    )
     from torchdistpackage_tpu.utils import axis_unique_key
 
     cfg_do = dataclasses.replace(CFG, dropout_rate=0.3)
@@ -523,7 +530,11 @@ def test_gpt_1f1b_dropout(devices8, params):
         [("data", 2), ("pipe", 2), ("tensor", 2)], devices=devices8
     )
     mesh = tpc.get_view()
-    specs = gpt_param_specs(CFG, tp_axis="tensor", pipe_axis="pipe")
+    if num_chunks > 1:
+        params = interleave_stage_params(params, num_chunks, 2)
+        specs = gpt_interleaved_param_specs(CFG, tp_axis="tensor")
+    else:
+        specs = gpt_param_specs(CFG, tp_axis="tensor", pipe_axis="pipe")
     sharded = jax.tree.map(
         lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
     )
@@ -533,7 +544,7 @@ def test_gpt_1f1b_dropout(devices8, params):
         key = axis_unique_key(jax.random.PRNGKey(seed), "data")
         loss, grads = gpt_pipeline_1f1b(
             p, b, cfg_do, num_microbatches=M, tp_axis="tensor", sp=True,
-            dropout_key=key,
+            dropout_key=key, num_chunks=num_chunks,
         )
         from torchdistpackage_tpu.parallel.data_parallel import _vma
 
@@ -564,6 +575,40 @@ def test_gpt_1f1b_dropout(devices8, params):
     assert abs(float(l_a) - float(l_b)) > 1e-6, "different keys must differ"
     for leaf in jax.tree.leaves(g_a):
         assert np.all(np.isfinite(np.asarray(leaf)))
+
+    # key=None must be EXACTLY the no-dropout path (identical to running
+    # with dropout_rate=0)
+    from torchdistpackage_tpu.parallel.data_parallel import _vma
+
+    def _norm(loss):
+        axes = tuple(a for a in ("data",) if a in _vma(loss))
+        return jax.lax.pmean(loss, axes) if axes else loss
+
+    def vg_none(p, b):
+        loss, grads = gpt_pipeline_1f1b(
+            p, b, cfg_do, num_microbatches=M, tp_axis="tensor", sp=True,
+            dropout_key=None, num_chunks=num_chunks,
+        )
+        return _norm(loss), grads
+
+    def vg_off(p, b):
+        loss, grads = gpt_pipeline_1f1b(
+            p, b, CFG, num_microbatches=M, tp_axis="tensor", sp=True,
+            num_chunks=num_chunks,
+        )
+        return _norm(loss), grads
+
+    def run_plain(f):
+        sm = shard_map(
+            f, mesh=mesh, in_specs=(specs, bspec), out_specs=(P(), specs)
+        )
+        loss, _ = jax.jit(sm)(sharded, dbatch)
+        return float(loss)
+
+    np.testing.assert_allclose(
+        run_plain(vg_none), run_plain(vg_off), rtol=0, atol=0,
+        err_msg="key=None must equal the dropout_rate=0 path exactly",
+    )
 
 
 def test_streamed_head_loss_under_dp(devices8, params):
