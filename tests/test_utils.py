@@ -273,3 +273,30 @@ def test_global_batch_from_local_single_process(devices8):
     assert got2["x"].sharding.spec == P(("data", "tensor"))
     assert got2["y"].sharding.spec == P()
     np.testing.assert_array_equal(np.asarray(got2["x"]), batch["x"])
+
+
+def test_metrics_logger(tmp_path):
+    """JSONL records, step timing, compile-excluded throughput average, and
+    EMA companions."""
+    import json as _json
+    import time as _time
+
+    from torchdistpackage_tpu.utils import MetricsLogger
+
+    path = str(tmp_path / "m.jsonl")
+    ml = MetricsLogger(path=path, tokens_per_step=1000, ema=0.5, print_every=0)
+    for i in range(4):
+        _time.sleep(0.01)
+        ml.log(i, loss=float(4 - i))
+    assert len(ml.history) == 4
+    # first record has no interval; second's throughput is excluded from avg
+    assert "step_time_s" not in ml.history[0]
+    assert "tok_per_sec" in ml.history[1]
+    assert "tok_per_sec_avg" not in ml.history[1]
+    assert "tok_per_sec_avg" in ml.history[2]
+    # EMA companions move toward the new value
+    assert ml.history[1]["loss_ema"] == 0.5 * 4.0 + 0.5 * 3.0
+    with open(path) as f:
+        lines = [_json.loads(l) for l in f]
+    assert [r["step"] for r in lines] == [0, 1, 2, 3]
+    assert lines[3]["loss"] == 1.0

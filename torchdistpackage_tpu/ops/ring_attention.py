@@ -19,8 +19,9 @@ built the TPU way:
   kernel's lse output carries its own cotangent.
 - :func:`ulysses_attention` — the all-to-all alternative: scatter heads /
   gather sequence over the axis, run full flash attention on H/cp local
-  heads, scatter back.  Two all_to_alls instead of cp-1 ppermute hops;
-  better when H >= cp and S very long.
+  heads, scatter back.  Four all_to_alls per attention (q/k/v head-scatter
+  + output gather) instead of cp-1 ppermute hops; better when H >= cp and
+  S very long.
 
 Both are for use inside ``shard_map`` with the sequence dim of q/k/v sharded
 over ``axis``; both run serially when ``axis`` is None (golden path).

@@ -5,6 +5,7 @@ Analogue of the reference's ``utils.py`` (fix_rand + partition_params) and
 inf/nan probe, master-only print).
 """
 
+from .metrics import MetricsLogger
 from .data import (
     global_batch_from_local,
     microbatch,
@@ -34,6 +35,7 @@ from .checkpoint import (
 )
 
 __all__ = [
+    "MetricsLogger",
     "global_batch_from_local",
     "microbatch",
     "prefetch_to_sharding",
