@@ -81,9 +81,18 @@ class TransformerConfig:
 
 
 def layer_norm(x: jnp.ndarray, p: Dict[str, jnp.ndarray], eps: float = 1e-5) -> jnp.ndarray:
-    mu = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    """Statistics in f32 regardless of storage dtype: at bf16 the mean/var
+    of ~1e3-element rows lose enough mantissa to visibly perturb the
+    normalization (the standard TPU-stack practice is f32 LN statistics;
+    the op is VPU-bound and XLA fuses the casts, so the cost is noise).
+    f32 inputs are bit-identical to the plain formulation."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (
+        y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    ).astype(x.dtype)
 
 
 def attention_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
