@@ -371,3 +371,106 @@ def test_zero_with_ring_context_parallel(devices8):
             np.asarray(zp[name]), np.asarray(sparams[name]),
             rtol=1e-3, atol=1e-5, err_msg=f"param divergence at {name}",
         )
+
+
+def test_zero_with_moe_expert_overrides(devices8):
+    """ZeRO x MoE (the DeepSpeed-style pairing): optimizer state sharded
+    over 'moe_dp' with expert grads reduced over moe_dp ONLY
+    (grad_reduce_overrides) while dense params reduce over the full data
+    group — trajectory must match serial Adam.  Masters of EP-sharded
+    expert stacks end up sharded over BOTH moe_ep (expert dim) and moe_dp
+    (zero shard dim)."""
+    from torchdistpackage_tpu.parallel.moe import (
+        MoEConfig,
+        init_moe_params,
+        moe_forward,
+        moe_grad_reduce_overrides,
+        moe_param_specs,
+    )
+
+    cfg = MoEConfig(dim=16, ffn_dim=32, num_experts=4, top_k=2, capacity_factor=4.0)
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    tpc.build_moe_mesh(moe_ep_size=4)
+    mesh = tpc.get_view("moe")
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.adam(1e-2)
+
+    def loss_fn(p, batch, ep_axis=None):
+        y, _aux = moe_forward(p, batch["x"], cfg, ep_axis=ep_axis)
+        return jnp.mean((y - batch["y"]) ** 2)
+
+    import functools
+
+    zero = ZeroOptimizer(
+        opt,
+        mesh=mesh,
+        shard_axis="moe_dp",
+        grad_reduce_axes=("moe_dp", "moe_ep"),
+        param_specs=moe_param_specs("moe_ep"),
+        grad_reduce_overrides=moe_grad_reduce_overrides(),
+    )
+    zp = zero.place_params(params)
+    zs = zero.init(zp)
+    # expert master: EP on the expert dim AND zero-sharded on a free dim
+    w1_spec = tuple(zs["master"]["experts"]["w1"].sharding.spec)
+    assert "moe_ep" in w1_spec and "moe_dp" in w1_spec, w1_spec
+    step = zero.make_train_step(
+        functools.partial(loss_fn, ep_axis="moe_ep"),
+        batch_spec={"x": P(("moe_dp", "moe_ep")), "y": P(("moe_dp", "moe_ep"))},
+    )
+
+    sparams, sstate = params, opt.init(params)
+
+    @jax.jit
+    def serial_step(p, s, b):
+        loss, g = jax.value_and_grad(loss_fn)(p, b)
+        u, s = opt.update(g, s, p)
+        return jax.tree.map(jnp.add, p, u), s, loss
+
+    from jax.sharding import NamedSharding
+
+    for i in range(3):
+        kx, ky = jax.random.split(jax.random.PRNGKey(10 + i))
+        batch = {
+            "x": jax.random.normal(kx, (8, 8, cfg.dim)),
+            "y": jax.random.normal(ky, (8, 8, cfg.dim)),
+        }
+        sparams, sstate, sloss = serial_step(sparams, sstate, batch)
+        sh = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(mesh, P(("moe_dp", "moe_ep")))
+            ),
+            batch,
+        )
+        zp, zs, dloss = step(zp, zs, sh)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
+
+    for name in ("w1", "b1", "w2", "b2"):
+        np.testing.assert_allclose(
+            np.asarray(zp["experts"][name]),
+            np.asarray(sparams["experts"][name]),
+            rtol=1e-4, atol=1e-5,
+            err_msg=f"expert param {name} diverged",
+        )
+    np.testing.assert_allclose(
+        np.asarray(zp["router"]["w"]),
+        np.asarray(sparams["router"]["w"]),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_zero_override_must_contain_shard_axis():
+    """An override that excludes the shard axis cannot deliver owner shards
+    — rejected up front."""
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(_np.array(jax.devices()[:1]), ("data",))
+    with pytest.raises(ValueError, match="must contain"):
+        ZeroOptimizer(
+            optax.adam(1e-2),
+            mesh=mesh,
+            shard_axis="data",
+            grad_reduce_axes=("data",),
+            grad_reduce_overrides={"experts": ()},
+        )

@@ -106,6 +106,7 @@ class ZeroOptimizer:
         param_specs: Optional[PyTree] = None,
         param_dtype: Any = None,
         master_dtype: Any = jnp.float32,
+        grad_reduce_overrides: Optional[dict] = None,
     ) -> None:
         self.inner = inner
         self.mesh = mesh if mesh is not None else tpc.get_view()
@@ -117,6 +118,27 @@ class ZeroOptimizer:
                 f"shard_axis {shard_axis!r} must be one of grad_reduce_axes {grad_reduce_axes}"
             )
         self.grad_reduce_axes = tuple(grad_reduce_axes)
+        # ``{name_substring: axes}`` like DataParallel's (reduce_gradients
+        # docstring): matching leaves psum over THESE axes only, normalized
+        # by the FULL data-group size (the MoE-DP expert semantics — the
+        # all_to_all transpose already summed over EP).  ZeRO additionally
+        # needs each override to still contain ``shard_axis`` so the grad
+        # can psum_scatter to its owner master shard.
+        self.grad_reduce_overrides = dict(grad_reduce_overrides or {})
+        for tok, ax in self.grad_reduce_overrides.items():
+            if shard_axis not in tuple(ax):
+                raise ValueError(
+                    f"grad_reduce_overrides[{tok!r}]={tuple(ax)} must contain "
+                    f"shard_axis {shard_axis!r}: ZeRO owners are shards of "
+                    f"that axis (for MoE, shard over 'moe_dp' — the axis "
+                    f"expert grads reduce on)"
+                )
+            extra = set(ax) - set(self.grad_reduce_axes)
+            if extra:
+                raise ValueError(
+                    f"grad_reduce_overrides[{tok!r}] axes {sorted(extra)} not "
+                    f"in grad_reduce_axes {self.grad_reduce_axes}"
+                )
         self.param_specs = param_specs
         self.param_dtype = param_dtype
         self.master_dtype = master_dtype
@@ -208,25 +230,43 @@ class ZeroOptimizer:
     def reduce_grads_to_shard(self, grads_local: PyTree, shard_dims: PyTree) -> PyTree:
         """Traced: mean-reduce grads over ``grad_reduce_axes`` delivering only
         the owner shard (fused psum_scatter; the reference's reduce-to-owner,
-        zero_optim.py:203)."""
-        n = jax.lax.axis_size(self.shard_axis)
-        other_axes = tuple(a for a in self.grad_reduce_axes if a != self.shard_axis)
+        zero_optim.py:203).
 
-        def to_owner(g, d):
+        Override leaves (``grad_reduce_overrides``) psum over their override
+        axes only, still normalized by the FULL data-group size — the MoE-DP
+        expert semantics (see :func:`..data_parallel.reduce_gradients`)."""
+        from .data_parallel import _key_str
+
+        n = jax.lax.axis_size(self.shard_axis)
+        total = n
+        for a in self.grad_reduce_axes:
+            if a != self.shard_axis:
+                total *= jax.lax.axis_size(a)
+
+        def to_owner(path, g, d):
             g = g.astype(self.master_dtype)
-            if d < 0:  # replicated leaf — plain mean over the data group
-                axes = tuple(a for a in self.grad_reduce_axes if a in _vma(g))
-                return jax.lax.pmean(g, axes) if axes else g
+            axes = self.grad_reduce_axes
+            matched = False
+            name = _key_str(path)
+            for tok, ax in self.grad_reduce_overrides.items():
+                if tok in name:
+                    axes = tuple(ax)
+                    matched = True
+                    break
+            other = tuple(a for a in axes if a != self.shard_axis)
+            if d < 0:  # replicated leaf
+                vaxes = tuple(a for a in axes if a in _vma(g))
+                if matched:
+                    # override semantics: full-group mean (EP overcount)
+                    return (jax.lax.psum(g, vaxes) if vaxes else g) / total
+                return jax.lax.pmean(g, vaxes) if vaxes else g
             g = jax.lax.psum_scatter(g, self.shard_axis, scatter_dimension=d, tiled=True)
-            o = tuple(a for a in other_axes if a in _vma(g))
+            o = tuple(a for a in other if a in _vma(g))
             if o:
                 g = jax.lax.psum(g, o)
-            total = n
-            for a in other_axes:
-                total *= jax.lax.axis_size(a)
             return g / total
 
-        return jax.tree.map(to_owner, grads_local, shard_dims)
+        return jax.tree_util.tree_map_with_path(to_owner, grads_local, shard_dims)
 
     def apply_gradients(
         self,
