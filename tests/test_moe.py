@@ -257,6 +257,36 @@ def test_gpt_moe_training_matches_serial(devices8):
     )
 
 
+def chunked_moe_serial_loss(cfg, M, nshards, rows_per_shard=2):
+    """Serial golden for distributed MoE training: the mean of per-
+    (microbatch, data-shard) chunk losses — each device routes (and
+    balances) its LOCAL rows, so this chunked evaluation IS the
+    distributed semantics (gpt_moe_pipeline_1f1b NB).  Shared by the DP,
+    interleaved, and ZeRO composition goldens."""
+    from torchdistpackage_tpu.models import gpt_moe_loss
+
+    def serial_loss(p, batch):
+        losses = [
+            gpt_moe_loss(
+                p,
+                {
+                    "tokens": batch["tokens"][
+                        m, rows_per_shard * d : rows_per_shard * (d + 1)
+                    ],
+                    "targets": batch["targets"][
+                        m, rows_per_shard * d : rows_per_shard * (d + 1)
+                    ],
+                },
+                cfg,
+            )
+            for m in range(M)
+            for d in range(nshards)
+        ]
+        return jnp.mean(jnp.stack(losses))
+
+    return serial_loss
+
+
 def test_gpt_moe_1f1b_matches_serial_microbatched(devices8):
     """MoE × PP: the MoE GPT under the 1F1B schedule (EP × MoE-DP × PP) must
     track a serial model trained on the mean of per-microbatch losses — the
@@ -321,22 +351,7 @@ def test_gpt_moe_1f1b_matches_serial_microbatched(devices8):
 
     sparams, sstate = params, opt.init(params)
 
-    def serial_loss(p, batch):
-        # mean over (microbatch, data-shard) chunks — the EP×MoE-DP×PP
-        # step's exact semantics (each device routes its local 2 rows)
-        losses = [
-            gpt_moe_loss(
-                p,
-                {
-                    "tokens": batch["tokens"][m, 2 * d : 2 * d + 2],
-                    "targets": batch["targets"][m, 2 * d : 2 * d + 2],
-                },
-                cfg,
-            )
-            for m in range(M)
-            for d in range(4)
-        ]
-        return jnp.mean(jnp.stack(losses))
+    serial_loss = chunked_moe_serial_loss(cfg, M, nshards=4)
 
     @jax.jit
     def serial_step(p, s, b):
@@ -510,20 +525,7 @@ def test_gpt_moe_interleaved_1f1b_matches_serial(devices8):
 
     sparams, sstate = params, opt.init(params)
 
-    def serial_loss(p, batch):
-        losses = [
-            gpt_moe_loss(
-                p,
-                {
-                    "tokens": batch["tokens"][m, 2 * d : 2 * d + 2],
-                    "targets": batch["targets"][m, 2 * d : 2 * d + 2],
-                },
-                cfg,
-            )
-            for m in range(M)
-            for d in range(4)
-        ]
-        return jnp.mean(jnp.stack(losses))
+    serial_loss = chunked_moe_serial_loss(cfg, M, nshards=4)
 
     @jax.jit
     def serial_step(p, s, b):
