@@ -699,3 +699,58 @@ def test_gpt_moe_expert_choice_trains(devices8):
         losses.append(float(loss))
     assert np.all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_gpt_moe_with_ring_cp_matches_serial(devices8):
+    """MoE × CP (the long-context expert-model pairing): an MoE GPT with
+    ring attention over the context axis — attention sees the full sequence
+    via the ring, each shard routes its LOCAL tokens.  With capacity high
+    enough for zero drops, per-token top-k routing is identical under any
+    chunking, so loss AND grads must match the serial model exactly (aux
+    off: the load-balance product-of-means is per-chunk by design)."""
+    import dataclasses
+
+    from torchdistpackage_tpu.models import (
+        GPTConfig,
+        gpt_moe_loss,
+        init_gpt_moe_params,
+    )
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, nheads=4, nlayers=2, max_seq=16, ffn_mult=2,
+        moe_experts=4, moe_top_k=2, moe_every=2,
+        moe_capacity_factor=4.0, moe_aux_weight=0.0,
+        attn_impl="ring", context_axis="context",
+    )
+    cfg_serial = dataclasses.replace(
+        cfg, attn_impl="naive", context_axis=None
+    )
+    cp = 4
+    tpc.setup_process_groups([("context", cp)], devices=devices8[:cp])
+    mesh = tpc.get_view()
+    params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    batch = {
+        "tokens": jax.random.randint(k1, (4, 16), 0, cfg.vocab_size),
+        "targets": jax.random.randint(k2, (4, 16), 0, cfg.vocab_size),
+    }
+
+    def cp_loss(p, b):
+        # mean over LOCAL tokens -> close with pmean over context
+        return jax.lax.pmean(gpt_moe_loss(p, b, cfg), "context")
+
+    bspec = {"tokens": P(None, "context"), "targets": P(None, "context")}
+    sm = shard_map(cp_loss, mesh=mesh, in_specs=(P(), bspec), out_specs=P())
+    got = jax.jit(sm)(params, batch)
+    want = gpt_moe_loss(params, batch, cfg_serial)
+    np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+
+    g_got = jax.jit(jax.grad(lambda p, b: sm(p, b)))(params, batch)
+    g_want = jax.grad(lambda p, b: gpt_moe_loss(p, b, cfg_serial))(params, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        g_got,
+        g_want,
+    )
