@@ -120,6 +120,15 @@ def _load_baselines(path: str) -> dict:
     return out
 
 
+def _best_recorded(baselines: dict, backend: str, fallback: float) -> float:
+    """The BEST value recorded for ``backend`` across configs — the
+    vs_baseline denominator (a config switch can never re-base history)."""
+    return max(
+        (r["value"] for r in baselines.get(backend, {}).values()),
+        default=fallback,
+    )
+
+
 def _record_baseline(baselines: dict, path: str, backend: str, config: str,
                      value: float) -> None:
     """First measurement of (backend, config) wins; later runs never touch it."""
@@ -266,10 +275,7 @@ def main(jax, jnp, ab: bool = False, only=None) -> None:
             f"{f' ce{xent_chunk}' if xent_chunk else ''}"
         )
         _record_baseline(baselines, baseline_path, backend, config_str, tps)
-        best = max(
-            (r["value"] for r in baselines.get(backend, {}).values()),
-            default=tps,
-        )
+        best = _best_recorded(baselines, backend, tps)
         line = {
             "metric": f"gpt-{'125m' if on_accel else 'tiny'}-train-throughput",
             "value": round(tps, 2),
