@@ -40,6 +40,39 @@ NEG_INF = -1e30  # finite "minus infinity": avoids (-inf) - (-inf) NaNs
 
 _LANES = 128  # m/l scratch keeps a full lane dim for layout friendliness
 
+# Tuned (block_q, block_k) by device_kind substring, measured by the
+# autotuner (tools/flash_tune.py — run it on a new chip generation and add
+# a row; current data: docs/FLASH_TUNE_v5e.json).  _FALLBACK_TILES covers
+# unmeasured chips and the CPU interpreter.
+_TUNED_TILES = (
+    ("v5 lite", (1024, 1024)),
+    ("v5e", (1024, 1024)),
+)
+_FALLBACK_TILES = (1024, 1024)
+
+
+@functools.lru_cache(maxsize=None)
+def _tiles_for(device_kind: str) -> Tuple[int, int]:
+    dk = device_kind.lower()
+    for sub, tiles in _TUNED_TILES:
+        if sub in dk:
+            return tiles
+    return _FALLBACK_TILES
+
+
+def default_tiles() -> Tuple[int, int]:
+    """(block_q, block_k) for the attached chip — autotuned when measured,
+    :data:`_FALLBACK_TILES` otherwise.  The device kind is re-read on every
+    call (only the per-kind lookup is cached): a process can switch
+    backends mid-run (bench.py's CPU fallback does exactly that), so a
+    transient failure or an interpreter-mode first trace must not pin the
+    wrong tiles."""
+    try:
+        dk = jax.devices()[0].device_kind
+    except Exception:
+        return _FALLBACK_TILES
+    return _tiles_for(dk)
+
 
 def _interpret() -> bool:
     return jax.default_backend() == "cpu"
@@ -366,6 +399,10 @@ def _prep(q, k, v, sm_scale, block_q, block_k):
     Sk = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
+    if block_q is None or block_k is None:
+        tq, tk = default_tiles()
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     # clamp to the sequence, then shrink to an exact divisor (gcd) so any
     # shard length works — e.g. ring shards of 384 with block_q=256 use 128
     block_q = math.gcd(min(block_q, Sq), Sq)
@@ -382,8 +419,8 @@ def flash_attention(
     v: jnp.ndarray,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jnp.ndarray:
     """Blockwise (flash) attention.  [B, H, S, D] layout, differentiable.
 
@@ -391,12 +428,13 @@ def flash_attention(
     divisors of S, so any shard length traces; power-of-two S keeps the
     requested blocks.  Pad upstream if S is prime-ish and perf matters.
 
-    Default tiles come from the on-chip autotune
-    (tools/flash_tune.py, docs/FLASH_TUNE_v5e.json): at the bench shape
-    [8, 12, 2048, 64] on v5e, (1024, 1024) runs the fwd+bwd 1.8x faster than
-    the previous (256, 512) default — larger tiles amortize the per-grid-step
-    scratch init/rescale overhead and keep the MXU busier; VMEM per program
-    stays ~2 MB, well under budget at head_dim 64.
+    ``block_q``/``block_k`` default to :func:`default_tiles` — the per-chip
+    autotuned sizes (tools/flash_tune.py, docs/FLASH_TUNE_v5e.json): at the
+    bench shape [8, 12, 2048, 64] on v5e, (1024, 1024) runs the fwd+bwd
+    1.8x faster than the previous (256, 512) default — larger tiles
+    amortize the per-grid-step scratch init/rescale overhead and keep the
+    MXU busier; VMEM per program stays ~2 MB, well under budget at
+    head_dim 64.
     """
     B, H, Sq, D = q.shape
     qf, kf, vf, sm_scale, block_q, block_k = _prep(q, k, v, sm_scale, block_q, block_k)
@@ -410,8 +448,8 @@ def flash_attention_with_lse(
     v: jnp.ndarray,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Like :func:`flash_attention` but also returns the per-row logsumexp
     ``[B, H, S]`` (f32), differentiably.

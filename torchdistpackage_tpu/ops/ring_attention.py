@@ -127,8 +127,10 @@ def ring_attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     use_flash: bool = True,
-    block_q: int = 1024,  # per-hop flash tiles; tuned defaults, see
-    block_k: int = 1024,  # ops/flash_attention.py + docs/FLASH_TUNE_v5e.json
+    # per-hop flash tiles; None = the per-chip autotuned defaults
+    # (ops/flash_attention.default_tiles, docs/FLASH_TUNE_v5e.json)
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     layout: str = "contiguous",
 ) -> jnp.ndarray:
     """Ring attention over the ``axis`` mesh ring.  [B, H, S_local, D] layout
