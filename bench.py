@@ -27,9 +27,19 @@ baselines file. Use this to choose the default config honestly.
 
 Hang-proof structure: the accelerator backend behind the axon tunnel can
 HANG at init (not just raise — observed: ``jax.devices()`` blocking >400 s),
-so the parent process never touches JAX.  It runs the measurement in a child
-process with a timeout (``BENCH_ACCEL_TIMEOUT``, default 900 s), and on
-timeout/crash re-runs pinned to CPU (``BENCH_CPU_TIMEOUT``, default 600 s).
+so the parent process never touches JAX.  Before paying for a full
+measurement child it first runs a cheap ``--probe`` child (imports jax,
+touches the device list, ``BENCH_PROBE_TIMEOUT`` default 90 s) and retries
+the probe ``BENCH_PROBE_ATTEMPTS`` times (default 4) with
+``BENCH_PROBE_DELAY`` (default 30 s) between attempts — several short shots
+across the run instead of one 900 s gamble against a flaky tunnel.  Only a
+successful probe launches the measurement child
+(``BENCH_ACCEL_TIMEOUT``, default 900 s).  When every probe hangs, the
+harness re-runs pinned to CPU (``BENCH_CPU_TIMEOUT``, default 600 s) AND —
+because a CPU number says nothing about the TPU record — finishes with the
+last-good accelerator record from ``BENCH_BASELINE.json`` carrying an
+explicit ``"stale": true`` + its original measurement date, so the driver
+artifact preserves the accelerator history instead of a bare CPU line.
 If everything fails it still prints the JSON line with an ``error`` field.
 Run with ``--measure`` to execute the measurement directly in-process.
 """
@@ -130,19 +140,52 @@ def _best_recorded(baselines: dict, backend: str, fallback: float) -> float:
 
 
 def _record_baseline(baselines: dict, path: str, backend: str, config: str,
-                     value: float) -> None:
+                     value: float, chip: str = "?",
+                     metric: str = "gpt-train-throughput") -> None:
     """First measurement of (backend, config) wins; later runs never touch it."""
     per_cfg = baselines.setdefault(backend, {})
     if config not in per_cfg:
         per_cfg[config] = {
             "backend": backend, "value": value,
             "unit": "tokens/sec/chip", "config": config,
+            "recorded": time.strftime("%Y-%m-%d"),
+            "chip": chip, "metric": metric,
         }
         try:
             with open(path, "w") as f:
                 json.dump(baselines, f, indent=1)
         except OSError:
             pass  # read-only checkout: keep reporting, skip recording
+
+
+def _last_good_accel_line(baselines: dict, reason: str = "unreachable"):
+    """The best non-CPU record across configs, reshaped into a bench line
+    with an explicit staleness marker — emitted when the accelerator can't
+    produce a fresh number this run so the driver artifact carries the
+    accelerator history honestly instead of only a CPU number.  ``reason``
+    states what actually failed (init probes vs the measurement itself) so
+    the artifact never misattributes a regression to tunnel flakiness."""
+    best = None
+    for backend, per_cfg in baselines.items():
+        if backend == "cpu":
+            continue
+        for rec in per_cfg.values():
+            if best is None or rec["value"] > best["value"]:
+                best = rec
+    if best is None:
+        return None
+    return {
+        "metric": best.get("metric", "gpt-125m-train-throughput"),
+        "value": round(best["value"], 2),
+        "unit": best.get("unit", "tokens/sec/chip"),
+        "vs_baseline": 1.0,
+        "config": best.get("config", "?"),
+        "chip": best.get("chip", best.get("backend", "accel")),
+        "stale": True,
+        "measured_this_run": False,
+        "recorded": best.get("recorded", "unknown"),
+        "stale_reason": f"{reason}; last-good record shown",
+    }
 
 
 def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None):
@@ -274,15 +317,18 @@ def main(jax, jnp, ab: bool = False, only=None) -> None:
             f"{' remat' if remat else ''}"
             f"{f' ce{xent_chunk}' if xent_chunk else ''}"
         )
-        _record_baseline(baselines, baseline_path, backend, config_str, tps)
+        metric = f"gpt-{'125m' if on_accel else 'tiny'}-train-throughput"
+        _record_baseline(baselines, baseline_path, backend, config_str, tps,
+                         chip=chip, metric=metric)
         best = _best_recorded(baselines, backend, tps)
         line = {
-            "metric": f"gpt-{'125m' if on_accel else 'tiny'}-train-throughput",
+            "metric": metric,
             "value": round(tps, 2),
             "unit": "tokens/sec/chip",
             "vs_baseline": round(tps / best, 4),
             "config": config_str,
             "chip": chip,
+            "backend": backend,
         }
         if peak:
             line["peak_flops_est"] = peak
@@ -298,23 +344,70 @@ def main(jax, jnp, ab: bool = False, only=None) -> None:
         print(json.dumps(results[0]))
 
 
-def _run_child(env_extra: dict, timeout: float, extra_args=(), capture=False):
-    """Run bench.py --measure in a child.  Returns True/False, or (when
-    ``capture``) the child's stdout str on success / None on failure.
-    ``capture`` captures stdout ONLY — stderr stays inherited so OOM /
-    XLA tracebacks from a failing candidate remain visible."""
+def _probe() -> None:
+    """--probe mode: touch the backend and print one JSON marker.  Run in a
+    short-lived child — the only point is to find out whether backend init
+    hangs WITHOUT committing a 900 s measurement timeout to the answer."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    devs = jax.devices()
+    print(json.dumps({
+        "probe_backend": jax.default_backend(),
+        "probe_chip": devs[0].device_kind,
+        "probe_n_devices": len(devs),
+    }))
+
+
+def _probe_accel(attempts: int, probe_timeout: float, delay: float) -> bool:
+    """Retry short init probes across the run.  True once any probe sees a
+    non-CPU backend; False when every attempt hangs/fails/lands on CPU."""
+    for i in range(attempts):
+        if i:
+            time.sleep(delay)
+        out = _run_child({}, probe_timeout, ("--probe",), capture=True,
+                         quiet=True)
+        if out is None:
+            print(f"bench: init probe {i + 1}/{attempts} hung/failed",
+                  file=sys.stderr)
+            continue
+        for ln in out.splitlines():
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+            if rec.get("probe_backend") and rec["probe_backend"] != "cpu":
+                return True
+        print(f"bench: init probe {i + 1}/{attempts} landed on CPU",
+              file=sys.stderr)
+    return False
+
+
+def _run_child(env_extra: dict, timeout: float, extra_args=(), capture=False,
+               quiet=False):
+    """Run a bench.py child (``--measure`` unless the args say otherwise).
+    Returns True/False, or (when ``capture``) the child's stdout str on
+    success / None on failure.  ``capture`` captures stdout ONLY — stderr
+    stays inherited so OOM / XLA tracebacks from a failing candidate remain
+    visible.  ``quiet`` keeps captured stdout out of the parent's stdout
+    (probe markers are parent-internal, not bench output)."""
     env = dict(os.environ, **env_extra)
+    args = list(extra_args)
+    if "--probe" not in args:
+        args = ["--measure", *args]
     try:
         res = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--measure", *extra_args],
+            [sys.executable, os.path.abspath(__file__), *args],
             env=env,
             timeout=timeout,
             stdout=subprocess.PIPE if capture else None,
             text=capture,
         )
         if capture:
-            sys.stdout.write(res.stdout)
-            sys.stdout.flush()
+            if not quiet:
+                sys.stdout.write(res.stdout)
+                sys.stdout.flush()
             return res.stdout if res.returncode == 0 else None
         return res.returncode == 0
     except subprocess.TimeoutExpired:
@@ -322,16 +415,21 @@ def _run_child(env_extra: dict, timeout: float, extra_args=(), capture=False):
         return None if capture else False
 
 
-def _ab_main(timeout: float) -> None:
+def _ab_main(timeout: float, allow_cpu: bool = False) -> None:
     """One child per candidate: an OOM/hang in one config cannot abort the
     sweep (observed: b16 no-remat exhausts v5e HBM and killed the round-3
     sweep's remaining configs), and each child gets a fresh backend — no
     allocator fragmentation carry-over between configs.
 
     A child that lands on CPU (explicit JAX_PLATFORMS=cpu, or accelerator
-    init failure inside the child) has a 1-entry candidate list: it emits a
-    ``skipped_candidate`` marker for out-of-range indices and the sweep
-    stops — the remaining TPU candidates are meaningless on CPU."""
+    init failure inside the child) must not feed the sweep: its measurement
+    of a TPU candidate is meaningless.  Two markers catch it — the
+    ``skipped_candidate`` marker (out-of-range index on the CPU 1-entry
+    list) and, for candidate 0 which IS in range on CPU, the line's own
+    ``backend`` field — either stops the sweep without updating ``best``.
+    Exception: under an EXPLICIT ``JAX_PLATFORMS=cpu`` (``allow_cpu``) the
+    user asked for the CPU sweep, so CPU lines are the legitimate result
+    and only the end-of-list marker stops."""
     best = None
     for i in range(len(TPU_CANDIDATES)):
         out = _run_child({}, timeout, ("--ab", "--only", str(i)), capture=True)
@@ -347,11 +445,17 @@ def _ab_main(timeout: float) -> None:
                 rec = json.loads(ln)
             except ValueError:
                 continue
-            if "skipped_candidate" in rec:
+            if "skipped_candidate" in rec or (
+                    rec.get("backend") == "cpu" and not allow_cpu):
                 stop = True
+                continue
             if "value" in rec and (best is None or rec["value"] > best["value"]):
                 best = rec
         if stop:
+            if not allow_cpu:
+                print("bench: a sweep child fell back to CPU; stopping the "
+                      "A/B sweep (TPU candidates are meaningless on CPU)",
+                      file=sys.stderr)
             break
     if best is not None:
         print(json.dumps({"ab_winner": best["config"], "value": best["value"]}))
@@ -360,28 +464,67 @@ def _ab_main(timeout: float) -> None:
 
 
 if __name__ == "__main__":
+    if "--probe" in sys.argv:
+        _probe()
+        sys.exit(0)
     if "--measure" in sys.argv:
         _measure()  # prints the JSON line(s) itself
         sys.exit(0)
 
     accel_timeout = float(os.environ.get("BENCH_ACCEL_TIMEOUT", "900"))
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "600"))
+    probe_attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "4"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
+    probe_delay = float(os.environ.get("BENCH_PROBE_DELAY", "30"))
+
+    on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    _baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
 
     if "--ab" in sys.argv:
-        on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
-        _ab_main(cpu_timeout if on_cpu else accel_timeout)
+        if not on_cpu and not _probe_accel(
+                probe_attempts, probe_timeout, probe_delay):
+            print("bench: accelerator unreachable; not starting the A/B "
+                  "sweep (TPU candidates are meaningless on CPU)",
+                  file=sys.stderr)
+            print(json.dumps(
+                {"ab_winner": None, "error": "accelerator unreachable"}))
+            sys.exit(0)
+        _ab_main(cpu_timeout if on_cpu else accel_timeout, allow_cpu=on_cpu)
         sys.exit(0)
 
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    if on_cpu:
         ok = _run_child({}, cpu_timeout)
     else:
-        ok = _run_child({}, accel_timeout)
+        ok = False
+        probed = _probe_accel(probe_attempts, probe_timeout, probe_delay)
+        if probed:
+            ok = _run_child({}, accel_timeout)
+            if not ok:
+                # init works (probe passed) — the failure was in the
+                # measurement itself; one retry before giving up on the chip
+                print("bench: accelerator measurement failed after a good "
+                      "probe; retrying once", file=sys.stderr)
+                ok = _run_child({}, accel_timeout)
         if not ok:
-            print(
-                "bench: accelerator path failed or hung; re-running on CPU",
-                file=sys.stderr,
-            )
-            ok = _run_child({"JAX_PLATFORMS": "cpu"}, cpu_timeout)
+            print("bench: accelerator unreachable/failed; measuring on CPU "
+                  "and attaching the last-good accelerator record",
+                  file=sys.stderr)
+            cpu_ok = _run_child({"JAX_PLATFORMS": "cpu"}, cpu_timeout)
+            reason = (
+                "accelerator measurement children failed after a successful "
+                "init probe" if probed else
+                "accelerator backend unreachable this run "
+                "(init probes exhausted)")
+            stale = _last_good_accel_line(
+                _load_baselines(_baseline_path), reason=reason)
+            if stale is not None:
+                if not cpu_ok:
+                    stale["error"] = "cpu fallback measurement also failed"
+                print(json.dumps(stale))
+                ok = True
+            else:
+                ok = cpu_ok
     if not ok:
         print(json.dumps({
             "metric": "gpt-train-throughput",

@@ -84,3 +84,48 @@ def test_peak_flops_lookup():
     assert b._peak_flops("TPU v5 lite") == 197e12
     assert b._peak_flops("TPU v4") == 275e12
     assert b._peak_flops("some future chip") is None
+
+
+def test_last_good_accel_line():
+    b = _bench()
+    baselines = {
+        "cpu": {"tiny": {"backend": "cpu", "value": 5000.0, "config": "tiny"}},
+        "tpu": {
+            "cfgA": {"backend": "tpu", "value": 62000.0, "config": "cfgA",
+                     "chip": "TPU v5 lite", "recorded": "2026-06-01"},
+            "cfgB": {"backend": "tpu", "value": 84000.0, "config": "cfgB",
+                     "chip": "TPU v5 lite", "recorded": "2026-06-02"},
+        },
+    }
+    line = b._last_good_accel_line(baselines, reason="init probes exhausted")
+    # the BEST non-CPU record, never a CPU one
+    assert line["value"] == 84000.0
+    assert line["config"] == "cfgB"
+    assert line["chip"] == "TPU v5 lite"
+    # staleness is explicit and machine-readable, and the reason reports
+    # what ACTUALLY failed (init vs measurement) — never hardcoded
+    assert line["stale"] is True
+    assert line["measured_this_run"] is False
+    assert line["recorded"] == "2026-06-02"
+    assert "init probes exhausted" in line["stale_reason"]
+    line2 = b._last_good_accel_line(baselines, reason="measurement failed")
+    assert "measurement failed" in line2["stale_reason"]
+    # metric name matches the fresh accelerator series (legacy records
+    # without a stored metric fall back to the accel metric name)
+    assert line["metric"] == "gpt-125m-train-throughput"
+    baselines["tpu"]["cfgB"]["metric"] = "custom-metric"
+    assert b._last_good_accel_line(baselines)["metric"] == "custom-metric"
+
+    # CPU-only history -> no stale line (nothing to honestly report)
+    assert b._last_good_accel_line({"cpu": baselines["cpu"]}) is None
+    assert b._last_good_accel_line({}) is None
+
+
+def test_record_baseline_stamps_date_and_chip(tmp_path):
+    b = _bench()
+    p = str(tmp_path / "b.json")
+    baselines = {}
+    b._record_baseline(baselines, p, "tpu", "cfgA", 100.0, chip="TPU v5 lite")
+    rec = baselines["tpu"]["cfgA"]
+    assert rec["chip"] == "TPU v5 lite"
+    assert len(rec["recorded"]) == 10  # YYYY-MM-DD
