@@ -34,12 +34,16 @@ the probe ``BENCH_PROBE_ATTEMPTS`` times (default 4) with
 ``BENCH_PROBE_DELAY`` (default 30 s) between attempts — several short shots
 across the run instead of one 900 s gamble against a flaky tunnel.  Only a
 successful probe launches the measurement child
-(``BENCH_ACCEL_TIMEOUT``, default 900 s).  When every probe hangs, the
-harness re-runs pinned to CPU (``BENCH_CPU_TIMEOUT``, default 600 s) AND —
-because a CPU number says nothing about the TPU record — finishes with the
-last-good accelerator record from ``BENCH_BASELINE.json`` carrying an
-explicit ``"stale": true`` + its original measurement date, so the driver
-artifact preserves the accelerator history instead of a bare CPU line.
+(``BENCH_ACCEL_TIMEOUT``, default 900 s).  A probe that ANSWERS with
+backend cpu short-circuits the retries — that is a CPU-only host, not a
+flaky tunnel.  When no accelerator is reachable, the harness re-runs
+pinned to CPU (``BENCH_CPU_TIMEOUT``, default 600 s) AND — because a CPU
+number says nothing about the TPU record — finishes with the last-good
+accelerator record from ``BENCH_BASELINE.json`` carrying an explicit
+``"stale": true`` + its original measurement date and a reason that
+distinguishes init hangs / measurement failures / CPU-only hosts, so the
+driver artifact preserves the accelerator history instead of a bare CPU
+line.
 If everything fails it still prints the JSON line with an ``error`` field.
 Run with ``--measure`` to execute the measurement directly in-process.
 """
@@ -360,9 +364,16 @@ def _probe() -> None:
     }))
 
 
-def _probe_accel(attempts: int, probe_timeout: float, delay: float) -> bool:
-    """Retry short init probes across the run.  True once any probe sees a
-    non-CPU backend; False when every attempt hangs/fails/lands on CPU."""
+def _probe_accel(attempts: int, probe_timeout: float, delay: float) -> str:
+    """Retry short init probes across the run.  Returns
+
+    - ``'accel'`` as soon as a probe sees a non-CPU backend,
+    - ``'cpu'`` when a probe ANSWERS with backend cpu — a deterministic
+      statement that no accelerator platform is visible on this host, so
+      retrying is pointless (a CPU-only dev box must not pay 4 probes + 90 s
+      of sleeps, and must not be reported as a tunnel outage), and
+    - ``'hang'`` when every attempt hung or crashed (the flaky-tunnel mode
+      that the retries exist for)."""
     for i in range(attempts):
         if i:
             time.sleep(delay)
@@ -377,11 +388,13 @@ def _probe_accel(attempts: int, probe_timeout: float, delay: float) -> bool:
                 rec = json.loads(ln)
             except ValueError:
                 continue
-            if rec.get("probe_backend") and rec["probe_backend"] != "cpu":
-                return True
-        print(f"bench: init probe {i + 1}/{attempts} landed on CPU",
-              file=sys.stderr)
-    return False
+            if rec.get("probe_backend") == "cpu":
+                print("bench: probe reports a CPU-only host; not retrying",
+                      file=sys.stderr)
+                return "cpu"
+            if rec.get("probe_backend"):
+                return "accel"
+    return "hang"
 
 
 def _run_child(env_extra: dict, timeout: float, extra_args=(), capture=False,
@@ -482,8 +495,8 @@ if __name__ == "__main__":
         os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
 
     if "--ab" in sys.argv:
-        if not on_cpu and not _probe_accel(
-                probe_attempts, probe_timeout, probe_delay):
+        if not on_cpu and _probe_accel(
+                probe_attempts, probe_timeout, probe_delay) != "accel":
             print("bench: accelerator unreachable; not starting the A/B "
                   "sweep (TPU candidates are meaningless on CPU)",
                   file=sys.stderr)
@@ -498,7 +511,7 @@ if __name__ == "__main__":
     else:
         ok = False
         probed = _probe_accel(probe_attempts, probe_timeout, probe_delay)
-        if probed:
+        if probed == "accel":
             ok = _run_child({}, accel_timeout)
             if not ok:
                 # init works (probe passed) — the failure was in the
@@ -511,11 +524,14 @@ if __name__ == "__main__":
                   "and attaching the last-good accelerator record",
                   file=sys.stderr)
             cpu_ok = _run_child({"JAX_PLATFORMS": "cpu"}, cpu_timeout)
-            reason = (
-                "accelerator measurement children failed after a successful "
-                "init probe" if probed else
-                "accelerator backend unreachable this run "
-                "(init probes exhausted)")
+            reason = {
+                "accel": "accelerator measurement children failed after a "
+                         "successful init probe",
+                "cpu": "no accelerator platform visible on this host "
+                       "(probe answered cpu)",
+                "hang": "accelerator backend unreachable this run "
+                        "(init probes exhausted)",
+            }[probed]
             stale = _last_good_accel_line(
                 _load_baselines(_baseline_path), reason=reason)
             if stale is not None:

@@ -121,6 +121,44 @@ def test_last_good_accel_line():
     assert b._last_good_accel_line({}) is None
 
 
+def test_probe_accel_tristate(monkeypatch):
+    """'accel' on a non-CPU answer; 'cpu' short-circuits retries (a CPU-only
+    host is a deterministic answer, not a flake); 'hang' only after every
+    attempt failed."""
+    b = _bench()
+    b.time.sleep = lambda s: None  # no real sleeping in tests
+
+    calls = []
+
+    def fake_child(answers):
+        it = iter(answers)
+
+        def run(env, timeout, extra_args=(), capture=False, quiet=False):
+            calls.append(extra_args)
+            nxt = next(it)
+            return None if nxt is None else json.dumps(
+                {"probe_backend": nxt, "probe_chip": nxt, "probe_n_devices": 1})
+        return run
+
+    b._run_child = fake_child(["tpu"])
+    assert b._probe_accel(4, 1.0, 0.0) == "accel"
+    assert len(calls) == 1  # first success stops
+
+    calls.clear()
+    b._run_child = fake_child(["cpu", "tpu"])
+    assert b._probe_accel(4, 1.0, 0.0) == "cpu"
+    assert len(calls) == 1  # cpu answer short-circuits, no retry
+
+    calls.clear()
+    b._run_child = fake_child([None, None, "tpu"])
+    assert b._probe_accel(3, 1.0, 0.0) == "accel"
+    assert len(calls) == 3  # hangs retry until the answer
+
+    calls.clear()
+    b._run_child = fake_child([None, None])
+    assert b._probe_accel(2, 1.0, 0.0) == "hang"
+
+
 def test_record_baseline_stamps_date_and_chip(tmp_path):
     b = _bench()
     p = str(tmp_path / "b.json")

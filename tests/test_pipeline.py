@@ -724,6 +724,63 @@ def test_interleaved_1f1b_matches_serial(devices8, pp, vv, m):
     )
 
 
+def test_interleaved_wrong_stage_fn_arity_raises(devices8):
+    """num_chunks > 1 with a stage_fn that can't take (p, x, m, v) must be
+    rejected with a contract error naming the required signature, not an
+    opaque TypeError from inside tracing (ADVICE r3)."""
+    tpc.setup_process_groups([("pipe", 2)], devices=devices8[:2])
+    mesh = tpc.get_view()
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    itree = _interleave(stack_stage_params([init_block_params(k, CFG) for k in keys]), 2, 2)
+    specs = _interleaved_specs(itree)
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), itree, specs
+    )
+    x = jnp.zeros((4, MBS, S, CFG.dim))
+    y = jnp.zeros((4, MBS, S, CFG.dim))
+
+    def two_arg_stage(params, h):  # V=1-style signature: must be rejected
+        return h
+
+    with pytest.raises(ValueError, match=r"\(params, x, microbatch_idx"):
+        jax.jit(
+            shard_map(
+                functools.partial(
+                    pipeline_1f1b,
+                    first_fn=lambda p, mb: mb,
+                    stage_fn=two_arg_stage,
+                    last_fn=lambda p, yy, t: jnp.mean((yy - t) ** 2),
+                    num_microbatches=4,
+                    num_chunks=2,
+                ),
+                mesh=mesh,
+                in_specs=(specs, P(), P()),
+                out_specs=(P(), specs),
+            )
+        )(sharded, x, y)
+
+    # a *args stage_fn is unintrospectable-compatible and must pass the check
+    def var_stage(*args):
+        return args[1]
+
+    loss, _ = jax.jit(
+        shard_map(
+            functools.partial(
+                pipeline_1f1b,
+                first_fn=lambda p, mb: mb,
+                stage_fn=var_stage,
+                last_fn=lambda p, yy, t: jnp.mean((yy - t) ** 2),
+                num_microbatches=4,
+                num_chunks=2,
+            ),
+            mesh=mesh,
+            in_specs=(specs, P(), P()),
+            out_specs=(P(), specs),
+        )
+    )(sharded, x, y)
+    assert np.isfinite(float(loss))
+
+
 def test_interleaved_1f1b_ring_memory_bounded(devices8):
     """Interleaved memory guarantee: the scan carries ring_slots(M, P, V) =
     min(VM, 2PV-1) chunk inputs — NOT V*M of them."""

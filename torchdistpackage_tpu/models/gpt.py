@@ -71,7 +71,13 @@ class GPTConfig:
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
-    moe_router: str = "topk"  # 'topk' | 'expert_choice' (see MoEConfig)
+    # 'topk' only for this family: GPT is autoregressive and
+    # 'expert_choice' routing is non-causal (each expert ranks the whole
+    # sequence -> future-token leak), so gpt_moe rejects it at trace time.
+    # EC remains available through moe_forward(causal=False) for
+    # encoder/non-AR models built from the same MoE layer.
+    moe_router: str = "topk"
+    moe_dispatch: str = "auto"  # 'dense' | 'sorted' | 'auto' (see MoEConfig)
 
     def __post_init__(self):
         if self.context_axis is not None and self.attn_impl not in ("ring", "ulysses"):

@@ -72,6 +72,7 @@ def moe_layer_config(cfg: GPTConfig) -> MoEConfig:
         aux_loss_weight=cfg.moe_aux_weight,
         dtype=cfg.dtype,
         router=cfg.moe_router,
+        dispatch=cfg.moe_dispatch,
     )
 
 
@@ -113,7 +114,10 @@ def moe_block_forward(
 
     h = layer_norm(x, p["ln2"])
     full = gather_from_sp(h, axis) if (axis and sp) else h
-    z, aux = moe_forward(p["moe"], full, mcfg, ep_axis=ep_axis)
+    # causal=True: the GPT family is autoregressive — this rejects the
+    # (non-causal) expert_choice router at trace time instead of silently
+    # leaking future tokens through the routing decision
+    z, aux = moe_forward(p["moe"], full, mcfg, ep_axis=ep_axis, causal=True)
     if axis and sp:
         z = split_to_sp(z, axis)
     return x + dropout(z, bcfg.dropout_rate, k_mlp), aux

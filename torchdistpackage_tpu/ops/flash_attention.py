@@ -43,12 +43,15 @@ _LANES = 128  # m/l scratch keeps a full lane dim for layout friendliness
 # Tuned (block_q, block_k) by device_kind substring, measured by the
 # autotuner (tools/flash_tune.py — run it on a new chip generation and add
 # a row; current data: docs/FLASH_TUNE_v5e.json).  _FALLBACK_TILES covers
-# unmeasured chips and the CPU interpreter.
+# unmeasured chips and the CPU interpreter, and stays conservative on
+# purpose: (1024, 1024) was measured fastest on v5e ONLY — an unmeasured
+# generation gets the safe small tiles (no VMEM-pressure surprises), and
+# earns larger ones the day flash_tune.py runs on it.
 _TUNED_TILES = (
     ("v5 lite", (1024, 1024)),
     ("v5e", (1024, 1024)),
 )
-_FALLBACK_TILES = (1024, 1024)
+_FALLBACK_TILES = (256, 512)
 
 
 @functools.lru_cache(maxsize=None)
@@ -57,6 +60,16 @@ def _tiles_for(device_kind: str) -> Tuple[int, int]:
     for sub, tiles in _TUNED_TILES:
         if sub in dk:
             return tiles
+    if jax.default_backend() != "cpu":
+        # once per kind (lru_cache): a mis-tiled accelerator run must be
+        # visible, or fallback-served chips silently bench below potential
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "flash_attention: no autotuned tile row for device_kind=%r; "
+            "serving conservative fallback %s — run tools/flash_tune.py on "
+            "this chip and add a _TUNED_TILES row", device_kind,
+            _FALLBACK_TILES)
     return _FALLBACK_TILES
 
 

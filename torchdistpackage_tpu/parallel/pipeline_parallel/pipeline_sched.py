@@ -469,6 +469,32 @@ def pipeline_1f1b(
     # masks are identical between forward and recompute.  With V > 1 the
     # stage fn must take (p, x, m, v) — v selects the chunk's param slab.
     if V > 1:
+        # fail the CONTRACT loudly: a stage_fn(p, x) or (p, x, m) would
+        # otherwise surface as an opaque arity TypeError from inside tracing
+        # when the scheduler calls it with four arguments
+        try:
+            import inspect
+
+            sig_params = inspect.signature(stage_fn).parameters.values()
+        except (TypeError, ValueError):
+            sig_params = None  # unintrospectable callable: let it through
+        if sig_params is not None and not any(
+            p.kind is inspect.Parameter.VAR_POSITIONAL for p in sig_params
+        ):
+            n_pos = sum(
+                p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                           inspect.Parameter.POSITIONAL_OR_KEYWORD)
+                for p in sig_params
+            )
+            if n_pos < 4:
+                raise ValueError(
+                    f"num_chunks > 1 (interleaved schedule) requires a "
+                    f"stage_fn with signature (params, x, microbatch_idx, "
+                    f"chunk_idx); got a callable taking {n_pos} positional "
+                    f"args. The scheduler passes m to replay per-microbatch "
+                    f"behavior in the backward recompute and v to select "
+                    f"the chunk's param slab."
+                )
         call_stage = stage_fn  # (p, x, m, v)
     elif stage_takes_mb:
         call_stage = lambda p, x, m, v: stage_fn(p, x, m)
