@@ -1,0 +1,84 @@
+"""Worker for tests/test_multiprocess.py — NOT a pytest file.
+
+Each of the two spawned processes runs this script: jax.distributed
+bootstrap through the REAL ``dist/launch.py`` torchrun-style env path
+(RANK / WORLD_SIZE / MASTER_ADDR / MASTER_PORT, the analogue of the
+reference's ``setup_distributed``, launch_from_slurm.py:16-62), then forms
+global meshes spanning both processes and drives the package's own
+collective smoke test plus a DP train step whose loss the parent checks
+for cross-rank and vs-single-process parity.
+"""
+
+import os
+import sys
+
+# 4 virtual CPU devices per process -> 8 global
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# cross-process CPU collectives ride gloo (the CPU stand-in for ICI/DCN)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from torchdistpackage_tpu.dist.launch import setup_distributed
+
+setup_distributed()
+rank = jax.process_index()
+assert jax.process_count() == 2, jax.process_count()
+assert jax.local_device_count() == 4 and jax.device_count() == 8
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from torchdistpackage_tpu.dist import tpc
+from torchdistpackage_tpu.dist.topology import test_comm
+from torchdistpackage_tpu.models import (
+    GPTConfig,
+    gpt_loss,
+    init_gpt_params,
+)
+from torchdistpackage_tpu.parallel import DataParallel
+from torchdistpackage_tpu.utils.data import global_batch_from_local
+
+# --- collectives over axes whose groups SPAN the two processes
+tpc.setup_process_groups([("data", 4), ("tensor", 2)])
+res = test_comm(tpc.get_view())
+assert res == {"data": True, "tensor": True}, res
+print(f"rank {rank}: test_comm ok {res}", flush=True)
+
+# --- DP train-step parity: every process computes the SAME global step
+tpc.reset()
+tpc.setup_process_groups([("data", 8)])
+mesh = tpc.get_view()
+cfg = GPTConfig(
+    vocab_size=64, dim=32, nheads=4, nlayers=2, max_seq=16, ffn_mult=2,
+    dtype=jnp.float32,
+)
+params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+dp = DataParallel(mesh=mesh)
+sharded = dp.broadcast_params(params)
+opt = optax.sgd(1e-2)
+state = opt.init(sharded)
+step = dp.make_train_step(
+    lambda p, b: gpt_loss(p, b, cfg),
+    opt,
+    batch_spec={"tokens": P("data"), "targets": P("data")},
+)
+
+# global batch of 8 rows; this process materializes ONLY its 4 local rows
+k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+tokens = np.asarray(jax.random.randint(k1, (8, 16), 0, cfg.vocab_size))
+targets = np.asarray(jax.random.randint(k2, (8, 16), 0, cfg.vocab_size))
+lo, hi = 4 * rank, 4 * rank + 4
+batch = global_batch_from_local(
+    {"tokens": tokens[lo:hi], "targets": targets[lo:hi]},
+    mesh,
+    {"tokens": P("data"), "targets": P("data")},
+)
+for _ in range(2):
+    sharded, state, loss = step(sharded, state, batch)
+print(f"rank {rank}: LOSS={float(loss):.8f}", flush=True)

@@ -71,6 +71,17 @@ def test_record_baseline_first_wins(tmp_path):
     # no records for a backend -> the current measurement is its own baseline
     assert b._best_recorded(baselines, "cpu", fallback=42.0) == 42.0
 
+    # metric scoping: different model sizes are different series — the 1b
+    # config's denominator ignores 125m records and vice versa
+    baselines["tpu"]["cfgA"]["metric"] = "gpt-125m-train-throughput"
+    baselines["tpu"]["cfgB"]["metric"] = "gpt-125m-train-throughput"
+    b._record_baseline(baselines, p, "tpu", "big1", 12.0,
+                       metric="gpt-1b-train-throughput")
+    assert b._best_recorded(
+        baselines, "tpu", 12.0, metric="gpt-1b-train-throughput") == 12.0
+    assert b._best_recorded(
+        baselines, "tpu", 80.0, metric="gpt-125m-train-throughput") == 100.0
+
 
 def test_only_index_parsing():
     b = _bench()
@@ -126,7 +137,9 @@ def test_probe_accel_tristate(monkeypatch):
     host is a deterministic answer, not a flake); 'hang' only after every
     attempt failed."""
     b = _bench()
-    b.time.sleep = lambda s: None  # no real sleeping in tests
+    # no real sleeping; scoped so stdlib time.sleep is restored after the
+    # test (b.time IS the shared stdlib module)
+    monkeypatch.setattr(b.time, "sleep", lambda s: None)
 
     calls = []
 
@@ -136,8 +149,13 @@ def test_probe_accel_tristate(monkeypatch):
         def run(env, timeout, extra_args=(), capture=False, quiet=False):
             calls.append(extra_args)
             nxt = next(it)
-            return None if nxt is None else json.dumps(
-                {"probe_backend": nxt, "probe_chip": nxt, "probe_n_devices": 1})
+            if nxt is None:
+                return None
+            failed = ["axon"] if nxt == "cpu-after-error" else []
+            backend = "cpu" if nxt == "cpu-after-error" else nxt
+            return json.dumps(
+                {"probe_backend": backend, "probe_chip": backend,
+                 "probe_n_devices": 1, "probe_failed_platforms": failed})
         return run
 
     b._run_child = fake_child(["tpu"])
@@ -156,6 +174,17 @@ def test_probe_accel_tristate(monkeypatch):
 
     calls.clear()
     b._run_child = fake_child([None, None])
+    assert b._probe_accel(2, 1.0, 0.0) == "hang"
+
+    # a CPU answer caused by an accelerator-platform init ERROR is the
+    # flaky tunnel, not a CPU-only host: it must keep retrying
+    calls.clear()
+    b._run_child = fake_child(["cpu-after-error", "tpu"])
+    assert b._probe_accel(4, 1.0, 0.0) == "accel"
+    assert len(calls) == 2
+
+    calls.clear()
+    b._run_child = fake_child(["cpu-after-error", "cpu-after-error"])
     assert b._probe_accel(2, 1.0, 0.0) == "hang"
 
 

@@ -164,7 +164,13 @@ def test_int8_ring_pmean_bounded_error(devices8):
     def body(g):
         local = g  # per-shard slice [1, 64, 32] -> squeeze
         approx = int8_ring_pmean(local[0], "data")
+        # the ring's output is invariance-TYPED over the axis (what lets it
+        # compose with TP/PP under check_vma) — pvary back to per-rank form
+        # so the test can fetch every rank's copy and prove bit-identity of
+        # the VALUES too, not just trust the type
+        approx = jax.lax.pvary(approx, "data")
         exact = jax.lax.pmean(local[0], "data")
+        exact = jax.lax.pvary(exact, "data")
         return approx[None], exact[None]
 
     approx, exact = jax.jit(
@@ -218,3 +224,70 @@ def test_int8_compressed_training_converges(devices8):
         np.testing.assert_allclose(
             np.asarray(p_q[k]), np.asarray(p_exact[k]), rtol=0.1, atol=5e-3
         )
+
+
+def test_int8_compression_composes_with_tp(devices8):
+    """grad_compress='int8' on a (data, tensor) mesh — the hybrid scenario
+    where wire bytes matter most (reference Intro.md:69-77) and which the
+    old check_vma=False design rejected outright.  The compressed TP run
+    must track the exact TP run within quantization noise, and the model
+    (TP-sharded leaves included) must keep training."""
+    from jax.sharding import PartitionSpec as P
+
+    from torchdistpackage_tpu.models import (
+        GPTConfig,
+        gpt_loss,
+        gpt_param_specs,
+        init_gpt_params,
+    )
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, nheads=4, nlayers=2, max_seq=16, ffn_mult=2)
+    tpc.setup_process_groups([("data", 4), ("tensor", 2)], devices=devices8)
+    mesh = tpc.get_view()
+    specs = gpt_param_specs(cfg, tp_axis="tensor")
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.sgd(1e-2)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(5))
+    batch = {
+        "tokens": np.asarray(
+            jax.random.randint(k1, (8, 16), 0, cfg.vocab_size)),
+        "targets": np.asarray(
+            jax.random.randint(k2, (8, 16), 0, cfg.vocab_size)),
+    }
+
+    def run(compress):
+        dp = DataParallel(mesh=mesh, grad_compress=compress,
+                          compress_min_size=0)
+        p = dp.broadcast_params(jax.tree.map(np.asarray, params),
+                                param_specs=specs)
+        s = opt.init(p)
+        step = dp.make_train_step(
+            lambda pp, bb: gpt_loss(pp, bb, cfg, axis="tensor", sp=True),
+            opt,
+            param_specs=specs,
+            batch_spec={"tokens": P("data"), "targets": P("data")},
+        )
+        from torchdistpackage_tpu.utils.data import shard_batch
+
+        b = shard_batch(batch, mesh, {"tokens": P("data"), "targets": P("data")})
+        losses = []
+        for _ in range(3):
+            p, s, loss = step(p, s, b)
+            losses.append(float(loss))
+        return p, losses
+
+    p_exact, l_exact = run(None)
+    p_q, l_q = run("int8")
+    assert l_q[-1] < l_q[0]
+    np.testing.assert_allclose(l_q, l_exact, rtol=0.05)
+    # a TP-sharded leaf and a replicated leaf both stay close to exact
+    np.testing.assert_allclose(
+        np.asarray(p_q["blocks"]["mlp"]["w1"]),
+        np.asarray(p_exact["blocks"]["mlp"]["w1"]),
+        rtol=0.1, atol=5e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(p_q["tok_emb"]), np.asarray(p_exact["tok_emb"]),
+        rtol=0.1, atol=5e-3,
+    )

@@ -172,6 +172,85 @@ def test_tp_sp_pp_dp_training_matches_serial(devices8, params):
     )
 
 
+def _iter_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            for v in val if isinstance(val, (list, tuple)) else [val]:
+                inner = getattr(v, "jaxpr", v)
+                if hasattr(inner, "eqns"):
+                    yield from _iter_eqns(inner)
+
+
+def _ppermute_bytes(fn, *args):
+    """Total bytes of ppermute operands in fn's jaxpr (per call site, not
+    per execution) — the pipe-edge payload diagnostic."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    return sum(
+        int(np.prod(e.invars[0].aval.shape)) * e.invars[0].aval.dtype.itemsize
+        for e in _iter_eqns(jaxpr.jaxpr)
+        if e.primitive.name == "ppermute"
+    )
+
+
+def test_gpt_1f1b_tp_nosp_sharded_transfers_match_serial(devices8, params):
+    """The scatter_gather_tensors analogue (reference comm.py:108-155): under
+    non-SP TP the inter-stage state is carried sliced 1/tp over the tensor
+    axis.  (a) goldens unchanged — PP=2 x TP=2 (no SP) 1F1B training tracks
+    the serial model; (b) the pipe ppermute payload bytes drop by exactly
+    tp_size vs shard_transfers=False."""
+    M, mbs = 4, 2
+    tpc.setup_process_groups([("pipe", 2), ("tensor", 2)], devices=devices8[:4])
+    mesh = tpc.get_view()
+    specs = gpt_param_specs(CFG, tp_axis="tensor", pipe_axis="pipe")
+
+    def make_vg(shard_transfers):
+        def vg_fn(p, batch):
+            return gpt_pipeline_1f1b(
+                p, batch, CFG, num_microbatches=M, tp_axis="tensor", sp=False,
+                shard_transfers=shard_transfers,
+            )
+
+        return shard_map(
+            vg_fn, mesh=mesh,
+            in_specs=(specs, {"tokens": P(), "targets": P()}),
+            out_specs=(P(), specs),
+        )
+
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+    )
+    k1, k2 = jax.random.split(jax.random.PRNGKey(21))
+    batch = {
+        "tokens": jax.random.randint(k1, (M, mbs, S), 0, CFG.vocab_size),
+        "targets": jax.random.randint(k2, (M, mbs, S), 0, CFG.vocab_size),
+    }
+
+    loss, grads = jax.jit(make_vg(True))(sharded, batch)
+
+    def serial_loss(p, b):
+        return jnp.mean(jnp.stack([
+            gpt_loss(
+                p, {"tokens": b["tokens"][m], "targets": b["targets"][m]}, CFG
+            )
+            for m in range(M)
+        ]))
+
+    sloss, sgrads = jax.value_and_grad(serial_loss)(params, batch)
+    np.testing.assert_allclose(float(loss), float(sloss), rtol=1e-5, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        grads, sgrads,
+    )
+
+    # payload diagnostic: transfers carry 1/tp of the state
+    on = _ppermute_bytes(make_vg(True), sharded, batch)
+    off = _ppermute_bytes(make_vg(False), sharded, batch)
+    assert on * 2 == off, (on, off)
+
+
 def test_gpt_1f1b_training_matches_serial(devices8, params):
     """Full-composition 1F1B: DP=2 x PP=2 x TP=2 (+SP) with the interleaved
     schedule supplying (loss, grads) directly to the DataParallel step; two
@@ -800,17 +879,15 @@ def test_gpt_interleaved_requires_divisible_microbatches(devices8, params):
         )(sharded, batch)
 
 
-def test_interleave_roundtrip_and_vit_cp_pp_guard(devices8, params):
+def test_interleave_roundtrip(devices8, params):
     """Layout portability: interleave -> deinterleave is the identity (a
-    checkpoint from either pipelined layout resumes in the other), and the
-    unsupported ViT CP x PP combination fails loudly with the grad-semantics
-    explanation rather than silently mis-scaling gradients."""
+    checkpoint from either pipelined layout resumes in the other).  The ViT
+    CP x PP guard that used to live here is gone: the composition is now
+    supported (context as a MODEL axis) and golden-tested in
+    test_vit.py::test_vit_1f1b_with_cp_matches_serial."""
     from torchdistpackage_tpu.models import (
-        ViTConfig,
         deinterleave_stage_params,
-        init_vit_params,
         interleave_stage_params,
-        vit_pipeline_1f1b,
     )
 
     ip = interleave_stage_params(params, 2, 2)
@@ -822,16 +899,3 @@ def test_interleave_roundtrip_and_vit_cp_pp_guard(devices8, params):
     )
     with pytest.raises(ValueError, match="not an interleaved layout"):
         deinterleave_stage_params(ip, 4, 2)
-
-    cp_cfg = ViTConfig(
-        image_size=32, patch_size=8, channels=3, num_classes=16,
-        dim=64, nheads=4, nlayers=2, ffn_mult=2,
-        attn_impl="ring", context_axis="context",
-    )
-    vparams = init_vit_params(jax.random.PRNGKey(0), cp_cfg)
-    batch = {
-        "images": jnp.zeros((2, 2, 32, 32, 3)),
-        "labels": jnp.zeros((2, 2), jnp.int32),
-    }
-    with pytest.raises(NotImplementedError, match="sum \\(not mean\\)"):
-        vit_pipeline_1f1b(vparams, batch, cp_cfg, num_microbatches=2)

@@ -453,6 +453,7 @@ def gpt_pipeline_1f1b(
     remat: bool = True,
     dropout_key: Optional[jax.Array] = None,
     num_chunks: int = 1,
+    shard_transfers: Optional[bool] = None,
 ):
     """1F1B-scheduled GPT training step core: returns ``(loss, grads)``
     directly (do NOT wrap in ``jax.grad`` — see
@@ -479,7 +480,17 @@ def gpt_pipeline_1f1b(
     stages — see ``pipeline_1f1b``): pass params in the
     :func:`interleave_stage_params` layout with
     :func:`gpt_interleaved_param_specs`; requires ``M % pipe == 0``.
+
+    ``shard_transfers`` (default: auto — on exactly when ``tp_axis`` is set
+    and ``sp`` is off): carry the inter-stage activation sliced 1/tp over
+    the tensor axis (``pipeline_1f1b(transfer_shard_axis=...)``, the
+    ``scatter_gather_tensors`` analogue, comm.py:108-155) — pipe-edge bytes
+    and ring-buffer memory drop by tp.  Under SP the state is already
+    sequence-sharded, so there is nothing to slice.
     """
+    if shard_transfers is None:
+        shard_transfers = tp_axis is not None and not sp
+    transfer_shard_axis = tp_axis if shard_transfers else None
 
     def first_fn(p, toks):
         h = gpt_embed(p, toks, tp_axis, context_axis=cfg.context_axis, cp_layout=cfg.cp_layout)
@@ -534,6 +545,7 @@ def gpt_pipeline_1f1b(
         pipe_axis=pipe_axis,
         stage_takes_mb=True,
         num_chunks=num_chunks,
+        transfer_shard_axis=transfer_shard_axis,
     )
 
 

@@ -255,16 +255,17 @@ def vit_pipeline_1f1b(
     from ..parallel.tensor_parallel import scan_blocks, split_to_sp
     from .gpt import vocab_parallel_xent
 
-    if cfg.context_axis is not None:
-        raise NotImplementedError(
-            "vit_pipeline_1f1b does not compose with context parallelism: "
-            "unlike the GPT CE (a mean over context-LOCAL tokens, which "
-            "makes the context axis a plain data axis — gpt_pipeline_1f1b "
-            "supports CPxPP), the ViT loss pools patches with a pmean over "
-            "the context axis, so its per-rank param grads are SHARES whose "
-            "sum (not mean) is the full gradient — the train step's "
-            "data-axis mean reduction would silently scale grads by 1/cp"
-        )
+    # CP composition note: unlike the GPT CE (a mean over context-LOCAL
+    # tokens, which makes the context axis a plain data axis), the ViT loss
+    # pmean-pools patches over the context axis INSIDE the model, so
+    # context must be treated as a MODEL axis by the train step:
+    #   DataParallel(mesh, axis='data')      # context NOT in the data axes
+    # Params then stay context-invariant-typed and shard_map AD resolves
+    # each leaf on its own — pre-pool leaves get the automatic
+    # transpose-psum of their per-rank SHARES, the post-pool class head
+    # keeps its single full grad.  (An axis-wide sum would double-count the
+    # head; an axis-wide mean would halve the shares.)  Golden-tested in
+    # tests/test_vit.py::test_vit_1f1b_with_cp_matches_serial.
 
     def first_fn(p, images):
         h = vit_embed(p, images, cfg)

@@ -31,6 +31,7 @@ reference's "reduce once after backward" design while letting XLA schedule it.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax
@@ -109,11 +110,10 @@ def pvary_params(params: PyTree, axes: Tuple[str, ...]) -> PyTree:
 def reduce_gradients(
     grads: PyTree,
     axis: AxisName = DATA_AXIS,
-    reduce_op: str = "mean",
+    reduce_op: Union[str, Dict[str, str]] = "mean",
     grad_reduce_overrides: Optional[Dict[str, Tuple[str, ...]]] = None,
     compress: Optional[str] = None,
     compress_min_size: int = 65536,
-    assume_varying: bool = False,
 ) -> PyTree:
     """Reduce a gradient pytree over the data axes (traced; call inside
     shard_map).  Analogue of ``NaiveDDP.reduce_gradients``
@@ -132,16 +132,27 @@ def reduce_gradients(
     would over-count by the EP size.  The reference papers over this inside
     DeepSpeed's expert-grad scaling; here it is explicit.
 
-    ``compress='int8'`` (mean only): leaves with >= ``compress_min_size``
-    elements reduce through the int8 quantized ring
-    (:func:`...dist.compressed.int8_ring_pmean`) — ~4x fewer wire bytes at
-    bounded quantization noise; small leaves and override leaves keep the
-    exact reduction.
+    ``compress='int8'``: leaves with >= ``compress_min_size`` elements
+    reduce their MEAN-op axes through the int8 quantized ring
+    (:func:`...dist.compressed.int8_ring_pmean`) — ~2.7x fewer wire bytes at
+    bounded quantization noise; small leaves, sum-op axes and override
+    leaves keep the exact reduction.  The ring is vma-legal
+    (invariance-typed output), so compression composes with TP/PP meshes.
+
+    ``reduce_op`` may be a single op or a per-axis dict ``{axis: op}``
+    (unlisted axes default to 'mean').  Per-axis 'sum' is for objectives
+    whose per-rank grads over one data-like axis are SHARES of the full
+    gradient for EVERY param (e.g. a sum-of-per-shard-losses objective).
+    NB: when only part of the model sits inside the shared region — ViT's
+    class head runs AFTER the context-axis patch pooling — no axis-wide op
+    is right (sum double-counts the outside leaves, mean halves the
+    shares); leave such an axis OUT of ``axis`` entirely so shard_map AD
+    resolves each leaf through its cotangent vma (model-axis treatment,
+    see tests/test_vit.py::test_vit_1f1b_with_cp_matches_serial).
     """
-    if reduce_op not in ("mean", "sum"):
-        raise ValueError(f"reduce_op must be 'mean' or 'sum', got {reduce_op!r}")
-    red = jax.lax.pmean if reduce_op == "mean" else jax.lax.psum
     default_axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    _validate_reduce_op(reduce_op)
+    op_of = functools.partial(_axis_op, reduce_op)
     overrides = grad_reduce_overrides or {}
 
     def reduce_leaf(path, g):
@@ -154,38 +165,65 @@ def reduce_gradients(
                 matched = True
                 break
         # only reduce over axes the grad actually varies on (a grad can
-        # already be unvarying over an axis, e.g. after implicit psum);
-        # assume_varying: the caller runs without vma checking (compressed
-        # mode), where typeof carries no vma — reduce over all axes
-        vaxes = (
-            tuple(axes) if assume_varying
-            else tuple(a for a in axes if a in _vma(g))
-        )
+        # already be unvarying over an axis, e.g. after implicit psum)
+        vaxes = tuple(a for a in axes if a in _vma(g))
         if not matched:
+            mean_axes = tuple(a for a in vaxes if op_of(a) == "mean")
+            sum_axes = tuple(a for a in vaxes if op_of(a) == "sum")
             if (
                 compress == "int8"
-                and reduce_op == "mean"
-                and vaxes
+                and mean_axes
                 and g.size >= compress_min_size
             ):
                 from ..dist.compressed import int8_ring_pmean
 
-                for a in vaxes:  # nested means == joint mean (equal sizes)
+                for a in mean_axes:  # nested means == joint mean (equal sizes)
                     g = int8_ring_pmean(g, a)
-                return g
-            return red(g, vaxes) if vaxes else g
+            elif mean_axes:
+                g = jax.lax.pmean(g, mean_axes)
+            if sum_axes:
+                g = jax.lax.psum(g, sum_axes)
+            return g
         if not axes:
             return g  # explicitly ignored — raw per-shard grad
         if vaxes:
             g = jax.lax.psum(g, vaxes)
-        if reduce_op == "mean":
-            denom = 1
-            for a in default_axes:
+        # mean-op semantics for overrides: normalize by the FULL size of the
+        # mean-op default axes (see the MoE note above); sum-op axes
+        # contribute no normalization
+        denom = 1
+        for a in default_axes:
+            if op_of(a) == "mean":
                 denom *= jax.lax.axis_size(a)
+        if denom > 1:
             g = g / denom
         return g
 
     return jax.tree_util.tree_map_with_path(reduce_leaf, grads)
+
+
+def _validate_reduce_op(reduce_op) -> None:
+    ops = reduce_op.values() if isinstance(reduce_op, dict) else (reduce_op,)
+    for op in ops:
+        if op not in ("mean", "sum"):
+            raise ValueError(f"reduce op must be 'mean' or 'sum', got {op!r}")
+
+
+def _axis_op(reduce_op, a: str) -> str:
+    """The reduce op for axis ``a`` ('mean' when unlisted in a dict)."""
+    if isinstance(reduce_op, dict):
+        return reduce_op.get(a, "mean")
+    return reduce_op
+
+
+def _reduce_loss(loss, axes: Tuple[str, ...], reduce_op):
+    """The LOGGED loss always averages over the data-like axes, whatever the
+    grad ops: 'sum' describes how per-rank GRAD SHARES combine (ViT-CP's
+    pooled loss has equal per-rank loss values whose sum would double-count;
+    the reference's avg/sum switch likewise concerns gradients only,
+    naive_ddp.py:50-56)."""
+    del reduce_op
+    return jax.lax.pmean(loss, axes)
 
 
 def local_value_and_grad(
@@ -267,22 +305,25 @@ class DataParallel:
         self,
         mesh: Optional[Mesh] = None,
         axis: AxisName = DATA_AXIS,
-        reduce_op: str = "mean",
+        reduce_op: Union[str, Dict[str, str]] = "mean",
         grad_reduce_overrides: Optional[Dict[str, Tuple[str, ...]]] = None,
         grad_compress: Optional[str] = None,
         compress_min_size: int = 65536,
     ) -> None:
         self.mesh = mesh if mesh is not None else tpc.get_view()
         self.axis = axis
+        _validate_reduce_op(reduce_op)
         self.reduce_op = reduce_op
         self.grad_reduce_overrides = dict(grad_reduce_overrides or {})
         if grad_compress not in (None, "int8"):
             raise ValueError(f"unknown grad_compress {grad_compress!r}")
-        if grad_compress is not None and reduce_op != "mean":
+        data_axes = (axis,) if isinstance(axis, str) else tuple(axis)
+        if grad_compress is not None and not any(
+            _axis_op(reduce_op, a) == "mean" for a in data_axes
+        ):
             raise ValueError(
-                "grad_compress supports reduce_op='mean' only — with 'sum' "
-                "every leaf would take the exact path while still paying the "
-                "compressed mode's restrictions"
+                "grad_compress needs at least one mean-op data axis — with "
+                "every axis on 'sum' every leaf would take the exact path"
             )
         self.grad_compress = grad_compress
         self.compress_min_size = compress_min_size
@@ -353,40 +394,8 @@ class DataParallel:
         mesh = self.mesh
         axis = self.axis
         data_axes = (axis,) if isinstance(axis, str) else tuple(axis)
-        compressed = self.grad_compress is not None
-        if compressed:
-            # the compressed step runs with check_vma=False (the quantized
-            # ring's cross-rank consistency is by construction, not provable
-            # to the vma checker), where the vma-driven bookkeeping below is
-            # unavailable — restrict to pure-DP meshes (NaiveDDP's domain)
-            extra = set(mesh.axis_names) - set(data_axes)
-            if extra:
-                raise ValueError(
-                    f"grad_compress requires a pure data-parallel mesh; "
-                    f"non-data axes {sorted(extra)} present"
-                )
 
         def step(params, opt_state, batch):
-            if compressed:
-                # no vma typing in this region: grads from in-body AD are
-                # local by construction; reduce/normalize explicitly
-                if value_and_grad_fn is not None:
-                    loss, grads = value_and_grad_fn(params, batch)
-                else:
-                    loss, grads = local_value_and_grad(
-                        loss_fn, params, batch, grad_accum_iters
-                    )
-                grads = reduce_gradients(
-                    grads, axis, self.reduce_op, self.grad_reduce_overrides,
-                    compress=self.grad_compress,
-                    compress_min_size=self.compress_min_size,
-                    assume_varying=True,
-                )
-                red = jax.lax.pmean if self.reduce_op == "mean" else jax.lax.psum
-                loss = red(loss, data_axes)
-                updates, opt_state = optimizer.update(grads, opt_state, params)
-                params = jax.tree.map(jnp.add, params, updates)
-                return params, opt_state, loss
             # Keep grads local over the data axes (one explicit reduce below).
             p_local = pvary_params(params, data_axes)
             if value_and_grad_fn is not None:
@@ -394,15 +403,19 @@ class DataParallel:
             else:
                 loss, grads = local_value_and_grad(loss_fn, p_local, batch, grad_accum_iters)
             grads, other = normalize_model_axis_grads(loss, grads, mesh, data_axes)
+            # grad_compress='int8' swaps the large-leaf pmean for the
+            # quantized ring — vma-legal (see dist/compressed.py), so the
+            # SAME step body serves pure-DP and TP/PP-composed meshes
             grads = reduce_gradients(
                 grads, axis, self.reduce_op, self.grad_reduce_overrides,
+                compress=self.grad_compress,
+                compress_min_size=self.compress_min_size,
             )
             if other:
                 loss = jax.lax.pmean(loss, other)
             dax = tuple(a for a in data_axes if a in _vma(loss))
             if dax:
-                red = jax.lax.pmean if self.reduce_op == "mean" else jax.lax.psum
-                loss = red(loss, dax)
+                loss = _reduce_loss(loss, dax, self.reduce_op)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = jax.tree.map(jnp.add, params, updates)
             return params, opt_state, loss
@@ -434,7 +447,6 @@ class DataParallel:
                     mesh=mesh,
                     in_specs=(in_param_specs, opt_specs, in_batch_specs),
                     out_specs=(in_param_specs, opt_specs, P()),
-                    check_vma=not compressed,
                 )
                 cache[key] = jax.jit(sm, donate_argnums=(0, 1) if donate else ())
             return cache[key](params, opt_state, batch)

@@ -1,3 +1,8 @@
+from .hetero import (
+    bus_pack,
+    bus_unpack,
+    make_heterogeneous_stage,
+)
 from .pipeline_helper import (
     balanced_stage_stack,
     flat_and_partition,

@@ -29,7 +29,7 @@ user code — it sees (stage_params, activation, per-tick aux) and can branch on
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -152,6 +152,42 @@ def shift_left(x, pipe_axis: str = PIPE_AXIS, circular: bool = False):
     return jax.lax.ppermute(
         x, pipe_axis, [(i, i - 1) for i in range(1, n)] + wrap_edge
     )
+
+
+def _transfer_dim(shape, n: int) -> int:
+    """The dim sliced by sharded inter-stage transfers: first one divisible
+    by the axis size (batch/seq dims come first, leaving the minor-most lane
+    dim intact when possible); -1 = leaf transfers unsliced."""
+    for d, s in enumerate(shape):
+        if s % n == 0 and s >= n:
+            return d
+    return -1
+
+
+def _slice_state(x, tdims, axis: str):
+    """Each ``axis`` rank keeps its 1/n slice of every leaf's transfer dim."""
+    i = jax.lax.axis_index(axis)
+    n = jax.lax.axis_size(axis)
+
+    def one(a, d):
+        if d < 0:
+            return a
+        sz = a.shape[d] // n
+        return jax.lax.dynamic_slice_in_dim(a, i * sz, sz, axis=d)
+
+    return jax.tree.map(one, x, tdims)
+
+
+def _gather_state(x, tdims, axis: str):
+    """Reassemble the full state from the per-rank slices (transpose:
+    psum_scatter — AD keeps replicated-param grads exact through this)."""
+
+    def one(a, d):
+        if d < 0:
+            return a
+        return jax.lax.all_gather(a, axis, axis=d, tiled=True)
+
+    return jax.tree.map(one, x, tdims)
 
 
 def _pipeline_scan(
@@ -370,6 +406,7 @@ def pipeline_1f1b(
     stage_takes_mb: bool = False,
     stage_returns_aux: bool = False,
     num_chunks: int = 1,
+    transfer_shard_axis: Optional[str] = None,
 ):
     """One-forward-one-backward pipeline schedule: returns ``(loss, grads)``
     directly (do NOT wrap in ``jax.grad`` — the backward pipeline runs inside).
@@ -435,6 +472,19 @@ def pipeline_1f1b(
     price is the deeper ring buffer, ``min(VM, 2PV-1)`` slots of 1 chunk's
     activation each (:func:`ring_slots`).  At V=1 every formula reduces to
     the classic schedule above.
+
+    ``transfer_shard_axis``: shard the inter-stage state over this (tensor)
+    axis — the analogue of the reference's ``scatter_gather_tensors``
+    (pipeline_parallel/comm.py:108-155), which splits the p2p payload 1/tp
+    before send and all-gathers after receive.  Here the state stays SLICED
+    through the whole schedule (each TP rank carries slice ``i`` of the
+    first divisible dim): stage entry all-gathers over the axis, stage exit
+    slices — both INSIDE the differentiated stage fn, so AD's
+    all_gather <-> psum_scatter transposition keeps every gradient exact
+    (the Megatron SP conjugate pair).  The pipe ``ppermute`` payload AND the
+    activation ring buffer shrink by 1/tp (beyond the reference, which only
+    shards the wire bytes).  Pointless under SP, where the state is already
+    sequence-sharded — meant for the non-SP TP pipeline.
     """
     from ..data_parallel import _mark_varying, _vma, pvary_params
 
@@ -506,6 +556,30 @@ def pipeline_1f1b(
     )
     mb0_in = take_mb(inputs, jnp.zeros((), jnp.int32))
     mb0_tgt = take_mb(targets, jnp.zeros((), jnp.int32))
+
+    if transfer_shard_axis is not None:
+        # Sharded inter-stage state (see docstring): slice at every stage
+        # exit, gather at every entry — INSIDE the differentiated fns, so
+        # the schedule below (carry, ring buffer, ppermutes, cotangents)
+        # only ever sees 1/tp-sized state and AD stays exact.
+        tax = transfer_shard_axis
+        tsz = jax.lax.axis_size(tax)
+        full_state = jax.eval_shape(first_fn, params, mb0_in)
+        tdims = jax.tree.map(lambda a: _transfer_dim(a.shape, tsz), full_state)
+        _first0, _stage0, _last0 = first_fn, call_stage, last_fn
+
+        def first_fn(p, mb):
+            return _slice_state(_first0(p, mb), tdims, tax)
+
+        def call_stage(p, x, m, v):
+            out = _stage0(p, _gather_state(x, tdims, tax), m, v)
+            if stage_returns_aux:
+                y, aux = out
+                return _slice_state(y, tdims, tax), aux
+            return _slice_state(out, tdims, tax)
+
+        def last_fn(p, y, tgt):
+            return _last0(p, _gather_state(y, tdims, tax), tgt)
 
     # ---- state aval fixed point (stage in/out shape + varying axes)
     x_shape = jax.eval_shape(first_fn, params, mb0_in)
