@@ -291,3 +291,25 @@ def test_int8_compression_composes_with_tp(devices8):
         np.asarray(p_q["tok_emb"]), np.asarray(p_exact["tok_emb"]),
         rtol=0.1, atol=5e-3,
     )
+
+
+def test_int8_ring_singleton_axis_is_invariance_typed(devices8):
+    """A 1-member data axis must still yield an invariance-typed result —
+    the bare-return regression failed check_vma at the sharded out_specs
+    (caught by review; the grad path is DataParallel(mesh=('data',1) x tp))."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from torchdistpackage_tpu.dist.compressed import int8_ring_pmean
+
+    tpc.setup_process_groups([("data", 1), ("tensor", 2)], devices=devices8[:2])
+    mesh = tpc.get_view()
+
+    def body(g):
+        out = int8_ring_pmean(g[0], "data")
+        return out[None]
+
+    got = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=(P("data"),), out_specs=P())
+    )(jnp.arange(8.0).reshape(1, 8))
+    np.testing.assert_array_equal(np.asarray(got), np.arange(8.0).reshape(1, 8))

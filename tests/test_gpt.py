@@ -193,22 +193,35 @@ def _ppermute_bytes(fn, *args):
     )
 
 
-def test_gpt_1f1b_tp_nosp_sharded_transfers_match_serial(devices8, params):
+@pytest.mark.parametrize("num_chunks", [1, 2])
+def test_gpt_1f1b_tp_nosp_sharded_transfers_match_serial(
+        devices8, params, num_chunks):
     """The scatter_gather_tensors analogue (reference comm.py:108-155): under
     non-SP TP the inter-stage state is carried sliced 1/tp over the tensor
     axis.  (a) goldens unchanged — PP=2 x TP=2 (no SP) 1F1B training tracks
-    the serial model; (b) the pipe ppermute payload bytes drop by exactly
-    tp_size vs shard_transfers=False."""
+    the serial model, for the classic AND the interleaved (V=2, circular
+    wrap edges) schedule; (b) the pipe ppermute payload bytes drop by
+    exactly tp_size vs shard_transfers=False."""
     M, mbs = 4, 2
     tpc.setup_process_groups([("pipe", 2), ("tensor", 2)], devices=devices8[:4])
     mesh = tpc.get_view()
-    specs = gpt_param_specs(CFG, tp_axis="tensor", pipe_axis="pipe")
+    orig_params = params
+    if num_chunks > 1:
+        from torchdistpackage_tpu.models import (
+            gpt_interleaved_param_specs,
+            interleave_stage_params,
+        )
+
+        params = interleave_stage_params(params, num_chunks, 2)
+        specs = gpt_interleaved_param_specs(CFG, tp_axis="tensor")
+    else:
+        specs = gpt_param_specs(CFG, tp_axis="tensor", pipe_axis="pipe")
 
     def make_vg(shard_transfers):
         def vg_fn(p, batch):
             return gpt_pipeline_1f1b(
                 p, batch, CFG, num_microbatches=M, tp_axis="tensor", sp=False,
-                shard_transfers=shard_transfers,
+                shard_transfers=shard_transfers, num_chunks=num_chunks,
             )
 
         return shard_map(
@@ -236,8 +249,12 @@ def test_gpt_1f1b_tp_nosp_sharded_transfers_match_serial(devices8, params):
             for m in range(M)
         ]))
 
-    sloss, sgrads = jax.value_and_grad(serial_loss)(params, batch)
+    sloss, sgrads = jax.value_and_grad(serial_loss)(orig_params, batch)
     np.testing.assert_allclose(float(loss), float(sloss), rtol=1e-5, atol=1e-6)
+    if num_chunks > 1:
+        from torchdistpackage_tpu.models import deinterleave_stage_params
+
+        grads = deinterleave_stage_params(grads, num_chunks, 2)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5

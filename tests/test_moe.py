@@ -370,7 +370,11 @@ def chunked_moe_serial_loss(cfg, M, nshards, rows_per_shard=2):
     return serial_loss
 
 
-def test_gpt_moe_1f1b_matches_serial_microbatched(devices8):
+import pytest as _pytest
+
+
+@_pytest.mark.parametrize("moe_dispatch", ["dense", "sorted"])
+def test_gpt_moe_1f1b_matches_serial_microbatched(devices8, moe_dispatch):
     """MoE × PP: the MoE GPT under the 1F1B schedule (EP × MoE-DP × PP) must
     track a serial model trained on the mean of per-microbatch losses — the
     reference's MoE-DP (naive_ddp.py:233-441) composed with its PP+DP layout
@@ -398,6 +402,7 @@ def test_gpt_moe_1f1b_matches_serial_microbatched(devices8):
         moe_experts=4, moe_top_k=2, moe_every=2,
         moe_capacity_factor=4.0,  # no drops: serial and EP routing identical
         moe_aux_weight=1e-2,
+        moe_dispatch=moe_dispatch,  # both materializations through PP x EP
     )
     M, mbs = 4, 2
     PP = 2

@@ -903,3 +903,41 @@ def test_heterogeneous_bus_stages_match_serial(devices8):
             ),
             params, x, y,
         )
+
+
+def test_heterogeneous_bus_guards(devices8):
+    """Misuse fails at trace time: stage-count != pipe size (lax.switch
+    would silently clamp), and an int edge on a float bus (values past the
+    float's integer-exact range would corrupt silently)."""
+    from torchdistpackage_tpu.parallel.pipeline_parallel import (
+        make_heterogeneous_stage,
+    )
+
+    f32 = jnp.float32
+    edges3 = [jax.ShapeDtypeStruct((2, 4), f32)] * 4
+    fns3 = [lambda p, x, m: x] * 3
+    wf, sf, wl = make_heterogeneous_stage(fns3, edges3)
+    tpc.setup_process_groups([("pipe", 2)], devices=devices8[:2])
+    mesh = tpc.get_view()
+    with pytest.raises(ValueError, match="one fn per stage"):
+        jax.eval_shape(
+            shard_map(
+                functools.partial(
+                    pipeline_1f1b,
+                    first_fn=wf(lambda p, mb: mb),
+                    stage_fn=sf,
+                    last_fn=wl(lambda p, y, t: jnp.mean(y)),
+                    num_microbatches=2,
+                    stage_takes_mb=True,
+                ),
+                mesh=mesh, in_specs=(P(), P(), P()), out_specs=(P(), P()),
+            ),
+            {"w": jnp.zeros((2,))}, jnp.zeros((2, 2, 4)), jnp.zeros((2, 2, 4)),
+        )
+
+    with pytest.raises(ValueError, match="integer and float"):
+        make_heterogeneous_stage(
+            [lambda p, x, m: x.astype(f32)],
+            [jax.ShapeDtypeStruct((2, 4), jnp.int32),
+             jax.ShapeDtypeStruct((2, 4), f32)],
+        )

@@ -82,7 +82,10 @@ def int8_ring_pmean(g: jnp.ndarray, axis: str) -> jnp.ndarray:
     single member."""
     n = jax.lax.axis_size(axis)
     if n == 1:
-        return g
+        # still a pmean: the caller is promised an invariance-TYPED result
+        # (a bare return would stay varying-marked and fail check_vma at
+        # the sharded out_specs); over a 1-member axis it's free
+        return jax.lax.pmean(g, axis)
     flat = g.reshape(-1)
     if flat.shape[0] % n != 0:
         return jax.lax.pmean(g, axis)

@@ -48,6 +48,16 @@ def _aval(x) -> jax.ShapeDtypeStruct:
 def _bus_aval(edges: Sequence[jax.ShapeDtypeStruct]) -> jax.ShapeDtypeStruct:
     size = max(int(jnp.prod(jnp.array(e.shape)) if e.shape else 1) for e in edges)
     dtype = jnp.result_type(*[e.dtype for e in edges])
+    # an integer edge promoted onto a float bus would silently corrupt
+    # values past the float's integer-exact range (int32 id >= 2^24 through
+    # an f32 bus) — refuse the mix instead
+    for e in edges:
+        if jnp.issubdtype(e.dtype, jnp.integer) != jnp.issubdtype(dtype, jnp.integer):
+            raise ValueError(
+                f"bus dtype {dtype} cannot carry edge dtype {e.dtype} "
+                f"exactly: integer and float edges cannot share one bus — "
+                f"use a uniform edge dtype (or cast inside the stage fns)"
+            )
     return jax.ShapeDtypeStruct((size,), dtype)
 
 
@@ -123,6 +133,15 @@ def make_heterogeneous_stage(
     branches = [_branch(s) for s in range(P_)]
 
     def stage_fn(params, bus_val, m):
+        n = jax.lax.axis_size(pipe_axis)  # static inside shard_map
+        if n != P_:
+            # without this, lax.switch CLAMPS the stage index: extra
+            # stages silently re-run the last branch / missing stages never
+            # run, and every bus aval matches so no shape error ever fires
+            raise ValueError(
+                f"{P_} heterogeneous stage fns on a {n}-rank "
+                f"{pipe_axis!r} axis — one fn per stage is required"
+            )
         s = jax.lax.axis_index(pipe_axis)
         return jax.lax.switch(s, branches, params, bus_val, m)
 
