@@ -10,6 +10,11 @@ second half on the text channel — balanced FLOPs, uniform program, no
 dynamic shapes); the last stage pools both channels and computes the
 symmetric InfoNCE contrastive loss inside its 1F1B backward unit.
 
+(When the towers genuinely need DIFFERENT widths per stage, use
+``pipeline_parallel.make_heterogeneous_stage`` — the max-edge bus with
+per-stage dispatch, ``examples/train_hetero_pipeline.py`` — instead of
+this channel-stacking trick, which requires equal channel shapes.)
+
 - real TPU chips:      python examples/train_clip_pipeline.py
 - 8-device CPU sim:    TDP_CPU_SIM=8 python examples/train_clip_pipeline.py
 """
