@@ -890,3 +890,100 @@ def test_gpt_moe_with_ring_cp_matches_serial(devices8):
         g_got,
         g_want,
     )
+
+
+def test_gpt_moe_1f1b_with_tp_nosp_sharded_transfers(devices8):
+    """MoE x TP(non-SP) x EP x PP — the expert stack with TENSOR parallelism
+    through the pipeline, riding the TP-sharded inter-stage transfers
+    (auto-enabled for non-SP TP).  Golden vs the chunked serial MoE loss;
+    two optimizer steps track serial params."""
+    from torchdistpackage_tpu.models import (
+        GPTConfig,
+        gpt_moe_pipeline_1f1b,
+        gpt_moe_pipeline_param_specs,
+        init_gpt_moe_params,
+        stack_moe_stage_params,
+    )
+    from torchdistpackage_tpu.parallel.data_parallel import DataParallel
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, nheads=4, nlayers=4, max_seq=16, ffn_mult=2,
+        moe_experts=4, moe_top_k=2, moe_every=2,
+        moe_capacity_factor=4.0,  # no drops: serial and EP routing identical
+        moe_aux_weight=1e-2,
+    )
+    M, mbs, PP = 4, 2, 2
+    tpc.setup_process_groups(
+        [("pipe", PP), ("data", 2), ("tensor", 2)], devices=devices8
+    )
+    tpc.build_moe_mesh(moe_ep_size=2)
+    mesh = tpc.get_view("moe")  # (pipe, moe_dp=1, moe_ep=2, tensor=2)
+
+    params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+    stage_params = stack_moe_stage_params(params, cfg, PP)
+    specs = gpt_moe_pipeline_param_specs(
+        cfg, PP, ep_axis="moe_ep", tp_axis="tensor")
+
+    def vg_fn(p, batch):
+        return gpt_moe_pipeline_1f1b(
+            p, batch, cfg, num_microbatches=M, tp_axis="tensor", sp=False,
+            ep_axis="moe_ep",
+        )
+
+    opt = optax.sgd(1e-1)
+    dp = DataParallel(
+        mesh=mesh,
+        axis=("moe_dp", "moe_ep"),
+        grad_reduce_overrides=moe_grad_reduce_overrides(),
+    )
+    sharded = dp.broadcast_params(stage_params, param_specs=specs)
+    state = opt.init(sharded)
+    step = dp.make_train_step(
+        value_and_grad_fn=vg_fn,
+        optimizer=opt,
+        param_specs=specs,
+        batch_spec={
+            "tokens": P(None, ("moe_dp", "moe_ep")),
+            "targets": P(None, ("moe_dp", "moe_ep")),
+        },
+    )
+
+    sparams, sstate = params, opt.init(params)
+    serial_loss = chunked_moe_serial_loss(cfg, M, nshards=2)
+
+    @jax.jit
+    def serial_step(p, s, b):
+        loss, g = jax.value_and_grad(serial_loss)(p, b)
+        u, s = opt.update(g, s, p)
+        return jax.tree.map(jnp.add, p, u), s, loss
+
+    S = cfg.max_seq
+    for i in range(2):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(75 + i))
+        batch = {
+            "tokens": jax.random.randint(k1, (M, mbs * 2, S), 0, cfg.vocab_size),
+            "targets": jax.random.randint(k2, (M, mbs * 2, S), 0, cfg.vocab_size),
+        }
+        sparams, sstate, sloss = serial_step(sparams, sstate, batch)
+        dbatch = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(mesh, P(None, ("moe_dp", "moe_ep")))
+            ),
+            batch,
+        )
+        sharded, state, dloss = step(sharded, state, dbatch)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
+
+    # a TP-sharded expert leaf and the replicated head both track serial
+    np.testing.assert_allclose(
+        np.asarray(sharded["head"]), np.asarray(sparams["head"]),
+        rtol=1e-3, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded["blocks"][0]["mlp"]["w1"]),
+        np.asarray(
+            jnp.stack([sparams["blocks"][0]["mlp"]["w1"],
+                       sparams["blocks"][2]["mlp"]["w1"]])
+        ),
+        rtol=1e-3, atol=1e-5,
+    )

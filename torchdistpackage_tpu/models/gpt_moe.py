@@ -287,6 +287,7 @@ def gpt_moe_pipeline_1f1b(
     remat: bool = True,
     dropout_key: Optional[jax.Array] = None,
     num_chunks: int = 1,
+    shard_transfers: Optional[bool] = None,
 ):
     """1F1B-scheduled MoE GPT training core: returns ``(loss, grads)`` (see
     :func:`...pipeline_parallel.pipeline_1f1b`).  The EP × MoE-DP × TP × PP
@@ -310,7 +311,14 @@ def gpt_moe_pipeline_1f1b(
     ``stack_moe_stage_params(..., num_chunks=V)``-layout params ([V, P, ...]
     leaves): the dense/expert pattern must be slab-invariant
     (``moe_stage_pattern`` checks) and the stage body selects chunk v's slab
-    before the block loop."""
+    before the block loop.
+
+    ``shard_transfers`` (default: auto — on exactly when ``tp_axis`` is set
+    and ``sp`` is off): carry the inter-stage activation sliced 1/tp over
+    the tensor axis (see :func:`..gpt.gpt_pipeline_1f1b`)."""
+    if shard_transfers is None:
+        shard_transfers = tp_axis is not None and not sp
+    transfer_shard_axis = tp_axis if shard_transfers else None
     n_moe = sum(1 for i in range(cfg.nlayers) if is_moe_block(cfg, i))
     aux_scale = cfg.moe_aux_weight / max(n_moe, 1)
     lpp = len(params["blocks"])
@@ -383,6 +391,7 @@ def gpt_moe_pipeline_1f1b(
         stage_takes_mb=True,
         stage_returns_aux=True,
         num_chunks=num_chunks,
+        transfer_shard_axis=transfer_shard_axis,
     )
 
 

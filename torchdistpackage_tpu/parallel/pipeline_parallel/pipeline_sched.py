@@ -568,6 +568,18 @@ def pipeline_1f1b(
         tdims = jax.tree.map(lambda a: _transfer_dim(a.shape, tsz), full_state)
         _first0, _stage0, _last0 = first_fn, call_stage, last_fn
 
+        def _close_scalar(v):
+            # A scalar that ESCAPES the slice/gather conjugate pair (aux
+            # losses, a last_fn that doesn't psum over tax internally) is
+            # computed from gathered — tax-varying-TYPED but value-equal —
+            # state.  Left varying, its vjp transpose-psums a FULL
+            # per-rank grad contribution tp times (overcount), while the
+            # sliced-state path's grads are exact shares — no global
+            # rescale can fix both.  pmean is exact on the equal values,
+            # restores invariance, and seeds each rank with the correct
+            # 1/tp cotangent so the transpose-psum sums to exactly 1x.
+            return jax.lax.pmean(v, tax) if tax in _vma(v) else v
+
         def first_fn(p, mb):
             return _slice_state(_first0(p, mb), tdims, tax)
 
@@ -575,11 +587,11 @@ def pipeline_1f1b(
             out = _stage0(p, _gather_state(x, tdims, tax), m, v)
             if stage_returns_aux:
                 y, aux = out
-                return _slice_state(y, tdims, tax), aux
+                return _slice_state(y, tdims, tax), _close_scalar(aux)
             return _slice_state(out, tdims, tax)
 
         def last_fn(p, y, tgt):
-            return _last0(p, _gather_state(y, tdims, tax), tgt)
+            return _close_scalar(_last0(p, _gather_state(y, tdims, tax), tgt))
 
     # ---- state aval fixed point (stage in/out shape + varying axes)
     x_shape = jax.eval_shape(first_fn, params, mb0_in)
