@@ -314,3 +314,145 @@ def test_vit_1f1b_with_cp_matches_serial(devices8):
             np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5,
             err_msg=f"param divergence at {path}",
         )
+
+
+def test_vit_moe_encoder_trains_both_routers():
+    """ViT-MoE (V-MoE style): the encoder MoE family where expert_choice
+    routing is LEGAL (cfg.block.causal=False — the same layer the GPT
+    family rejects).  Both routers train serially: loss decreases, EC aux
+    identically 0, token-choice aux > 0."""
+    import dataclasses
+
+    from torchdistpackage_tpu.models import (
+        init_vit_moe_params,
+        vit_moe_forward,
+        vit_moe_loss,
+    )
+
+    base = ViTConfig(
+        image_size=32, patch_size=8, channels=3, num_classes=16,
+        dim=32, nheads=4, nlayers=4, ffn_mult=2,
+        moe_experts=4, moe_every=2, moe_capacity_factor=2.0,
+    )
+    batch = {
+        "images": jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3)),
+        "labels": jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 16),
+    }
+    for router in ("topk", "expert_choice"):
+        cfg = dataclasses.replace(base, moe_router=router)
+        params = init_vit_moe_params(jax.random.PRNGKey(0), cfg)
+        _, aux = vit_moe_forward(params, batch["images"], cfg)
+        if router == "expert_choice":
+            assert float(aux) == 0.0  # balanced by construction
+        else:
+            assert float(aux) > 0.0
+        opt = optax.adam(1e-2)
+        state = opt.init(params)
+
+        @jax.jit
+        def step(p, s):
+            loss, g = jax.value_and_grad(
+                lambda pp: vit_moe_loss(pp, batch, cfg))(p)
+            u, s = opt.update(g, s, p)
+            return jax.tree.map(jnp.add, p, u), s, loss
+
+        losses = []
+        for _ in range(5):
+            params, state, loss = step(params, state)
+            losses.append(float(loss))
+        assert np.all(np.isfinite(losses)) and losses[-1] < losses[0], (
+            router, losses)
+
+
+def test_vit_moe_ep_training_matches_serial(devices8):
+    """ViT-MoE under EP x MoE-DP with expert-grad overrides tracks the
+    chunked serial model (each device routes its LOCAL rows) — the MoE-DP
+    discipline of test_moe.py applied to the encoder family, with the
+    expert-choice router (only legal in an encoder)."""
+    from torchdistpackage_tpu.models import (
+        init_vit_moe_params,
+        vit_moe_loss,
+        vit_moe_param_specs,
+    )
+    from torchdistpackage_tpu.parallel.moe import moe_grad_reduce_overrides
+
+    cfg = ViTConfig(
+        image_size=32, patch_size=8, channels=3, num_classes=16,
+        dim=32, nheads=4, nlayers=2, ffn_mult=2,
+        moe_experts=4, moe_every=2, moe_capacity_factor=4.0,
+        moe_router="expert_choice",
+    )
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    tpc.build_moe_mesh(moe_ep_size=4)
+    mesh = tpc.get_view("moe")  # moe_dp=2 x moe_ep=4
+
+    params = init_vit_moe_params(jax.random.PRNGKey(0), cfg)
+    specs = vit_moe_param_specs(cfg, tp_axis=None, ep_axis="moe_ep")
+    opt = optax.sgd(5e-2)
+
+    from torchdistpackage_tpu.parallel.data_parallel import DataParallel
+
+    dp = DataParallel(
+        mesh=mesh,
+        axis=("moe_dp", "moe_ep"),
+        grad_reduce_overrides=moe_grad_reduce_overrides(),
+    )
+    sharded = dp.broadcast_params(params, param_specs=specs)
+    state = opt.init(sharded)
+    step = dp.make_train_step(
+        lambda p, b: vit_moe_loss(p, b, cfg, ep_axis="moe_ep"),
+        opt,
+        param_specs=specs,
+        batch_spec={
+            "images": P(("moe_dp", "moe_ep")),
+            "labels": P(("moe_dp", "moe_ep")),
+        },
+    )
+
+    # serial golden: mean of per-device-row-chunk losses (local routing)
+    def serial_loss(p, b):
+        losses = [
+            vit_moe_loss(
+                p,
+                {"images": b["images"][d : d + 1], "labels": b["labels"][d : d + 1]},
+                cfg,
+            )
+            for d in range(8)
+        ]
+        return jnp.mean(jnp.stack(losses))
+
+    sparams, sstate = params, opt.init(params)
+
+    @jax.jit
+    def serial_step(p, s, b):
+        loss, g = jax.value_and_grad(serial_loss)(p, b)
+        u, s = opt.update(g, s, p)
+        return jax.tree.map(jnp.add, p, u), s, loss
+
+    from jax.sharding import NamedSharding
+
+    for i in range(2):
+        ki, kl = jax.random.split(jax.random.PRNGKey(95 + i))
+        batch = {
+            "images": jax.random.normal(ki, (8, 32, 32, 3)),
+            "labels": jax.random.randint(kl, (8,), 0, 16),
+        }
+        sparams, sstate, sloss = serial_step(sparams, sstate, batch)
+        dbatch = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(mesh, P(("moe_dp", "moe_ep")))),
+            batch,
+        )
+        sharded, state, dloss = step(sharded, state, dbatch)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
+
+    # expert leaf (EP-sharded) and a dense leaf both track serial
+    np.testing.assert_allclose(
+        np.asarray(sharded["blocks"][1]["moe"]["experts"]["w1"]),
+        np.asarray(sparams["blocks"][1]["moe"]["experts"]["w1"]),
+        rtol=1e-3, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded["head"]["w"]), np.asarray(sparams["head"]["w"]),
+        rtol=1e-3, atol=1e-5,
+    )

@@ -34,3 +34,9 @@ from .vit import (
     vit_param_specs,
     vit_pipeline_1f1b,
 )
+from .vit_moe import (
+    init_vit_moe_params,
+    vit_moe_forward,
+    vit_moe_loss,
+    vit_moe_param_specs,
+)

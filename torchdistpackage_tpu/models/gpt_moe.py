@@ -114,10 +114,12 @@ def moe_block_forward(
 
     h = layer_norm(x, p["ln2"])
     full = gather_from_sp(h, axis) if (axis and sp) else h
-    # causal=True: the GPT family is autoregressive — this rejects the
-    # (non-causal) expert_choice router at trace time instead of silently
-    # leaking future tokens through the routing decision
-    z, aux = moe_forward(p["moe"], full, mcfg, ep_axis=ep_axis, causal=True)
+    # causality follows the model config: autoregressive configs (GPT,
+    # cfg.block.causal=True) reject the non-causal expert_choice router at
+    # trace time and get token-major capacity priority; encoder configs
+    # (ViT-MoE, causal=False) may use EC — the Zhou et al. setting
+    z, aux = moe_forward(
+        p["moe"], full, mcfg, ep_axis=ep_axis, causal=cfg.block.causal)
     if axis and sp:
         z = split_to_sp(z, axis)
     return x + dropout(z, bcfg.dropout_rate, k_mlp), aux

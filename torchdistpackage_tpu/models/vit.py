@@ -48,6 +48,17 @@ class ViTConfig:
     attn_impl: str = "naive"
     context_axis: Optional[str] = None
     dropout_rate: float = 0.0  # residual dropout (needs a dropout_key)
+    # MoE knobs (models/vit_moe.py, V-MoE style): >0 experts turns every
+    # moe_every-th block's FFN into the expert layer.  ViT is an ENCODER
+    # (causal=False), so — unlike GPT-MoE — the 'expert_choice' router is
+    # allowed here: the Zhou et al. setting, balanced by construction.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
+    moe_router: str = "topk"  # 'topk' | 'expert_choice' (encoder: both ok)
+    moe_dispatch: str = "auto"  # 'dense' | 'sorted' | 'auto' (see MoEConfig)
 
     def __post_init__(self):
         if self.context_axis is not None and self.attn_impl not in ("ring", "ulysses"):

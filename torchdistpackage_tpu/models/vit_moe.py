@@ -1,0 +1,161 @@
+"""ViT-MoE: the encoder MoE family (V-MoE style — Riquelme et al. 2021;
+expert-choice routing per Zhou et al. 2022).
+
+The reference has no vision-MoE model; this family exists because the
+package's own causal guard makes the GPT family reject ``expert_choice``
+routing — an encoder is where EC legitimately lives (each expert ranks the
+whole patch sequence; there is no autoregressive order to leak).  Every
+``moe_every``-th ViT block's FFN is the expert layer from
+``parallel/moe.py`` (shared with GPT-MoE: same routing, same EP
+all_to_alls, same dispatch materializations); causality is taken from
+``cfg.block.causal`` — False for ViT, so both routers are available.
+
+Reference capability provenance: MoE machinery analogue of
+``torchdistpackage/ddp/naive_ddp.py:233-441`` + ``process_topo.py:118-143``
+applied to the vision tower the reference pipelines in
+``examples/model_parallel/test_pipeline.py:54-123``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.moe import init_moe_params, moe_param_specs
+from ..parallel.tensor_parallel import (
+    block_forward,
+    block_param_specs,
+    init_block_params,
+)
+from .gpt_moe import is_moe_block, moe_block_forward, moe_layer_config
+from .vit import ViTConfig, vit_embed, vit_pool_logits
+
+PyTree = Any
+
+
+def init_vit_moe_params(key, cfg: ViTConfig) -> Dict[str, PyTree]:
+    """Like ``init_vit_params`` but blocks are a heterogeneous LIST with MoE
+    blocks' ``mlp`` replaced by the expert layer params."""
+    assert cfg.moe_experts > 0, "use init_vit_params for dense models"
+    import math
+
+    kp, kpos, kh, kb = jax.random.split(key, 4)
+    dt = cfg.dtype
+    mcfg = moe_layer_config(cfg)
+    blocks: List[Dict[str, PyTree]] = []
+    for i, k in enumerate(jax.random.split(kb, cfg.nlayers)):
+        if is_moe_block(cfg, i):
+            bp = init_block_params(k, cfg.block, mlp=False)
+            bp["moe"] = init_moe_params(jax.random.fold_in(k, 1), mcfg)
+        else:
+            bp = init_block_params(k, cfg.block)
+        blocks.append(bp)
+    return {
+        "patch_proj": {
+            "w": (jax.random.normal(kp, (cfg.patch_dim, cfg.dim))
+                  / math.sqrt(cfg.patch_dim)).astype(dt),
+            "b": jnp.zeros((cfg.dim,), dt),
+        },
+        "pos_emb": (jax.random.normal(kpos, (cfg.num_patches, cfg.dim)) * 0.02).astype(dt),
+        "blocks": blocks,
+        "ln_f": {"scale": jnp.ones((cfg.dim,), dt), "bias": jnp.zeros((cfg.dim,), dt)},
+        "head": {
+            "w": (jax.random.normal(kh, (cfg.dim, cfg.num_classes))
+                  / math.sqrt(cfg.dim)).astype(dt),
+            "b": jnp.zeros((cfg.num_classes,), dt),
+        },
+    }
+
+
+def vit_moe_forward(
+    params: Dict[str, PyTree],
+    images: jnp.ndarray,
+    cfg: ViTConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    ep_axis: Optional[str] = None,
+    dropout_key: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, H, W, C] images -> ([B, num_classes(/tp)] logits, mean aux loss
+    over MoE blocks).  ``params['blocks']`` is the heterogeneous per-block
+    list from :func:`init_vit_moe_params`."""
+    h = vit_embed(params, images, cfg)
+    if axis is not None and sp:
+        from ..parallel.tensor_parallel import split_to_sp
+
+        h = split_to_sp(h, axis)
+    aux_total = jnp.zeros((), jnp.float32)
+    n_moe = 0
+    for i, bp in enumerate(params["blocks"]):
+        k = (
+            jax.random.fold_in(dropout_key, i)
+            if dropout_key is not None
+            else None
+        )
+        if is_moe_block(cfg, i):
+            # moe_block_forward reads causality from cfg.block.causal —
+            # False here, so expert_choice routing is allowed
+            h, aux = moe_block_forward(
+                bp, h, cfg, axis=axis, sp=sp, ep_axis=ep_axis, dropout_key=k
+            )
+            aux_total = aux_total + aux
+            n_moe += 1
+        else:
+            h = block_forward(bp, h, cfg.block, axis=axis, sp=sp, dropout_key=k)
+    aux_mean = aux_total / max(n_moe, 1)
+    return vit_pool_logits(params, h, cfg, axis=axis, sp=sp), aux_mean
+
+
+def vit_moe_loss(
+    params: Dict[str, PyTree],
+    batch: Dict[str, jnp.ndarray],
+    cfg: ViTConfig,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    ep_axis: Optional[str] = None,
+    dropout_key: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Mean CE + ``cfg.moe_aux_weight`` x mean load-balance aux (identically
+    0 under expert-choice routing).  ``batch``: {'images': [B, H, W, C],
+    'labels': int [B]}."""
+    from .gpt import vocab_parallel_xent
+
+    logits, aux = vit_moe_forward(
+        params, batch["images"], cfg, axis=axis, sp=sp, ep_axis=ep_axis,
+        dropout_key=dropout_key,
+    )
+    tp = axis if logits.shape[-1] != cfg.num_classes else None
+    ce = vocab_parallel_xent(logits, batch["labels"], tp)
+    return ce + cfg.moe_aux_weight * aux.astype(ce.dtype)
+
+
+def vit_moe_param_specs(
+    cfg: ViTConfig,
+    tp_axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
+) -> Dict[str, PyTree]:
+    """Per-block specs: dense blocks get the TP specs, MoE blocks the TP
+    attention specs + EP-sharded expert stacks (router replicated)."""
+    blocks = []
+    for i in range(cfg.nlayers):
+        bspec = block_param_specs(tp_axis)
+        if is_moe_block(cfg, i):
+            bspec = {
+                "ln1": bspec["ln1"],
+                "attn": bspec["attn"],
+                "ln2": bspec["ln2"],
+                "moe": moe_param_specs(ep_axis),
+            }
+        blocks.append(bspec)
+    head_w = P(None, tp_axis) if tp_axis else P()
+    head_b = P(tp_axis) if tp_axis else P()
+    return {
+        "patch_proj": {"w": P(), "b": P()},
+        "pos_emb": P(),
+        "blocks": blocks,
+        "ln_f": {"scale": P(), "bias": P()},
+        "head": {"w": head_w, "b": head_b},
+    }
