@@ -140,9 +140,30 @@ def gpt_moe_forward(
     h = gpt_embed(params, tokens, axis, context_axis=cfg.context_axis, cp_layout=cfg.cp_layout)
     if axis is not None and sp:
         h = split_to_sp(h, axis)
+    h, aux_mean = moe_block_stack(
+        params["blocks"], h, cfg, axis=axis, sp=sp, ep_axis=ep_axis,
+        dropout_key=dropout_key,
+    )
+    return gpt_head(params, h, axis, sp), aux_mean
+
+
+def moe_block_stack(
+    blocks: List[Dict[str, PyTree]],
+    h: jnp.ndarray,
+    cfg,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    ep_axis: Optional[str] = None,
+    dropout_key: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The heterogeneous dense/expert block loop shared by the MoE model
+    families (GPT-MoE, ViT-MoE): per-block dropout-key folding,
+    :func:`is_moe_block` dispatch, and the mean-over-MoE-blocks aux
+    normalization live HERE once.  ``cfg`` is duck-typed (needs ``.block``,
+    ``.nlayers`` and the ``moe_*`` fields)."""
     aux_total = jnp.zeros((), jnp.float32)
     n_moe = 0
-    for i, bp in enumerate(params["blocks"]):
+    for i, bp in enumerate(blocks):
         k = (
             jax.random.fold_in(dropout_key, i)
             if dropout_key is not None
@@ -156,8 +177,27 @@ def gpt_moe_forward(
             n_moe += 1
         else:
             h = block_forward(bp, h, cfg.block, axis=axis, sp=sp, dropout_key=k)
-    aux_mean = aux_total / max(n_moe, 1)
-    return gpt_head(params, h, axis, sp), aux_mean
+    return h, aux_total / max(n_moe, 1)
+
+
+def moe_blocks_param_specs(
+    cfg, tp_axis: Optional[str] = None, ep_axis: Optional[str] = None
+) -> List[Dict[str, PyTree]]:
+    """Per-block spec list shared by the MoE families: dense blocks get the
+    TP specs, MoE blocks the TP attention specs + EP-sharded expert stacks
+    (router replicated)."""
+    blocks = []
+    for i in range(cfg.nlayers):
+        bspec = block_param_specs(tp_axis)
+        if is_moe_block(cfg, i):
+            bspec = {
+                "ln1": bspec["ln1"],
+                "attn": bspec["attn"],
+                "ln2": bspec["ln2"],
+                "moe": moe_param_specs(ep_axis),
+            }
+        blocks.append(bspec)
+    return blocks
 
 
 def gpt_moe_loss(
@@ -431,23 +471,12 @@ def gpt_moe_param_specs(
     ep_axis: Optional[str] = None,
 ) -> Dict[str, PyTree]:
     """Per-block specs: dense blocks get the TP specs, MoE blocks the TP
-    attention specs + EP-sharded expert stacks (router replicated)."""
-    blocks = []
-    for i in range(cfg.nlayers):
-        bspec = block_param_specs(tp_axis)
-        if is_moe_block(cfg, i):
-            bspec = {
-                "ln1": bspec["ln1"],
-                "attn": bspec["attn"],
-                "ln2": bspec["ln2"],
-                # moe_param_specs(None) yields P(None, ...) == replicated
-                "moe": moe_param_specs(ep_axis),
-            }
-        blocks.append(bspec)
+    attention specs + EP-sharded expert stacks (router replicated) — the
+    block list via the shared :func:`moe_blocks_param_specs`."""
     return {
         "tok_emb": P(tp_axis, None) if tp_axis else P(),
         "pos_emb": P(),
-        "blocks": blocks,
+        "blocks": moe_blocks_param_specs(cfg, tp_axis, ep_axis),
         "ln_f": {"scale": P(), "bias": P()},
         "head": P(None, tp_axis) if tp_axis else P(),
     }

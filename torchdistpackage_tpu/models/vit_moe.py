@@ -22,16 +22,16 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
-from ..parallel.moe import init_moe_params, moe_param_specs
-from ..parallel.tensor_parallel import (
-    block_forward,
-    block_param_specs,
-    init_block_params,
+from ..parallel.moe import init_moe_params
+from ..parallel.tensor_parallel import init_block_params
+from .gpt_moe import (
+    is_moe_block,
+    moe_block_stack,
+    moe_blocks_param_specs,
+    moe_layer_config,
 )
-from .gpt_moe import is_moe_block, moe_block_forward, moe_layer_config
-from .vit import ViTConfig, vit_embed, vit_pool_logits
+from .vit import ViTConfig, vit_embed, vit_param_specs, vit_pool_logits
 
 PyTree = Any
 
@@ -87,25 +87,12 @@ def vit_moe_forward(
         from ..parallel.tensor_parallel import split_to_sp
 
         h = split_to_sp(h, axis)
-    aux_total = jnp.zeros((), jnp.float32)
-    n_moe = 0
-    for i, bp in enumerate(params["blocks"]):
-        k = (
-            jax.random.fold_in(dropout_key, i)
-            if dropout_key is not None
-            else None
-        )
-        if is_moe_block(cfg, i):
-            # moe_block_forward reads causality from cfg.block.causal —
-            # False here, so expert_choice routing is allowed
-            h, aux = moe_block_forward(
-                bp, h, cfg, axis=axis, sp=sp, ep_axis=ep_axis, dropout_key=k
-            )
-            aux_total = aux_total + aux
-            n_moe += 1
-        else:
-            h = block_forward(bp, h, cfg.block, axis=axis, sp=sp, dropout_key=k)
-    aux_mean = aux_total / max(n_moe, 1)
+    # the shared dense/expert loop; moe_block_forward reads causality from
+    # cfg.block.causal — False here, so expert_choice routing is allowed
+    h, aux_mean = moe_block_stack(
+        params["blocks"], h, cfg, axis=axis, sp=sp, ep_axis=ep_axis,
+        dropout_key=dropout_key,
+    )
     return vit_pool_logits(params, h, cfg, axis=axis, sp=sp), aux_mean
 
 
@@ -137,25 +124,8 @@ def vit_moe_param_specs(
     tp_axis: Optional[str] = None,
     ep_axis: Optional[str] = None,
 ) -> Dict[str, PyTree]:
-    """Per-block specs: dense blocks get the TP specs, MoE blocks the TP
-    attention specs + EP-sharded expert stacks (router replicated)."""
-    blocks = []
-    for i in range(cfg.nlayers):
-        bspec = block_param_specs(tp_axis)
-        if is_moe_block(cfg, i):
-            bspec = {
-                "ln1": bspec["ln1"],
-                "attn": bspec["attn"],
-                "ln2": bspec["ln2"],
-                "moe": moe_param_specs(ep_axis),
-            }
-        blocks.append(bspec)
-    head_w = P(None, tp_axis) if tp_axis else P()
-    head_b = P(tp_axis) if tp_axis else P()
-    return {
-        "patch_proj": {"w": P(), "b": P()},
-        "pos_emb": P(),
-        "blocks": blocks,
-        "ln_f": {"scale": P(), "bias": P()},
-        "head": {"w": head_w, "b": head_b},
-    }
+    """:func:`..vit.vit_param_specs`' non-block entries + the MoE families'
+    shared per-block spec list — each layout exists once."""
+    specs = vit_param_specs(cfg, tp_axis=tp_axis)
+    specs["blocks"] = moe_blocks_param_specs(cfg, tp_axis, ep_axis)
+    return specs
