@@ -463,6 +463,10 @@ def _run_child(env_extra: dict, timeout: float, extra_args=(), capture=False,
     visible.  ``quiet`` keeps captured stdout out of the parent's stdout
     (probe markers are parent-internal, not bench output)."""
     env = dict(os.environ, **env_extra)
+    # persistent XLA compile cache shared across measurement children: the
+    # A/B sweep's one-child-per-candidate isolation would otherwise pay the
+    # full compile (~20-40 s on the chip) per child for near-identical HLO
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_bench_cache")
     args = list(extra_args)
     if "--probe" not in args:
         args = ["--measure", *args]
