@@ -65,10 +65,10 @@ import time
 # over sequence chunks (gpt_loss(xent_chunk=...)) instead of materializing
 # the ~2 GB [B, S, V] logits.
 TPU_CANDIDATES = [
+    (16, True, None),
+    (16, True, 256),
     (8, False, None),
     (8, False, 256),
-    (16, True, 256),
-    (16, True, None),
 ]
 
 # ~1B-param candidates (--big): the north-star direction (BASELINE.json
@@ -85,8 +85,9 @@ BIG_CANDIDATES = [
 # (32, True, None) 22,263 collapses (spills); (16, False, 256) OOMs —
 # streamed CE removes the logits but b16 no-remat still saves every block
 # activation (12 x [16, 2048, 768] bf16 + per-head tensors), which exhausts
-# v5e HBM.  The remat configs stay in the sweep: the flash-tile retune
-# changed the recompute price, so their pre-tune rankings are stale.
+# v5e HBM.  The post-tile-tune A/B (session 4, 2026-07-31) measured all
+# four remaining candidates on-chip: b16+remat won (85,299 — the retune
+# made its recompute ~35% cheaper) and is the headline default above.
 
 # Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
 _PEAK_BF16 = [
