@@ -65,10 +65,9 @@ import time
 # over sequence chunks (gpt_loss(xent_chunk=...)) instead of materializing
 # the ~2 GB [B, S, V] logits.
 TPU_CANDIDATES = [
+    (16, "flash", None),
     (16, True, None),
-    (16, True, 256),
     (8, False, None),
-    (8, False, 256),
 ]
 
 # ~1B-param candidates (--big): the north-star direction (BASELINE.json
@@ -78,6 +77,8 @@ TPU_CANDIDATES = [
 # amortizes the non-matmul fraction, so MFU should EXCEED the 125M
 # config's (target >= 0.45).
 BIG_CANDIDATES = [
+    (4, "flash", 256),
+    (8, "flash", 256),
     (4, True, 256),
     (8, True, 256),
 ]
@@ -85,9 +86,14 @@ BIG_CANDIDATES = [
 # (32, True, None) 22,263 collapses (spills); (16, False, 256) OOMs —
 # streamed CE removes the logits but b16 no-remat still saves every block
 # activation (12 x [16, 2048, 768] bf16 + per-head tensors), which exhausts
-# v5e HBM.  The post-tile-tune A/B (session 4, 2026-07-31) measured all
-# four remaining candidates on-chip: b16+remat won (85,299 — the retune
-# made its recompute ~35% cheaper) and is the headline default above.
+# v5e HBM.  Session-4 (2026-07-31) on-chip results: post-tile-tune,
+# b16+remat (85,299) beat b8 no-remat (82,765); remat='flash' (save the
+# flash kernel's o/lse so the backward skips its fwd re-run) pushed b16 to
+# 89,815 — the current record and headline default.  Larger flash-remat
+# batches lost ground (b24 87,127; b32+ce256 85,618): past b16 the extra
+# arithmetic intensity no longer covers the saved-activation traffic.
+# ce256 variants cost ~2% at 125M and stay retired from the sweep (the
+# streamed CE is a memory lever, not a throughput one).
 
 # Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
 _PEAK_BF16 = [
@@ -352,9 +358,12 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False) -> None:
         tps, global_batch, fpt = _run_config(
             jax, jnp, cfg, batch_size, steps, warmup, remat,
             xent_chunk=xent_chunk)
+        # remat is False | True | 'flash' (save the flash kernel's residuals
+        # so the backward skips the Pallas fwd re-run — scan_blocks docstring)
+        remat_tag = {False: "", True: " remat"}.get(remat, f" remat-{remat}")
         config_str = (
             f"gpt d{cfg.dim} L{cfg.nlayers} seq{cfg.max_seq} b{global_batch}"
-            f"{' remat' if remat else ''}"
+            f"{remat_tag}"
             f"{f' ce{xent_chunk}' if xent_chunk else ''}"
         )
         metric = f"gpt-{size_tag}-train-throughput"

@@ -589,6 +589,49 @@ def test_gpt_remat_grads_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
 
 
+def test_gpt_remat_flash_policy_matches_and_saves_residuals():
+    """remat='flash' (save the flash kernel's o/lse, skip its fwd re-run in
+    the backward) must be numerically identical to remat=True, and the
+    policy must actually capture the named residuals — otherwise it silently
+    degrades to plain block remat and the perf claim is fiction."""
+    cfg = GPTConfig(vocab_size=64, dim=32, nheads=2, nlayers=3, max_seq=16,
+                    ffn_mult=2, dtype=jnp.float32, attn_impl="flash")
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    batch = {
+        "tokens": jax.random.randint(k1, (2, 16), 0, 64),
+        "targets": jax.random.randint(k2, (2, 16), 0, 64),
+    }
+    g1 = jax.jit(jax.grad(lambda p: gpt_loss(p, batch, cfg, remat=True)))(params)
+    g2 = jax.jit(jax.grad(
+        lambda p: gpt_loss(p, batch, cfg, remat="flash")))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-6)
+
+    # the policy must save MORE than plain block remat: exactly the
+    # scan-stacked flash o [L, B*H, S, hd] and lse.  (saved_residuals is
+    # private in this jax version; skip the introspection half if it moves.)
+    try:
+        from jax._src.ad_checkpoint import saved_residuals
+    except ImportError:
+        import pytest
+
+        pytest.skip("saved_residuals moved — residual-capture check needs "
+                    "re-porting to this jax version")
+    from collections import Counter
+
+    shapes = {}
+    for mode in (True, "flash"):
+        res = saved_residuals(
+            lambda p: gpt_loss(p, batch, cfg, remat=mode), params)
+        shapes[mode] = Counter(aval.str_short() for aval, _ in res)
+    extra = shapes["flash"] - shapes[True]
+    L, BH, S, hd = (cfg.nlayers, 2 * cfg.nheads, cfg.max_seq,
+                    cfg.dim // cfg.nheads)
+    assert f"float32[{L},{BH},{S},{hd}]" in extra, dict(extra)
+
+
 def test_streamed_head_loss_matches_full():
     """The seq-chunked streaming CE equals the full-logits CE; a chunk that
     doesn't divide S fails loudly (silent full-logits fallback would defeat

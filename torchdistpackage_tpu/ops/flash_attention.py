@@ -397,6 +397,18 @@ def _flash(q, k, v, sm_scale, causal, block_q, block_k):
 
 def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
     o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    # Name the kernel's residuals so rematerialization policies can elect to
+    # save them: under jax.checkpoint with
+    # save_only_these_names('flash_out', 'flash_lse') (scan_blocks
+    # remat='flash') the backward reuses o/lse instead of re-running the
+    # Pallas forward kernel — the recompute replays only the cheap qkv
+    # einsum, cutting the remat recompute by the whole attention fwd at
+    # [B, S, D] (+ lse) bf16 of extra saved bytes per block.  Without such a
+    # policy the tags are inert identities.
+    from jax.ad_checkpoint import checkpoint_name
+
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return (o, lse), (q, k, v, o, lse)
 
 

@@ -249,7 +249,12 @@ def scan_blocks(
     ``remat`` checkpoints each block: only block boundaries are saved and the
     backward recomputes the block, trading ~1 extra fwd for O(L) less
     activation HBM — enables 2-4x larger per-chip batch (place selectively
-    via tools/profiler.py MB/ms ranking).
+    via tools/profiler.py MB/ms ranking).  ``remat='flash'`` also saves the
+    flash-attention kernel's (o, lse) residuals so the backward recompute
+    skips the Pallas fwd kernel — faster than ``True`` for ~[B, S, D] more
+    saved bytes per block (requires ``cfg.attn_impl`` 'flash'/'ring'/
+    'ulysses'; with 'naive' attention no tags exist and it degrades to
+    exactly ``True``).
 
     ``dropout_key`` enables residual dropout (``cfg.dropout_rate``); each
     layer folds its index into the key so layers draw distinct masks.
@@ -285,8 +290,20 @@ def scan_blocks(
 
     if remat:
         # prevent_cse=False: scan's loop structure already blocks CSE, so the
-        # default optimization barriers would only cost performance
-        blk = jax.checkpoint(blk, prevent_cse=False)
+        # default optimization barriers would only cost performance.
+        # remat='flash' additionally saves the flash kernel's named
+        # residuals (o, lse — tagged in ops/flash_attention._flash_fwd_rule)
+        # so the backward skips the Pallas fwd re-run: the recompute replays
+        # only LN/einsum/MLP.  Costs [B, S, D] bf16 + [B, H, S] f32 extra
+        # saved bytes per block over remat=True; measured on v5e it turns
+        # most of the attention recompute time back into throughput
+        # (docs/BENCH_AB.md session 4).
+        policy = (
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse")
+            if remat == "flash" else None
+        )
+        blk = jax.checkpoint(blk, prevent_cse=False, policy=policy)
 
     L = jax.tree.leaves(stacked)[0].shape[0]
 
