@@ -268,6 +268,105 @@ def test_gpt_1f1b_tp_nosp_sharded_transfers_match_serial(
     assert on * 2 == off, (on, off)
 
 
+def test_gpt_1f1b_remat_flash_matches_serial(devices8):
+    """The remat='flash' policy (save the Pallas kernel's o/lse, skip its
+    fwd re-run in backward) under the pipelined stack — scan over the block
+    slab inside shard_map, PP=2 x TP=2 (+SP) — must track the serial
+    un-checkpointed model in loss AND grads."""
+    cfg = dataclasses.replace(CFG, attn_impl="flash")
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    M, mbs = 4, 2
+    tpc.setup_process_groups([("pipe", 2), ("tensor", 2)], devices=devices8[:4])
+    mesh = tpc.get_view()
+    specs = gpt_param_specs(cfg, tp_axis="tensor", pipe_axis="pipe")
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+    )
+
+    def vg_fn(p, batch):
+        return gpt_pipeline_1f1b(
+            p, batch, cfg, num_microbatches=M, tp_axis="tensor", sp=True,
+            remat="flash",
+        )
+
+    sm = shard_map(
+        vg_fn, mesh=mesh,
+        in_specs=(specs, {"tokens": P(), "targets": P()}),
+        out_specs=(P(), specs),
+    )
+    k1, k2 = jax.random.split(jax.random.PRNGKey(33))
+    batch = {
+        "tokens": jax.random.randint(k1, (M, mbs, S), 0, cfg.vocab_size),
+        "targets": jax.random.randint(k2, (M, mbs, S), 0, cfg.vocab_size),
+    }
+    loss, grads = jax.jit(sm)(sharded, batch)
+
+    def serial_loss(p, b):
+        return jnp.mean(jnp.stack([
+            gpt_loss(
+                p, {"tokens": b["tokens"][m], "targets": b["targets"][m]}, cfg
+            )
+            for m in range(M)
+        ]))
+
+    sloss, sgrads = jax.value_and_grad(serial_loss)(params, batch)
+    np.testing.assert_allclose(float(loss), float(sloss), rtol=1e-5, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        grads, sgrads,
+    )
+
+
+def test_gpt_ring_cp_remat_flash_matches_serial(devices8, params):
+    """remat='flash' x ring context parallelism: the ring op calls the flash
+    kernel once per hop, so the policy saves each hop's named (o, lse)
+    partials — grads must still match the serial un-checkpointed model."""
+    cfg_cp = dataclasses.replace(CFG, attn_impl="ring", context_axis="context")
+    tpc.setup_process_groups([("context", 4)], devices=devices8[:4])
+    mesh = tpc.get_view()
+    batch = _data(jax.random.PRNGKey(7))
+
+    def cp_loss(p, b):
+        return jax.lax.pmean(
+            gpt_loss(p, b, cfg_cp, remat="flash"), "context"
+        )
+
+    bspec = {"tokens": P(None, "context"), "targets": P(None, "context")}
+    sm = shard_map(cp_loss, mesh=mesh, in_specs=(P(), bspec), out_specs=P())
+    g_got = jax.jit(jax.grad(lambda p, b: sm(p, b)))(params, batch)
+    g_want = jax.grad(lambda p, b: gpt_loss(p, b, CFG))(params, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        g_got, g_want,
+    )
+
+    # the policy must actually capture residuals through the ring op (a
+    # wrapper hiding the checkpoint_name tags would silently degrade to
+    # plain block remat while the goldens above stay green)
+    try:
+        from jax._src.ad_checkpoint import saved_residuals
+    except ImportError:
+        pytest.skip("saved_residuals moved — introspection needs re-porting")
+    from collections import Counter
+
+    shapes = {}
+    for mode in (True, "flash"):
+        res = saved_residuals(
+            lambda p, b: shard_map(
+                lambda p, b: jax.lax.pmean(
+                    gpt_loss(p, b, cfg_cp, remat=mode), "context"),
+                mesh=mesh, in_specs=(P(), bspec), out_specs=P(),
+            )(p, b),
+            params, batch)
+        shapes[mode] = Counter(aval.str_short() for aval, _ in res)
+    assert sum((shapes["flash"] - shapes[True]).values()) > 0, (
+        "remat='flash' saved nothing beyond plain remat under ring CP")
+
+
 def test_gpt_1f1b_training_matches_serial(devices8, params):
     """Full-composition 1F1B: DP=2 x PP=2 x TP=2 (+SP) with the interleaved
     schedule supplying (loss, grads) directly to the DataParallel step; two
@@ -630,6 +729,17 @@ def test_gpt_remat_flash_policy_matches_and_saves_residuals():
     L, BH, S, hd = (cfg.nlayers, 2 * cfg.nheads, cfg.max_seq,
                     cfg.dim // cfg.nheads)
     assert f"float32[{L},{BH},{S},{hd}]" in extra, dict(extra)
+
+
+def test_remat_mode_validated():
+    """A misspelled remat policy string must raise, not silently degrade to
+    plain block remat (checkpoint_block funnels every remat= kwarg)."""
+    from torchdistpackage_tpu.parallel.tensor_parallel import checkpoint_block
+
+    for ok in (False, None, True, "flash"):
+        checkpoint_block(lambda x: x, ok)
+    with pytest.raises(ValueError, match="remat"):
+        checkpoint_block(lambda x: x, "Flash")
 
 
 def test_streamed_head_loss_matches_full():

@@ -373,7 +373,8 @@ def chunked_moe_serial_loss(cfg, M, nshards, rows_per_shard=2):
 import pytest as _pytest
 
 
-@_pytest.mark.parametrize("moe_dispatch", ["dense", "sorted"])
+@_pytest.mark.parametrize(
+    "moe_dispatch", ["dense", "sorted", "sorted+rematflash"])
 def test_gpt_moe_1f1b_matches_serial_microbatched(devices8, moe_dispatch):
     """MoE × PP: the MoE GPT under the 1F1B schedule (EP × MoE-DP × PP) must
     track a serial model trained on the mean of per-microbatch losses — the
@@ -397,12 +398,18 @@ def test_gpt_moe_1f1b_matches_serial_microbatched(devices8, moe_dispatch):
     )
     from torchdistpackage_tpu.parallel.data_parallel import DataParallel
 
+    # 'sorted+rematflash' additionally runs the MoE pipeline under the
+    # remat='flash' policy with Pallas flash attention — the policy must
+    # hold through the heterogeneous dense/expert block stack too
+    dispatch, _, variant = moe_dispatch.partition("+")
+    remat = "flash" if variant == "rematflash" else True
     cfg = GPTConfig(
         vocab_size=64, dim=32, nheads=4, nlayers=4, max_seq=16, ffn_mult=2,
         moe_experts=4, moe_top_k=2, moe_every=2,
         moe_capacity_factor=4.0,  # no drops: serial and EP routing identical
         moe_aux_weight=1e-2,
-        moe_dispatch=moe_dispatch,  # both materializations through PP x EP
+        moe_dispatch=dispatch,  # both materializations through PP x EP
+        attn_impl="flash" if remat == "flash" else "naive",
     )
     M, mbs = 4, 2
     PP = 2
@@ -416,7 +423,7 @@ def test_gpt_moe_1f1b_matches_serial_microbatched(devices8, moe_dispatch):
 
     def vg_fn(p, batch):
         return gpt_moe_pipeline_1f1b(
-            p, batch, cfg, num_microbatches=M, ep_axis="moe_ep"
+            p, batch, cfg, num_microbatches=M, ep_axis="moe_ep", remat=remat
         )
 
     opt = optax.sgd(1e-1)

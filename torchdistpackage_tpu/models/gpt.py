@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.pipeline_parallel import pipeline_1f1b, pipeline_loss
 from ..parallel.tensor_parallel import (
+    RematMode,
     TransformerConfig,
     block_forward,
     block_param_specs,
@@ -206,12 +207,13 @@ def gpt_forward(
     cfg: GPTConfig,
     axis: Optional[str] = None,
     sp: bool = False,
-    remat: bool = False,
+    remat: RematMode = False,
     dropout_key: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """tokens [B, S] -> logits [B, S, V_local].  Serial when ``axis`` is None,
-    TP(/SP) inside shard_map otherwise.  ``remat`` checkpoints each block
-    (see :func:`..parallel.tensor_parallel.scan_blocks`).
+    TP(/SP) inside shard_map otherwise.  ``remat`` checkpoints each block:
+    False | True | 'flash' (the policy that saves the flash kernel's
+    residuals — see :func:`..parallel.tensor_parallel.scan_blocks`).
 
     ``dropout_key`` enables residual dropout at ``cfg.dropout_rate``; under a
     mesh derive it with ``axis_unique_key(key, 'data')`` (utils/random.py) so
@@ -238,7 +240,7 @@ def gpt_hidden(
     cfg: GPTConfig,
     axis: Optional[str] = None,
     sp: bool = False,
-    remat: bool = False,
+    remat: RematMode = False,
     dropout_key: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """tokens [B, S] -> post-blocks hidden [B, S(/tp if sp), D] — the shared
@@ -303,7 +305,7 @@ def gpt_loss(
     cfg: GPTConfig,
     axis: Optional[str] = None,
     sp: bool = False,
-    remat: bool = False,
+    remat: RematMode = False,
     dropout_key: Optional[jax.Array] = None,
     xent_chunk: Optional[int] = None,
 ) -> jnp.ndarray:
@@ -339,7 +341,7 @@ def gpt_pipeline_loss(
     tp_axis: Optional[str] = None,
     pipe_axis: str = "pipe",
     sp: bool = False,
-    remat: bool = True,
+    remat: RematMode = True,
 ) -> jnp.ndarray:
     """Pipelined GPT loss (traced; call inside shard_map over a mesh with the
     ``pipe`` axis, optionally + ``tensor``/``data``).
@@ -450,7 +452,7 @@ def gpt_pipeline_1f1b(
     tp_axis: Optional[str] = None,
     pipe_axis: str = "pipe",
     sp: bool = False,
-    remat: bool = True,
+    remat: RematMode = True,
     dropout_key: Optional[jax.Array] = None,
     num_chunks: int = 1,
     shard_transfers: Optional[bool] = None,

@@ -42,7 +42,9 @@ from ..parallel.moe import (
     moe_param_specs,
 )
 from ..parallel.tensor_parallel.layers import (
+    RematMode,
     _close_row_parallel,
+    checkpoint_block,
     attention_partial,
     block_forward,
     block_param_specs,
@@ -326,7 +328,7 @@ def gpt_moe_pipeline_1f1b(
     pipe_axis: str = "pipe",
     ep_axis: Optional[str] = None,
     sp: bool = False,
-    remat: bool = True,
+    remat: RematMode = True,
     dropout_key: Optional[jax.Array] = None,
     num_chunks: int = 1,
     shard_transfers: Optional[bool] = None,
@@ -390,16 +392,14 @@ def gpt_moe_pipeline_1f1b(
                     bp, x, cfg, axis=tp_axis, sp=sp, ep_axis=ep_axis,
                     dropout_key=k,
                 )
-                if remat:
-                    body = jax.checkpoint(body)
+                body = checkpoint_block(body, remat)
                 x, aux = body(bp, x, k)
                 aux_total = aux_total + aux
             else:
                 body = lambda bp, x, k: block_forward(
                     bp, x, cfg.block, axis=tp_axis, sp=sp, dropout_key=k
                 )
-                if remat:
-                    body = jax.checkpoint(body)
+                body = checkpoint_block(body, remat)
                 x = body(bp, x, k)
         return x, aux_scale * aux_total
 

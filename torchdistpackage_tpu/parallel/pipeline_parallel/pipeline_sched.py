@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from ...dist.topology import PIPE_AXIS
+from ..tensor_parallel.layers import RematMode, checkpoint_block
 
 PyTree = Any
 
@@ -196,7 +197,7 @@ def _pipeline_scan(
     stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
     num_microbatches: int,
     pipe_axis: str,
-    remat: bool,
+    remat: RematMode,
     make_acc: Callable,
     consume: Callable,
     first_fn: Callable = None,
@@ -227,7 +228,9 @@ def _pipeline_scan(
     P_ = jax.lax.axis_size(pipe_axis)
     ticks = M + P_ - 1
     first = is_first_stage(pipe_axis)
-    body_fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    # prevent_cse=False: body_fn executes inside the tick lax.scan below,
+    # whose loop structure already blocks CSE (same rationale as scan_blocks)
+    body_fn = checkpoint_block(stage_fn, remat, prevent_cse=False)
 
     if first_fn is None:
         zero_state, want_vma = _stage_probe(
@@ -282,7 +285,7 @@ def pipeline_forward(
     stage_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
     num_microbatches: int,
     pipe_axis: str = PIPE_AXIS,
-    remat: bool = True,
+    remat: RematMode = True,
     collect_outputs: bool = True,
     first_fn: Callable = None,
     params: PyTree = None,
@@ -336,7 +339,7 @@ def pipeline_loss(
     loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
     num_microbatches: int,
     pipe_axis: str = PIPE_AXIS,
-    remat: bool = True,
+    remat: RematMode = True,
     first_fn: Callable = None,
     params: PyTree = None,
 ) -> jnp.ndarray:

@@ -7,10 +7,12 @@ from .tp_utils import (
     split_to_sp,
 )
 from .layers import (
+    RematMode,
     TransformerConfig,
     attention_partial,
     block_forward,
     block_param_specs,
+    checkpoint_block,
     init_block_params,
     init_transformer_params,
     layer_norm,

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -173,6 +173,37 @@ def dropout(
     return jnp.where(keep, x / (1.0 - rate), jnp.zeros((), x.dtype))
 
 
+#: Valid ``remat`` values everywhere the package threads one: False/None
+#: (no checkpointing), True (full-block), 'flash' (block checkpoint whose
+#: policy saves the flash kernel's named (o, lse) residuals — tagged in
+#: ops/flash_attention._flash_fwd_rule — so the backward skips the Pallas
+#: fwd re-run and recomputes only LN/einsum/MLP; measured +5.3% on the v5e
+#: 125M bench, docs/BENCH_AB.md session 4).
+RematMode = Union[bool, None, str]
+_REMAT_MODES = (False, None, True, "flash")
+
+
+def checkpoint_block(fn, remat: RematMode, prevent_cse: bool = True):
+    """``jax.checkpoint`` with the package's validated remat modes.
+
+    Every ``remat=`` kwarg in the package funnels here, so a misspelled
+    policy string raises instead of silently degrading to plain block remat
+    (which would leave the caller believing the faster policy is active).
+    ``prevent_cse=False`` is correct under ``lax.scan`` (the loop structure
+    already blocks CSE — the default barriers would only cost performance).
+    """
+    if remat not in _REMAT_MODES:
+        raise ValueError(
+            f"remat must be one of {_REMAT_MODES}, got {remat!r}")
+    if not remat:
+        return fn
+    policy = (
+        jax.checkpoint_policies.save_only_these_names("flash_out", "flash_lse")
+        if remat == "flash" else None
+    )
+    return jax.checkpoint(fn, prevent_cse=prevent_cse, policy=policy)
+
+
 # ---------------------------------------------------------------------- blocks
 
 
@@ -238,7 +269,7 @@ def scan_blocks(
     cfg: TransformerConfig,
     axis: Optional[str] = None,
     sp: bool = False,
-    remat: bool = False,
+    remat: RematMode = False,
     dropout_key: Optional[jax.Array] = None,
     layer_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
@@ -289,21 +320,7 @@ def scan_blocks(
         return block_forward(lp, h, cfg, axis=axis, sp=sp, dropout_key=k)
 
     if remat:
-        # prevent_cse=False: scan's loop structure already blocks CSE, so the
-        # default optimization barriers would only cost performance.
-        # remat='flash' additionally saves the flash kernel's named
-        # residuals (o, lse — tagged in ops/flash_attention._flash_fwd_rule)
-        # so the backward skips the Pallas fwd re-run: the recompute replays
-        # only LN/einsum/MLP.  Costs [B, S, D] bf16 + [B, H, S] f32 extra
-        # saved bytes per block over remat=True; measured on v5e it turns
-        # most of the attention recompute time back into throughput
-        # (docs/BENCH_AB.md session 4).
-        policy = (
-            jax.checkpoint_policies.save_only_these_names(
-                "flash_out", "flash_lse")
-            if remat == "flash" else None
-        )
-        blk = jax.checkpoint(blk, prevent_cse=False, policy=policy)
+        blk = checkpoint_block(blk, remat, prevent_cse=False)
 
     L = jax.tree.leaves(stacked)[0].shape[0]
 
