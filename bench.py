@@ -81,6 +81,10 @@ BIG_CANDIDATES = [
     (8, "flash", 256),
     (4, True, 256),
     (8, True, 256),
+    # residuals offloaded to pinned_host: HBM cost of the 'flash' policy
+    # drops to ~one block in flight — candidate for batches that OOM in
+    # plain 'flash' mode (untested on-chip until the tunnel returns)
+    (16, "flash_offload", 256),
 ]
 # Retired candidates (recorded in BENCH_BASELINE.json / docs/BENCH_AB.md):
 # (32, True, None) 22,263 collapses (spills); (16, False, 256) OOMs —
@@ -358,8 +362,9 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False) -> None:
         tps, global_batch, fpt = _run_config(
             jax, jnp, cfg, batch_size, steps, warmup, remat,
             xent_chunk=xent_chunk)
-        # remat is False | True | 'flash' (save the flash kernel's residuals
-        # so the backward skips the Pallas fwd re-run — scan_blocks docstring)
+        # remat: False | True | 'flash' | 'flash_offload' (save the flash
+        # kernel's residuals — in HBM or pinned_host — so the backward skips
+        # the Pallas fwd re-run; scan_blocks docstring)
         remat_tag = {False: "", True: " remat"}.get(remat, f" remat-{remat}")
         config_str = (
             f"gpt d{cfg.dim} L{cfg.nlayers} seq{cfg.max_seq} b{global_batch}"

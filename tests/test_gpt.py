@@ -702,11 +702,13 @@ def test_gpt_remat_flash_policy_matches_and_saves_residuals():
         "targets": jax.random.randint(k2, (2, 16), 0, 64),
     }
     g1 = jax.jit(jax.grad(lambda p: gpt_loss(p, batch, cfg, remat=True)))(params)
-    g2 = jax.jit(jax.grad(
-        lambda p: gpt_loss(p, batch, cfg, remat="flash")))(params)
-    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
-                                   atol=1e-6)
+    for mode in ("flash", "flash_offload"):
+        g2 = jax.jit(jax.grad(
+            lambda p: gpt_loss(p, batch, cfg, remat=mode)))(params)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6,
+                                       err_msg=f"remat={mode}")
 
     # the policy must save MORE than plain block remat: exactly the
     # scan-stacked flash o [L, B*H, S, hd] and lse.  (saved_residuals is
@@ -721,14 +723,17 @@ def test_gpt_remat_flash_policy_matches_and_saves_residuals():
     from collections import Counter
 
     shapes = {}
-    for mode in (True, "flash"):
+    for mode in (True, "flash", "flash_offload"):
         res = saved_residuals(
             lambda p: gpt_loss(p, batch, cfg, remat=mode), params)
         shapes[mode] = Counter(aval.str_short() for aval, _ in res)
-    extra = shapes["flash"] - shapes[True]
     L, BH, S, hd = (cfg.nlayers, 2 * cfg.nheads, cfg.max_seq,
                     cfg.dim // cfg.nheads)
-    assert f"float32[{L},{BH},{S},{hd}]" in extra, dict(extra)
+    # the offloaded residuals carry the <host> memory-space annotation —
+    # proving they land in pinned_host, not merely that they were saved
+    for mode, tag in (("flash", ""), ("flash_offload", "<host>")):
+        extra = shapes[mode] - shapes[True]
+        assert f"float32{tag}[{L},{BH},{S},{hd}]" in extra, (mode, dict(extra))
 
 
 def test_remat_mode_validated():
@@ -736,7 +741,7 @@ def test_remat_mode_validated():
     plain block remat (checkpoint_block funnels every remat= kwarg)."""
     from torchdistpackage_tpu.parallel.tensor_parallel import checkpoint_block
 
-    for ok in (False, None, True, "flash"):
+    for ok in (False, None, True, "flash", "flash_offload"):
         checkpoint_block(lambda x: x, ok)
     with pytest.raises(ValueError, match="remat"):
         checkpoint_block(lambda x: x, "Flash")

@@ -212,8 +212,9 @@ def gpt_forward(
 ) -> jnp.ndarray:
     """tokens [B, S] -> logits [B, S, V_local].  Serial when ``axis`` is None,
     TP(/SP) inside shard_map otherwise.  ``remat`` checkpoints each block:
-    False | True | 'flash' (the policy that saves the flash kernel's
-    residuals — see :func:`..parallel.tensor_parallel.scan_blocks`).
+    False | True | 'flash' (save the flash kernel's residuals) |
+    'flash_offload' (same, parked in pinned_host memory) — see
+    :func:`..parallel.tensor_parallel.scan_blocks`.
 
     ``dropout_key`` enables residual dropout at ``cfg.dropout_rate``; under a
     mesh derive it with ``axis_unique_key(key, 'data')`` (utils/random.py) so

@@ -178,9 +178,15 @@ def dropout(
 #: policy saves the flash kernel's named (o, lse) residuals — tagged in
 #: ops/flash_attention._flash_fwd_rule — so the backward skips the Pallas
 #: fwd re-run and recomputes only LN/einsum/MLP; measured +5.3% on the v5e
-#: 125M bench, docs/BENCH_AB.md session 4).
+#: 125M bench, docs/BENCH_AB.md session 4), and 'flash_offload' ('flash'
+#: whose saved residuals live in ``pinned_host`` memory instead of HBM —
+#: XLA schedules the device->host DMA behind the remaining forward and the
+#: host->device prefetch behind the backward, so the HBM cost of the
+#: policy drops to ~one block's residuals in flight; the long-context /
+#: big-batch lever).
 RematMode = Union[bool, None, str]
-_REMAT_MODES = (False, None, True, "flash")
+_REMAT_MODES = (False, None, True, "flash", "flash_offload")
+_FLASH_RESIDUAL_NAMES = ("flash_out", "flash_lse")
 
 
 def checkpoint_block(fn, remat: RematMode, prevent_cse: bool = True):
@@ -197,10 +203,18 @@ def checkpoint_block(fn, remat: RematMode, prevent_cse: bool = True):
             f"remat must be one of {_REMAT_MODES}, got {remat!r}")
     if not remat:
         return fn
-    policy = (
-        jax.checkpoint_policies.save_only_these_names("flash_out", "flash_lse")
-        if remat == "flash" else None
-    )
+    if remat == "flash":
+        policy = jax.checkpoint_policies.save_only_these_names(
+            *_FLASH_RESIDUAL_NAMES)
+    elif remat == "flash_offload":
+        policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=list(_FLASH_RESIDUAL_NAMES),
+            offload_src="device",
+            offload_dst="pinned_host",
+        )
+    else:
+        policy = None
     return jax.checkpoint(fn, prevent_cse=prevent_cse, policy=policy)
 
 
@@ -285,7 +299,9 @@ def scan_blocks(
     skips the Pallas fwd kernel — faster than ``True`` for ~[B, S, D] more
     saved bytes per block (requires ``cfg.attn_impl`` 'flash'/'ring'/
     'ulysses'; with 'naive' attention no tags exist and it degrades to
-    exactly ``True``).
+    exactly ``True``).  ``remat='flash_offload'`` parks those saved
+    residuals in pinned_host memory instead of HBM (the long-context /
+    big-batch lever — see :data:`RematMode`).
 
     ``dropout_key`` enables residual dropout (``cfg.dropout_rate``); each
     layer folds its index into the key so layers draw distinct masks.
