@@ -52,6 +52,39 @@ def test_moe_serial_matches_dense_golden():
     assert np.isfinite(float(aux)) and float(aux) > 0
 
 
+def test_gpt_moe_serial_remat_modes_match():
+    """The non-pipeline MoE path supports activation checkpointing (before
+    this, only the dense family and the MoE pipeline did): every remat mode
+    must be numerically identical to remat=False through the heterogeneous
+    dense/expert block loop, flash attention included."""
+    from torchdistpackage_tpu.models import (
+        GPTConfig, gpt_moe_loss, init_gpt_moe_params,
+    )
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, nheads=4, nlayers=4, max_seq=16, ffn_mult=2,
+        moe_experts=4, moe_top_k=2, moe_every=2, moe_capacity_factor=4.0,
+        moe_aux_weight=1e-2, attn_impl="flash",
+    )
+    params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    batch = {
+        "tokens": jax.random.randint(k1, (2, 16), 0, cfg.vocab_size),
+        "targets": jax.random.randint(k2, (2, 16), 0, cfg.vocab_size),
+    }
+    g0 = jax.jit(jax.grad(
+        lambda p: gpt_moe_loss(p, batch, cfg, remat=False)))(params)
+    for mode in (True, "flash", "flash_offload"):
+        g1 = jax.jit(jax.grad(
+            lambda p: gpt_moe_loss(p, batch, cfg, remat=mode)))(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6,
+                err_msg=f"remat={mode}"),
+            g0, g1,
+        )
+
+
 def test_sorted_dispatch_matches_dense():
     """The index-based (gather/scatter-add) dispatch must reproduce the
     dense [T,E,C] einsum path — same routing decision, same outputs and

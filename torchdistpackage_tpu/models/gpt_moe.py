@@ -135,16 +135,21 @@ def gpt_moe_forward(
     sp: bool = False,
     ep_axis: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
+    remat: RematMode = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """tokens [B, S] -> (logits [B, S, V_local], mean aux loss over MoE
     blocks).  ``params['blocks']`` is the heterogeneous per-block list from
-    :func:`init_gpt_moe_params`."""
+    :func:`init_gpt_moe_params`.  ``remat`` checkpoints each block
+    (False | True | 'flash' | 'flash_offload' — scan_blocks docstring);
+    before this the non-pipeline MoE path had NO activation checkpointing,
+    so big-MoE-on-few-chips configs couldn't trade recompute for HBM the
+    way the dense family (gpt_loss) and the MoE pipeline already could."""
     h = gpt_embed(params, tokens, axis, context_axis=cfg.context_axis, cp_layout=cfg.cp_layout)
     if axis is not None and sp:
         h = split_to_sp(h, axis)
     h, aux_mean = moe_block_stack(
         params["blocks"], h, cfg, axis=axis, sp=sp, ep_axis=ep_axis,
-        dropout_key=dropout_key,
+        dropout_key=dropout_key, remat=remat,
     )
     return gpt_head(params, h, axis, sp), aux_mean
 
@@ -157,12 +162,24 @@ def moe_block_stack(
     sp: bool = False,
     ep_axis: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
+    remat: RematMode = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The heterogeneous dense/expert block loop shared by the MoE model
     families (GPT-MoE, ViT-MoE): per-block dropout-key folding,
     :func:`is_moe_block` dispatch, and the mean-over-MoE-blocks aux
     normalization live HERE once.  ``cfg`` is duck-typed (needs ``.block``,
     ``.nlayers`` and the ``moe_*`` fields)."""
+    moe_body = checkpoint_block(
+        lambda bp, h, k: moe_block_forward(
+            bp, h, cfg, axis=axis, sp=sp, ep_axis=ep_axis, dropout_key=k,
+        ),
+        remat,
+    )
+    dense_body = checkpoint_block(
+        lambda bp, h, k: block_forward(
+            bp, h, cfg.block, axis=axis, sp=sp, dropout_key=k),
+        remat,
+    )
     aux_total = jnp.zeros((), jnp.float32)
     n_moe = 0
     for i, bp in enumerate(blocks):
@@ -172,13 +189,11 @@ def moe_block_stack(
             else None
         )
         if is_moe_block(cfg, i):
-            h, aux = moe_block_forward(
-                bp, h, cfg, axis=axis, sp=sp, ep_axis=ep_axis, dropout_key=k
-            )
+            h, aux = moe_body(bp, h, k)
             aux_total = aux_total + aux
             n_moe += 1
         else:
-            h = block_forward(bp, h, cfg.block, axis=axis, sp=sp, dropout_key=k)
+            h = dense_body(bp, h, k)
     return h, aux_total / max(n_moe, 1)
 
 
@@ -210,12 +225,13 @@ def gpt_moe_loss(
     sp: bool = False,
     ep_axis: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
+    remat: RematMode = False,
 ) -> jnp.ndarray:
     """Mean next-token CE + ``cfg.moe_aux_weight`` x mean load-balance aux
     (the Switch recipe: aux summed into the task loss)."""
     logits, aux = gpt_moe_forward(
         params, batch["tokens"], cfg, axis=axis, sp=sp, ep_axis=ep_axis,
-        dropout_key=dropout_key,
+        dropout_key=dropout_key, remat=remat,
     )
     ce = vocab_parallel_xent(logits, batch["targets"], axis)
     return ce + cfg.moe_aux_weight * aux.astype(ce.dtype)
@@ -374,6 +390,18 @@ def gpt_moe_pipeline_1f1b(
             h = split_to_sp(h, tp_axis)
         return h
 
+    moe_body = checkpoint_block(
+        lambda bp, x, k: moe_block_forward(
+            bp, x, cfg, axis=tp_axis, sp=sp, ep_axis=ep_axis, dropout_key=k,
+        ),
+        remat,
+    )
+    dense_body = checkpoint_block(
+        lambda bp, x, k: block_forward(
+            bp, x, cfg.block, axis=tp_axis, sp=sp, dropout_key=k),
+        remat,
+    )
+
     def run_blocks(p, x, m, select, v=None):
         """One slab's block loop; ``select`` maps a stacked leaf to the
         slab-local array (closes over the chunk index when interleaved)."""
@@ -388,19 +416,10 @@ def gpt_moe_pipeline_1f1b(
                 if v is not None:  # distinct masks per chunk slab
                     k = jax.random.fold_in(k, v)
             if pattern[i]:
-                body = lambda bp, x, k: moe_block_forward(
-                    bp, x, cfg, axis=tp_axis, sp=sp, ep_axis=ep_axis,
-                    dropout_key=k,
-                )
-                body = checkpoint_block(body, remat)
-                x, aux = body(bp, x, k)
+                x, aux = moe_body(bp, x, k)
                 aux_total = aux_total + aux
             else:
-                body = lambda bp, x, k: block_forward(
-                    bp, x, cfg.block, axis=tp_axis, sp=sp, dropout_key=k
-                )
-                body = checkpoint_block(body, remat)
-                x = body(bp, x, k)
+                x = dense_body(bp, x, k)
         return x, aux_scale * aux_total
 
     if num_chunks == 1:

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.tensor_parallel import (
+    RematMode,
     TransformerConfig,
     block_forward,
     init_block_params,
@@ -177,7 +178,7 @@ def vit_forward(
     cfg: ViTConfig,
     axis: Optional[str] = None,
     sp: bool = False,
-    remat: bool = False,
+    remat: RematMode = False,
     dropout_key = None,
 ) -> jnp.ndarray:
     """[B, H, W, C] images -> [B, num_classes] logits.  TP(/SP) over ``axis``
@@ -200,7 +201,7 @@ def vit_loss(
     cfg: ViTConfig,
     axis: Optional[str] = None,
     sp: bool = False,
-    remat: bool = False,
+    remat: RematMode = False,
     dropout_key = None,
 ) -> jnp.ndarray:
     """Mean softmax cross-entropy.  ``batch``: {'images': [B,H,W,C],
@@ -246,7 +247,7 @@ def vit_pipeline_1f1b(
     tp_axis: Optional[str] = None,
     pipe_axis: str = "pipe",
     sp: bool = False,
-    remat: bool = True,
+    remat: RematMode = True,
     dropout_key: Optional[jax.Array] = None,
 ):
     """1F1B-scheduled ViT training core: returns ``(loss, grads)`` (see

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.moe import init_moe_params
-from ..parallel.tensor_parallel import init_block_params
+from ..parallel.tensor_parallel import RematMode, init_block_params
 from .gpt_moe import (
     is_moe_block,
     moe_block_stack,
@@ -78,10 +78,12 @@ def vit_moe_forward(
     sp: bool = False,
     ep_axis: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
+    remat: RematMode = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """[B, H, W, C] images -> ([B, num_classes(/tp)] logits, mean aux loss
     over MoE blocks).  ``params['blocks']`` is the heterogeneous per-block
-    list from :func:`init_vit_moe_params`."""
+    list from :func:`init_vit_moe_params`.  ``remat`` checkpoints each
+    block (False | True | 'flash' | 'flash_offload')."""
     h = vit_embed(params, images, cfg)
     if axis is not None and sp:
         from ..parallel.tensor_parallel import split_to_sp
@@ -91,7 +93,7 @@ def vit_moe_forward(
     # cfg.block.causal — False here, so expert_choice routing is allowed
     h, aux_mean = moe_block_stack(
         params["blocks"], h, cfg, axis=axis, sp=sp, ep_axis=ep_axis,
-        dropout_key=dropout_key,
+        dropout_key=dropout_key, remat=remat,
     )
     return vit_pool_logits(params, h, cfg, axis=axis, sp=sp), aux_mean
 
@@ -104,6 +106,7 @@ def vit_moe_loss(
     sp: bool = False,
     ep_axis: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
+    remat: RematMode = False,
 ) -> jnp.ndarray:
     """Mean CE + ``cfg.moe_aux_weight`` x mean load-balance aux (identically
     0 under expert-choice routing).  ``batch``: {'images': [B, H, W, C],
@@ -112,7 +115,7 @@ def vit_moe_loss(
 
     logits, aux = vit_moe_forward(
         params, batch["images"], cfg, axis=axis, sp=sp, ep_axis=ep_axis,
-        dropout_key=dropout_key,
+        dropout_key=dropout_key, remat=remat,
     )
     tp = axis if logits.shape[-1] != cfg.num_classes else None
     ce = vocab_parallel_xent(logits, batch["labels"], tp)
