@@ -86,6 +86,18 @@ BIG_CANDIDATES = [
     # plain 'flash' mode (untested on-chip until the tunnel returns)
     (16, "flash_offload", 256),
 ]
+
+# Long-context candidates (--long): the 125M model at seq 8192 — the
+# single-chip long-S story (CP spreads S across chips; this measures the
+# per-chip leaf: flash tiles at long S + remat='flash' + streamed CE).
+# (1024, 1024) tiles measured fastest through S=4096 on v5e
+# (docs/FLASH_TUNE_v5e.json); the S=8192 tile sweep itself is queued —
+# until it lands these candidates ride the S=4096-validated choice.
+LONG_CANDIDATES = [
+    (2, "flash", 512),
+    (4, "flash", 512),
+    (2, "flash_offload", 512),
+]
 # Retired candidates (recorded in BENCH_BASELINE.json / docs/BENCH_AB.md):
 # (32, True, None) 22,263 collapses (spills); (16, False, 256) OOMs —
 # streamed CE removes the logits but b16 no-remat still saves every block
@@ -138,7 +150,7 @@ def _measure() -> None:
     import jax.numpy as jnp
 
     main(jax, jnp, ab="--ab" in sys.argv, only=_only_index(sys.argv),
-         big="--big" in sys.argv)
+         big="--big" in sys.argv, long="--long" in sys.argv)
 
 
 def _load_baselines(path: str) -> dict:
@@ -301,7 +313,8 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
     return global_batch * cfg.max_seq * steps / dt / n_chips, global_batch, flops_per_token
 
 
-def main(jax, jnp, ab: bool = False, only=None, big: bool = False) -> None:
+def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
+         long: bool = False) -> None:
     from torchdistpackage_tpu.models import GPTConfig
 
     # Backend probe with CPU fallback: an accelerator backend that errors at
@@ -317,7 +330,16 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False) -> None:
     chip = jax.devices()[0].device_kind
     peak = _peak_flops(chip) if on_accel else None
 
-    if on_accel and big:
+    if on_accel and long:
+        # long-context leaf: 125M at S=8192 (the CP ring's per-chip config)
+        cfg = GPTConfig(
+            vocab_size=32768, dim=768, nheads=12, nlayers=12, max_seq=8192,
+            ffn_mult=4, dtype=jnp.bfloat16, attn_impl="flash",
+        )
+        candidates = LONG_CANDIDATES
+        steps, warmup = 8, 2
+        size_tag = "125m-s8k"
+    elif on_accel and big:
         cfg = GPTConfig(
             vocab_size=32768, dim=2048, nheads=16, nlayers=16, max_seq=2048,
             ffn_mult=4, dtype=jnp.bfloat16, attn_impl="flash",
@@ -505,7 +527,7 @@ def _run_child(env_extra: dict, timeout: float, extra_args=(), capture=False,
 
 
 def _ab_main(timeout: float, allow_cpu: bool = False,
-             big: bool = False) -> None:
+             big: bool = False, long: bool = False) -> None:
     """One child per candidate: an OOM/hang in one config cannot abort the
     sweep (observed: b16 no-remat exhausts v5e HBM and killed the round-3
     sweep's remaining configs), and each child gets a fresh backend — no
@@ -520,8 +542,9 @@ def _ab_main(timeout: float, allow_cpu: bool = False,
     Exception: under an EXPLICIT ``JAX_PLATFORMS=cpu`` (``allow_cpu``) the
     user asked for the CPU sweep, so CPU lines are the legitimate result
     and only the end-of-list marker stops."""
-    cands = BIG_CANDIDATES if big else TPU_CANDIDATES
-    extra = ("--big",) if big else ()
+    cands = (LONG_CANDIDATES if long
+             else BIG_CANDIDATES if big else TPU_CANDIDATES)
+    extra = ("--long",) if long else ("--big",) if big else ()
     best = None
     for i in range(len(cands)):
         out = _run_child(
@@ -584,11 +607,16 @@ if __name__ == "__main__":
                 {"ab_winner": None, "error": "accelerator unreachable"}))
             sys.exit(0)
         _ab_main(cpu_timeout if on_cpu else accel_timeout, allow_cpu=on_cpu,
-                 big="--big" in sys.argv)
+                 big="--big" in sys.argv, long="--long" in sys.argv)
         sys.exit(0)
 
+    # `python bench.py --long` measures LONG_CANDIDATES[0] (its own
+    # gpt-125m-s8k series) instead of the S=2048 headline — the flag must
+    # reach the measurement children or results would land in the wrong
+    # baseline series while appearing to succeed
+    long_flag = ("--long",) if "--long" in sys.argv else ()
     if on_cpu:
-        ok = _run_child({}, cpu_timeout)
+        ok = _run_child({}, cpu_timeout, long_flag)
     else:
         ok = False
         probed = _probe_accel(probe_attempts, probe_timeout, probe_delay)
@@ -596,18 +624,19 @@ if __name__ == "__main__":
             # the ~1B north-star config measures in its OWN child first,
             # best-effort: an OOM/hang there cannot cost the headline line
             # (and its line precedes the headline so the parsed last line
-            # stays the 125m record series)
-            if os.environ.get("BENCH_BIG", "1") != "0":
+            # stays the 125m record series); skipped under --long, which is
+            # a different series entirely
+            if not long_flag and os.environ.get("BENCH_BIG", "1") != "0":
                 if not _run_child({}, accel_timeout, ("--big",)):
                     print("bench: 1b config child failed; continuing with "
                           "the headline config", file=sys.stderr)
-            ok = _run_child({}, accel_timeout)
+            ok = _run_child({}, accel_timeout, long_flag)
             if not ok:
                 # init works (probe passed) — the failure was in the
                 # measurement itself; one retry before giving up on the chip
                 print("bench: accelerator measurement failed after a good "
                       "probe; retrying once", file=sys.stderr)
-                ok = _run_child({}, accel_timeout)
+                ok = _run_child({}, accel_timeout, long_flag)
         if not ok:
             print("bench: accelerator unreachable/failed; measuring on CPU "
                   "and attaching the last-good accelerator record",

@@ -154,6 +154,24 @@ def gpt_moe_forward(
     return gpt_head(params, h, axis, sp), aux_mean
 
 
+def _moe_bodies(cfg, axis, sp, ep_axis, remat):
+    """(moe_body, dense_body) with the remat mode applied — the one place
+    the per-block checkpoint wiring exists, shared by the serial stack and
+    the pipeline stage loop so the two paths cannot diverge."""
+    moe_body = checkpoint_block(
+        lambda bp, h, k: moe_block_forward(
+            bp, h, cfg, axis=axis, sp=sp, ep_axis=ep_axis, dropout_key=k,
+        ),
+        remat,
+    )
+    dense_body = checkpoint_block(
+        lambda bp, h, k: block_forward(
+            bp, h, cfg.block, axis=axis, sp=sp, dropout_key=k),
+        remat,
+    )
+    return moe_body, dense_body
+
+
 def moe_block_stack(
     blocks: List[Dict[str, PyTree]],
     h: jnp.ndarray,
@@ -169,17 +187,7 @@ def moe_block_stack(
     :func:`is_moe_block` dispatch, and the mean-over-MoE-blocks aux
     normalization live HERE once.  ``cfg`` is duck-typed (needs ``.block``,
     ``.nlayers`` and the ``moe_*`` fields)."""
-    moe_body = checkpoint_block(
-        lambda bp, h, k: moe_block_forward(
-            bp, h, cfg, axis=axis, sp=sp, ep_axis=ep_axis, dropout_key=k,
-        ),
-        remat,
-    )
-    dense_body = checkpoint_block(
-        lambda bp, h, k: block_forward(
-            bp, h, cfg.block, axis=axis, sp=sp, dropout_key=k),
-        remat,
-    )
+    moe_body, dense_body = _moe_bodies(cfg, axis, sp, ep_axis, remat)
     aux_total = jnp.zeros((), jnp.float32)
     n_moe = 0
     for i, bp in enumerate(blocks):
@@ -390,17 +398,7 @@ def gpt_moe_pipeline_1f1b(
             h = split_to_sp(h, tp_axis)
         return h
 
-    moe_body = checkpoint_block(
-        lambda bp, x, k: moe_block_forward(
-            bp, x, cfg, axis=tp_axis, sp=sp, ep_axis=ep_axis, dropout_key=k,
-        ),
-        remat,
-    )
-    dense_body = checkpoint_block(
-        lambda bp, x, k: block_forward(
-            bp, x, cfg.block, axis=tp_axis, sp=sp, dropout_key=k),
-        remat,
-    )
+    moe_body, dense_body = _moe_bodies(cfg, tp_axis, sp, ep_axis, remat)
 
     def run_blocks(p, x, m, select, v=None):
         """One slab's block loop; ``select`` maps a stacked leaf to the
