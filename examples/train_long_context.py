@@ -74,8 +74,14 @@ def main():
     dp = DataParallel(mesh=mesh, axis=("context",))
     sharded = dp.broadcast_params(params)
     state = opt.init(sharded)
+    # remat='flash' + streamed CE: the long-context memory stack
+    # (docs/long_context.md "Memory levers") — the ring op's per-hop flash
+    # (o, lse) residuals are saved so the backward skips the kernel re-run,
+    # and the [B, S_loc, V] logits never materialize.  The chunk must
+    # divide the context-LOCAL sequence shard (S/ndev), so derive it.
+    xc = min(256, S // ndev)
     step = dp.make_train_step(
-        lambda p, b: gpt_loss(p, b, cfg),
+        lambda p, b: gpt_loss(p, b, cfg, remat="flash", xent_chunk=xc),
         opt,
         batch_spec={"tokens": P(None, "context"), "targets": P(None, "context")},
     )

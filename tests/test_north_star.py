@@ -1,52 +1,28 @@
 """The BASELINE.json north-star config at REAL scale, abstractly.
 
-``dryrun_multichip`` executes the flagship composition at tiny shapes; this
-suite traces it at the actual 7B / 64-chip target (``jax.eval_shape`` —
-zero FLOPs, zero array bytes), proving every sharding spec divides, the
-interleaved slab layout holds, and the ZeRO partition algebra works at
-d4096/L32/TP8/PP2/DP4.  Runs in a subprocess so the 64-device CPU sim
-doesn't disturb this process's 8-device backend.
+``dryrun_multichip`` executes the flagship composition at tiny shapes;
+``trace_north_star_7b`` traces it at the actual 7B / 64-chip target
+(``jax.eval_shape`` — zero FLOPs, zero array bytes), proving every
+sharding spec divides, the interleaved slab layout holds, and the ZeRO
+partition algebra works at d4096/L32/TP8/PP2/DP4.  The function
+self-respawns under a 64-device CPU sim (this pytest process holds the
+8-device backend), and its own asserts — param count 6-8B, scalar loss,
+shape-preserving step — run in that child; a child failure raises here.
 """
 
-import json
-import os
 import pathlib
-import subprocess
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
-_CHILD = """
-import os
-os.environ["XLA_FLAGS"] = " --xla_force_host_platform_device_count=64"
-import jax
-jax.config.update("jax_platforms", "cpu")
-import json, sys
-sys.path.insert(0, {repo!r})
-import __graft_entry__ as g
-print("SUMMARY=" + json.dumps(g.trace_north_star_7b()))
-"""
-
 
 def test_north_star_7b_traces_on_64_device_mesh():
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env.pop("JAX_PLATFORMS", None)
-    res = subprocess.run(
-        [sys.executable, "-c", _CHILD.format(repo=str(REPO))],
-        env=env,
-        capture_output=True,
-        text=True,
-        timeout=600,
-        cwd=str(REPO),
-    )
-    assert res.returncode == 0, (
-        f"trace failed (rc={res.returncode})\n--- stdout ---\n"
-        f"{res.stdout[-2000:]}\n--- stderr ---\n{res.stderr[-2000:]}"
-    )
-    line = [l for l in res.stdout.splitlines() if l.startswith("SUMMARY=")][-1]
-    summary = json.loads(line[len("SUMMARY="):])
-    # ~7B-class (the reference's north-star model size), scalar loss
-    assert 6.0 < summary["params_b"] < 8.0, summary
-    assert summary["loss_shape"] == [], summary
-    assert "tensor=8" in summary["mesh"] and "pipe=2" in summary["mesh"]
+    sys.path.insert(0, str(REPO))
+    try:
+        import __graft_entry__ as g
+
+        # 8-device pytest process -> self-respawn path; raises on any
+        # child assertion/trace failure
+        assert g.trace_north_star_7b() is None
+    finally:
+        sys.path.remove(str(REPO))
