@@ -21,8 +21,25 @@ def test_north_star_7b_traces_on_64_device_mesh():
     try:
         import __graft_entry__ as g
 
-        # 8-device pytest process -> self-respawn path; raises on any
-        # child assertion/trace failure
-        assert g.trace_north_star_7b() is None
+        # usually the 8-device pytest process -> self-respawn path (None);
+        # a 64-device env runs in-process and returns the summary.  Either
+        # way assertion/trace failures raise.
+        r = g.trace_north_star_7b()
+        assert r is None or 6.0 < r["params_b"] < 8.0
+    finally:
+        sys.path.remove(str(REPO))
+
+
+def test_moe_flagship_traces_on_64_device_mesh():
+    """The expert-stack counterpart: ~3B MoE GPT under ZeRO(moe_dp) x
+    EP=4 x MoE-DP=4 x TP=2 x PP=2, sorted dispatch, flash remat — the
+    tiny-shape golden (test_zero.py::test_zero_moe_1f1b_full_stack)
+    type-checked at real scale."""
+    sys.path.insert(0, str(REPO))
+    try:
+        import __graft_entry__ as g
+
+        r = g.trace_moe_flagship()
+        assert r is None or 2.0 < r["params_b"] < 4.5
     finally:
         sys.path.remove(str(REPO))
