@@ -85,6 +85,43 @@ def test_gpt_moe_serial_remat_modes_match():
         )
 
 
+def test_gpt_moe_gqa_specs_match_params(devices8):
+    """GQA through the MoE family: the spec tree must mirror the GQA param
+    leaves (wq/wkv, not wqkv) or every tree.map/shard_map dies on structure
+    mismatch — and the EP-sharded model must run with kv_heads set."""
+    from torchdistpackage_tpu.models import (
+        GPTConfig, gpt_moe_loss, gpt_moe_param_specs, init_gpt_moe_params,
+    )
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, nheads=4, nlayers=2, max_seq=16, ffn_mult=2,
+        moe_experts=4, moe_top_k=2, moe_every=2, moe_capacity_factor=4.0,
+        attn_impl="flash", kv_heads=2,
+    )
+    params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+    specs = gpt_moe_param_specs(cfg, tp_axis=None, ep_axis="moe_ep")
+    # structure compatibility IS the test
+    jax.tree.map(lambda a, s: None, params, specs)
+
+    tpc.setup_process_groups([("data", 4)], devices=devices8[:4])
+    tpc.build_moe_mesh(moe_ep_size=4)
+    mesh = tpc.get_view("moe")
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    batch = {
+        "tokens": jax.random.randint(k1, (4, 16), 0, 64),
+        "targets": jax.random.randint(k2, (4, 16), 0, 64),
+    }
+    loss = jax.jit(shard_map(
+        lambda p, b: jax.lax.pmean(
+            gpt_moe_loss(p, b, cfg, ep_axis="moe_ep"), ("moe_dp", "moe_ep")),
+        mesh=mesh,
+        in_specs=(specs, {"tokens": P(("moe_dp", "moe_ep")),
+                          "targets": P(("moe_dp", "moe_ep"))}),
+        out_specs=P(),
+    ))(params, batch)
+    assert np.isfinite(float(loss))
+
+
 def test_sorted_dispatch_matches_dense():
     """The index-based (gather/scatter-add) dispatch must reproduce the
     dense [T,E,C] einsum path — same routing decision, same outputs and

@@ -63,6 +63,9 @@ class GPTConfig:
     context_axis: Optional[str] = None  # mesh axis for 'ring'/'ulysses'
     cp_layout: str = "contiguous"  # 'zigzag' balances causal ring FLOPs
     dropout_rate: float = 0.0  # residual dropout (needs a dropout_key)
+    # grouped-query attention: KV head count (None = MHA, 1 = MQA);
+    # see TransformerConfig.kv_heads
+    kv_heads: Optional[int] = None
     # Mixture-of-Experts (0 = dense model).  With ``moe_experts > 0`` every
     # ``moe_every``-th block's FFN becomes an expert layer (Switch-style
     # alternation); use the gpt_moe_* family (models/gpt_moe.py) which
@@ -107,11 +110,17 @@ class GPTConfig:
             context_axis=self.context_axis,
             cp_layout=self.cp_layout,
             dropout_rate=self.dropout_rate,
+            kv_heads=self.kv_heads,
         )
 
     def num_params(self) -> int:
         D, F, V, L = self.dim, self.dim * self.ffn_mult, self.vocab_size, self.nlayers
-        per_block = 3 * D * D + 3 * D + D * D + D + 2 * D * F + D + F + 4 * D
+        if self.kv_heads is not None and self.kv_heads != self.nheads:
+            Dkv = self.kv_heads * (D // self.nheads)
+            attn = (D * D + D) + (2 * D * Dkv + 2 * Dkv)  # wq/bq + wkv/bkv
+        else:
+            attn = 3 * D * D + 3 * D
+        per_block = attn + D * D + D + 2 * D * F + D + F + 4 * D
         return V * D + self.max_seq * D + L * per_block + 2 * D + D * V
 
 
@@ -581,7 +590,8 @@ def gpt_param_specs(
     per-block TP specs."""
     from ..parallel.tensor_parallel import stacked_block_specs
 
-    blocks = stacked_block_specs(tp_axis, stack_axis=pipe_axis)
+    blocks = stacked_block_specs(
+        tp_axis, stack_axis=pipe_axis, gqa=cfg.block.is_gqa)
     return {
         "tok_emb": P(tp_axis, None) if tp_axis else P(),
         "pos_emb": P(),

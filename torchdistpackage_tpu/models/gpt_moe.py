@@ -213,7 +213,7 @@ def moe_blocks_param_specs(
     (router replicated)."""
     blocks = []
     for i in range(cfg.nlayers):
-        bspec = block_param_specs(tp_axis)
+        bspec = block_param_specs(tp_axis, gqa=cfg.block.is_gqa)
         if is_moe_block(cfg, i):
             bspec = {
                 "ln1": bspec["ln1"],

@@ -106,7 +106,15 @@ def mha_reference(
     causal: bool = True,
     sm_scale: Optional[float] = None,
 ) -> jnp.ndarray:
-    """Plain softmax(QK^T)V golden — [B, H, S, D] layout."""
+    """Plain softmax(QK^T)V golden — [B, H, S, D] layout.  Grouped-query
+    attention: ``k``/``v`` may carry fewer heads (H_q % H_kv == 0); each
+    group of ``H_q // H_kv`` consecutive query heads attends to one shared
+    KV head."""
+    if k.shape[1] != q.shape[1]:
+        g, rem = divmod(q.shape[1], k.shape[1])
+        assert rem == 0, (q.shape, k.shape)
+        k = jnp.repeat(k, g, axis=1)
+        v = jnp.repeat(v, g, axis=1)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
@@ -187,7 +195,7 @@ def _fwd_kernel(
         lse_ref[0] = m + jnp.log(l)  # [Bq, 1]
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, groups=1):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     num_kv = Sk // block_k
@@ -195,13 +203,16 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, num_kv=num_kv
     )
+    # GQA: q is flattened [B*Hq, ...] b-major with the G q-heads of a group
+    # consecutive, kv is [B*Hkv, ...] — kv block for q-program b is b//G
+    # (an index_map, not a materialized repeat)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b // groups, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b // groups, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -320,7 +331,7 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, res, cts):
+def _bwd(sm_scale, causal, block_q, block_k, groups, res, cts):
     q, k, v, o, lse = res
     dout, dlse = cts
     BH, Sq, D = q.shape
@@ -343,8 +354,8 @@ def _bwd(sm_scale, causal, block_q, block_k, res, cts):
         grid=(BH, num_q, num_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b // groups, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b // groups, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -356,6 +367,11 @@ def _bwd(sm_scale, causal, block_q, block_k, res, cts):
         interpret=_interpret(),
     )(q, k, v, dout, lse, delta)
 
+    # GQA: the dkv kernel stays per-Q-HEAD (grid dim 0 = B*Hq, kv blocks
+    # read via b//G) — G programs writing one kv output block would race,
+    # so each q head writes its own partial [B*Hq, Sk, D] (f32 when G > 1)
+    # and the group-sum happens outside as a fused XLA reduction.
+    dkv_dtype = k.dtype if groups == 1 else jnp.float32
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, num_q=num_q
@@ -363,8 +379,8 @@ def _bwd(sm_scale, causal, block_q, block_k, res, cts):
         grid=(BH, num_kv, num_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b // groups, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b // groups, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
@@ -374,8 +390,8 @@ def _bwd(sm_scale, causal, block_q, block_k, res, cts):
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            _out_struct(k.shape, k.dtype, k),
-            _out_struct(v.shape, v.dtype, v),
+            _out_struct((BH, Sk, D), dkv_dtype, k),
+            _out_struct((BH, Sk, D), dkv_dtype, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -384,19 +400,23 @@ def _bwd(sm_scale, causal, block_q, block_k, res, cts):
         compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(q, k, v, dout, lse, delta)
+    if groups > 1:
+        BHkv = BH // groups
+        dk = dk.reshape(BHkv, groups, Sk, D).sum(axis=1).astype(k.dtype)
+        dv = dv.reshape(BHkv, groups, Sk, D).sum(axis=1).astype(v.dtype)
     return dq, dk, dv
 
 
 # ------------------------------------------------------------------ public op
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k):
-    return _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, groups=1):
+    return _fwd(q, k, v, sm_scale, causal, block_q, block_k, groups)
 
 
-def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, groups=1):
+    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, groups)
     # Name the kernel's residuals so rematerialization policies can elect to
     # save them: under jax.checkpoint with
     # save_only_these_names('flash_out', 'flash_lse') (scan_blocks
@@ -412,8 +432,8 @@ def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(sm_scale, causal, block_q, block_k, res, cts):
-    return _bwd(sm_scale, causal, block_q, block_k, res, cts)
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, groups, res, cts):
+    return _bwd(sm_scale, causal, block_q, block_k, groups, res, cts)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -421,7 +441,12 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def _prep(q, k, v, sm_scale, block_q, block_k):
     B, H, Sq, D = q.shape
+    Hkv = k.shape[1]
     Sk = k.shape[2]
+    groups, rem = divmod(H, Hkv)
+    if rem:
+        raise ValueError(
+            f"GQA needs q heads divisible by kv heads, got {H} vs {Hkv}")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
     if block_q is None or block_k is None:
@@ -433,9 +458,9 @@ def _prep(q, k, v, sm_scale, block_q, block_k):
     block_q = math.gcd(min(block_q, Sq), Sq)
     block_k = math.gcd(min(block_k, Sk), Sk)
     qf = q.reshape(B * H, Sq, D)
-    kf = k.reshape(B * H, Sk, D)
-    vf = v.reshape(B * H, Sk, D)
-    return qf, kf, vf, float(sm_scale), int(block_q), int(block_k)
+    kf = k.reshape(B * Hkv, Sk, D)
+    vf = v.reshape(B * Hkv, Sk, D)
+    return qf, kf, vf, float(sm_scale), int(block_q), int(block_k), int(groups)
 
 
 def flash_attention(
@@ -448,6 +473,14 @@ def flash_attention(
     block_k: Optional[int] = None,
 ) -> jnp.ndarray:
     """Blockwise (flash) attention.  [B, H, S, D] layout, differentiable.
+
+    **Grouped-query attention**: ``k``/``v`` may carry fewer heads than
+    ``q`` (``H_q % H_kv == 0`` — MQA is ``H_kv == 1``); each group of
+    ``H_q // H_kv`` consecutive query heads shares one KV head.  The kv
+    tiles are NEVER materialized per-group: the kernels' kv BlockSpecs
+    index ``b // G``, so a KV block is DMA'd once per group, and the
+    dk/dv group-sum is a fused XLA reduction outside the kernel.  Grads
+    return in the kv heads' own shape.
 
     Block sizes are clamped to the sequence lengths and shrunk (gcd) to exact
     divisors of S, so any shard length traces; power-of-two S keeps the
@@ -462,8 +495,9 @@ def flash_attention(
     head_dim 64.
     """
     B, H, Sq, D = q.shape
-    qf, kf, vf, sm_scale, block_q, block_k = _prep(q, k, v, sm_scale, block_q, block_k)
-    o, _ = _flash(qf, kf, vf, sm_scale, bool(causal), block_q, block_k)
+    qf, kf, vf, sm_scale, block_q, block_k, groups = _prep(
+        q, k, v, sm_scale, block_q, block_k)
+    o, _ = _flash(qf, kf, vf, sm_scale, bool(causal), block_q, block_k, groups)
     return o.reshape(B, H, Sq, D)
 
 
@@ -485,6 +519,7 @@ def flash_attention_with_lse(
     ``lse_total = logaddexp_i(lse_i)`` (ops/ring_attention.py).
     """
     B, H, Sq, D = q.shape
-    qf, kf, vf, sm_scale, block_q, block_k = _prep(q, k, v, sm_scale, block_q, block_k)
-    o, lse = _flash(qf, kf, vf, sm_scale, bool(causal), block_q, block_k)
+    qf, kf, vf, sm_scale, block_q, block_k, groups = _prep(
+        q, k, v, sm_scale, block_q, block_k)
+    o, lse = _flash(qf, kf, vf, sm_scale, bool(causal), block_q, block_k, groups)
     return o.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)

@@ -156,6 +156,17 @@ def ring_attention(
         raise ValueError(f"unknown ring layout {layout!r}")
     if axis is None:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    if not use_flash and k.shape[1] != q.shape[1]:
+        # the einsum online-softmax (golden/debug) path assumes equal head
+        # counts — materialize the GQA broadcast here; the flash paths
+        # serve shared KV blocks via the kernel's index maps instead
+        g, rem = divmod(q.shape[1], k.shape[1])
+        if rem:
+            raise ValueError(
+                f"GQA needs q heads divisible by kv heads "
+                f"({q.shape[1]} vs {k.shape[1]})")
+        k = jnp.repeat(k, g, axis=1)
+        v = jnp.repeat(v, g, axis=1)
     if layout == "zigzag":
         if not causal:
             # zigzag only rebalances the causal triangle; non-causal work is
@@ -391,17 +402,24 @@ def ulysses_attention(
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     n = jax.lax.axis_size(axis)
     B, H, S, D = q.shape
-    if H % n != 0:
-        raise ValueError(f"heads {H} not divisible by context-parallel size {n}")
 
     def scatter_heads(x):
-        # [B, H, S_loc, D] -> [B, n, H/n, S_loc, D] -> a2a (recv dim = source
-        # rank, inserted *before* seq so the global order is preserved)
-        x = x.reshape(B, n, H // n, S, D)
+        # [B, Hx, S_loc, D] -> [B, n, Hx/n, S_loc, D] -> a2a (recv dim =
+        # source rank, inserted *before* seq so the global order is
+        # preserved).  Reads the head count off each tensor: under GQA the
+        # kv tensors carry fewer heads, and BOTH counts must divide the
+        # ring so every shard keeps whole (q-group, kv-head) pairs.
+        Hx = x.shape[1]
+        if Hx % n != 0:
+            raise ValueError(
+                f"heads {Hx} not divisible by context-parallel size {n}"
+                + (" (GQA under Ulysses needs kv_heads % cp == 0)"
+                   if Hx != H else ""))
+        x = x.reshape(B, n, Hx // n, S, D)
         x = jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2)
-        return x.reshape(B, H // n, n * S, D)
+        return x.reshape(B, Hx // n, n * S, D)
 
-    def gather_heads(x):
+    def gather_heads(x):  # out is q-shaped
         x = x.reshape(B, H // n, n, S, D)
         x = jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1)
         return x.reshape(B, H, S, D)

@@ -66,11 +66,26 @@ class TransformerConfig:
     # when a dropout key is threaded into the forward — see ``dropout`` and
     # the per-axis key recipe in utils/random.py (axis_unique_key)
     dropout_rate: float = 0.0
+    # Grouped-query attention: number of KV heads (None = nheads, plain
+    # MHA; 1 = MQA).  nheads % kv_heads must be 0; under TP additionally
+    # kv_heads % tp_size (each shard owns whole KV heads).  The flash
+    # kernel serves the shared KV blocks via index maps — no repeat.
+    kv_heads: "int | None" = None
 
     @property
     def head_dim(self) -> int:
         assert self.dim % self.nheads == 0
         return self.dim // self.nheads
+
+    @property
+    def kv_head_count(self) -> int:
+        kv = self.nheads if self.kv_heads is None else self.kv_heads
+        assert self.nheads % kv == 0, (self.nheads, kv)
+        return kv
+
+    @property
+    def is_gqa(self) -> bool:
+        return self.kv_head_count != self.nheads
 
     @property
     def ffn_dim(self) -> int:
@@ -107,13 +122,32 @@ def attention_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: Transforme
     ``cfg.context_axis``.  p['wqkv']: [3, D, H_loc * hd]."""
     B, S, D = x.shape
     hd = cfg.head_dim
-    h_loc = p["wqkv"].shape[-1] // hd
-
-    qkv = jnp.einsum("bsd,tdh->tbsh", x, p["wqkv"]) + p["bqkv"][:, None, None, :]
-    q, k, v = qkv[0], qkv[1], qkv[2]
-    q = q.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)  # [B,h,S,hd]
-    k = k.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
-    v = v.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
+    if "wqkv" in p:
+        h_loc = p["wqkv"].shape[-1] // hd
+        qkv = jnp.einsum("bsd,tdh->tbsh", x, p["wqkv"]) + p["bqkv"][:, None, None, :]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        q = q.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)  # [B,h,S,hd]
+        k = k.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
+    else:
+        # GQA params (cfg.kv_heads < nheads): separate q and stacked kv
+        # projections — the attention op reads the head counts off the
+        # shapes and serves shared KV blocks without materializing repeats
+        h_loc = p["wq"].shape[-1] // hd
+        hkv_loc, rem = divmod(p["wkv"].shape[-1], hd)
+        if rem or hkv_loc == 0:
+            # e.g. MQA (kv_heads=1) under TP=2: the byte count divides so
+            # sharding succeeds, but the shard owns HALF a KV head — the
+            # reshape would quietly produce 0 heads and zero attention
+            raise ValueError(
+                f"TP shard holds {p['wkv'].shape[-1]} kv columns = "
+                f"{p['wkv'].shape[-1] / hd:g} heads of dim {hd}; GQA under "
+                f"TP needs kv_heads % tp_size == 0 (whole heads per shard)"
+            )
+        q = (x @ p["wq"] + p["bq"]).reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
+        kv = jnp.einsum("bsd,tdh->tbsh", x, p["wkv"]) + p["bkv"][:, None, None, :]
+        k = kv[0].reshape(B, S, hkv_loc, hd).transpose(0, 2, 1, 3)
+        v = kv[1].reshape(B, S, hkv_loc, hd).transpose(0, 2, 1, 3)
 
     if cfg.attn_impl == "flash":
         from ...ops.flash_attention import flash_attention
@@ -364,12 +398,13 @@ def scan_blocks(
 
 
 def stacked_block_specs(
-    tp_axis: Optional[str] = None, stack_axis: Optional[str] = None
+    tp_axis: Optional[str] = None, stack_axis: Optional[str] = None,
+    gqa: bool = False,
 ) -> Dict[str, PyTree]:
     """Per-block TP specs with a leading entry for the layer-stack dim —
     ``stack_axis`` shards the stack (pipeline stages), None replicates it.
     Shared by gpt_param_specs / vit_param_specs."""
-    bspecs = block_param_specs(tp_axis)
+    bspecs = block_param_specs(tp_axis, gqa=gqa)
     is_spec = lambda x: isinstance(x, P)
     return jax.tree.map(lambda s: P(stack_axis, *tuple(s)), bspecs, is_leaf=is_spec)
 
@@ -384,14 +419,27 @@ def init_block_params(key, cfg: TransformerConfig, mlp: bool = True) -> Dict[str
     D, F = cfg.dim, cfg.ffn_dim
     s = 1.0 / math.sqrt(D)
     dt = cfg.dtype
-    out = {
-        "ln1": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
-        "attn": {
+    if cfg.is_gqa:
+        Dkv = cfg.kv_head_count * cfg.head_dim
+        attn = {
+            "wq": (jax.random.normal(kq, (D, D)) * s).astype(dt),
+            "bq": jnp.zeros((D,), dt),
+            "wkv": (jax.random.normal(
+                jax.random.fold_in(kq, 1), (2, D, Dkv)) * s).astype(dt),
+            "bkv": jnp.zeros((2, Dkv), dt),
+            "wo": (jax.random.normal(ko, (D, D)) * s).astype(dt),
+            "bo": jnp.zeros((D,), dt),
+        }
+    else:
+        attn = {
             "wqkv": (jax.random.normal(kq, (3, D, D)) * s).astype(dt),
             "bqkv": jnp.zeros((3, D), dt),
             "wo": (jax.random.normal(ko, (D, D)) * s).astype(dt),
             "bo": jnp.zeros((D,), dt),
-        },
+        }
+    out = {
+        "ln1": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
+        "attn": attn,
         "ln2": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
     }
     if mlp:
@@ -415,18 +463,32 @@ def init_transformer_params(key, cfg: TransformerConfig) -> Dict[str, PyTree]:
 # ----------------------------------------------------------------------- specs
 
 
-def block_param_specs(axis: str = "tensor") -> Dict[str, PyTree]:
+def block_param_specs(axis: str = "tensor", gqa: bool = False) -> Dict[str, PyTree]:
     """PartitionSpec tree for one block under TP.  Column-parallel weights
     shard their output dim, row-parallel their input dim; LN and row biases
-    replicated (added post-reduction exactly once)."""
-    return {
-        "ln1": {"scale": P(), "bias": P()},
-        "attn": {
+    replicated (added post-reduction exactly once).  ``gqa`` selects the
+    grouped-query leaf set (separate wq / stacked wkv; requires
+    kv_heads % tp_size == 0 so shards own whole KV heads)."""
+    attn = (
+        {
+            "wq": P(None, axis),
+            "bq": P(axis),
+            "wkv": P(None, None, axis),
+            "bkv": P(None, axis),
+            "wo": P(axis, None),
+            "bo": P(),
+        }
+        if gqa
+        else {
             "wqkv": P(None, None, axis),  # heads contiguous on last dim
             "bqkv": P(None, axis),
             "wo": P(axis, None),
             "bo": P(),
-        },
+        }
+    )
+    return {
+        "ln1": {"scale": P(), "bias": P()},
+        "attn": attn,
         "ln2": {"scale": P(), "bias": P()},
         "mlp": {
             "w1": P(None, axis),
@@ -439,6 +501,9 @@ def block_param_specs(axis: str = "tensor") -> Dict[str, PyTree]:
 
 def transformer_param_specs(cfg: TransformerConfig, axis: str = "tensor") -> Dict[str, PyTree]:
     return {
-        "blocks": [block_param_specs(axis) for _ in range(cfg.nlayers)],
+        "blocks": [
+            block_param_specs(axis, gqa=cfg.is_gqa)
+            for _ in range(cfg.nlayers)
+        ],
         "ln_f": {"scale": P(), "bias": P()},
     }
