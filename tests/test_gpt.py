@@ -718,6 +718,103 @@ def test_gpt_gqa_tp_matches_serial(devices8, sp, kv_heads):
     )
 
 
+def test_apply_rope_matches_reference():
+    """Half-split rotary math vs a direct numpy construction, plus the
+    relative-position property softmax attention relies on: the rotated
+    q.k dot depends on positions only through their difference."""
+    from torchdistpackage_tpu.parallel.tensor_parallel import apply_rope
+
+    B, H, S, hd = 1, 1, 6, 8
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, H, S, hd))
+    pos = jnp.arange(S)
+    got = np.asarray(apply_rope(x, pos))
+
+    half = hd // 2
+    inv = 10000.0 ** (-np.arange(half) / half)
+    ang = np.arange(S)[:, None] * inv[None, :]
+    x1, x2 = np.asarray(x)[..., :half], np.asarray(x)[..., half:]
+    want = np.concatenate(
+        [x1 * np.cos(ang) - x2 * np.sin(ang),
+         x1 * np.sin(ang) + x2 * np.cos(ang)], axis=-1)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    # relative property: <R(p+c)q, R(k+c)k> == <R(p)q, R(k)k>
+    q = jax.random.normal(jax.random.PRNGKey(1), (B, H, 1, hd))
+    k = jax.random.normal(jax.random.PRNGKey(2), (B, H, 1, hd))
+    def dot(c):
+        qa = apply_rope(q, jnp.array([3 + c]))
+        ka = apply_rope(k, jnp.array([1 + c]))
+        return float(jnp.sum(qa * ka))
+    np.testing.assert_allclose(dot(0), dot(17), rtol=1e-5)
+
+
+def test_gpt_rope_tp_matches_serial(devices8):
+    """pos='rope' (no pos_emb table; q/k rotated inside attention) under
+    TP=2+SP must match the serial rope model in loss AND grads; the param
+    tree has no pos_emb leaf and num_params accounts for it."""
+    cfg = dataclasses.replace(CFG, attn_impl="flash", pos="rope")
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    assert "pos_emb" not in params
+    n_leaves = sum(x.size for x in jax.tree.leaves(params))
+    assert n_leaves == cfg.num_params(), (n_leaves, cfg.num_params())
+
+    tpc.setup_process_groups([("tensor", 2)], devices=devices8[:2])
+    mesh = tpc.get_view()
+    specs = gpt_param_specs(cfg, tp_axis="tensor")
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+    )
+    batch = _data(jax.random.PRNGKey(3))
+    sm = shard_map(
+        lambda p, b: gpt_loss(p, b, cfg, axis="tensor", sp=True),
+        mesh=mesh, in_specs=(specs, {"tokens": P(), "targets": P()}),
+        out_specs=P(),
+    )
+    got = jax.jit(sm)(sharded, batch)
+    want = gpt_loss(params, batch, cfg)
+    np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+
+    g_got = jax.jit(jax.grad(lambda p, b: sm(p, b)))(sharded, batch)
+    g_want = jax.grad(lambda p, b: gpt_loss(p, b, cfg))(params, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        g_got, g_want,
+    )
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+def test_gpt_rope_ring_cp_matches_serial(devices8, layout):
+    """RoPE under ring context parallelism: each shard rotates its chunk at
+    the chunk's GLOBAL positions (contiguous offset or zigzag rows) — the
+    distributed rope model must match the serial rope model exactly."""
+    from torchdistpackage_tpu.ops.ring_attention import zigzag_permute
+
+    cp = 4
+    cfg_cp = dataclasses.replace(
+        CFG, attn_impl="ring", context_axis="context", pos="rope",
+        cp_layout=layout)
+    cfg_serial = dataclasses.replace(CFG, attn_impl="flash", pos="rope")
+    rope_params = init_gpt_params(jax.random.PRNGKey(0), cfg_serial)
+    tpc.setup_process_groups([("context", cp)], devices=devices8[:cp])
+    mesh = tpc.get_view()
+    batch = _data(jax.random.PRNGKey(11))
+    dist_batch = (
+        jax.tree.map(lambda a: zigzag_permute(a, cp, seq_dim=-1), batch)
+        if layout == "zigzag" else batch
+    )
+
+    def cp_loss(p, b):
+        return jax.lax.pmean(gpt_loss(p, b, cfg_cp), "context")
+
+    bspec = {"tokens": P(None, "context"), "targets": P(None, "context")}
+    sm = shard_map(cp_loss, mesh=mesh, in_specs=(P(), bspec), out_specs=P())
+    got = jax.jit(sm)(rope_params, dist_batch)
+    want = gpt_loss(rope_params, batch, cfg_serial)
+    np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+
+
 def test_gpt_remat_grads_match():
     """Activation-checkpointed grads must equal un-checkpointed grads."""
     cfg = GPTConfig(vocab_size=64, dim=32, nheads=2, nlayers=3, max_seq=16,

@@ -66,6 +66,12 @@ class GPTConfig:
     # grouped-query attention: KV head count (None = MHA, 1 = MQA);
     # see TransformerConfig.kv_heads
     kv_heads: Optional[int] = None
+    # position encoding: 'learned' (table added at embed, the reference
+    # style) | 'rope' (rotary: q/k rotated at their global positions inside
+    # attention; no pos_emb table — see TransformerConfig.rope).  RoPE
+    # composes with CP (chunk-offset/zigzag positions) and GQA.
+    pos: str = "learned"
+    rope_theta: float = 10000.0
     # Mixture-of-Experts (0 = dense model).  With ``moe_experts > 0`` every
     # ``moe_every``-th block's FFN becomes an expert layer (Switch-style
     # alternation); use the gpt_moe_* family (models/gpt_moe.py) which
@@ -96,6 +102,8 @@ class GPTConfig:
                 f"cp_layout={self.cp_layout!r} applies to attn_impl='ring' "
                 f"only (got {self.attn_impl!r})"
             )
+        if self.pos not in ("learned", "rope"):
+            raise ValueError(f"pos must be 'learned' or 'rope', got {self.pos!r}")
 
     @property
     def block(self) -> TransformerConfig:
@@ -111,6 +119,8 @@ class GPTConfig:
             cp_layout=self.cp_layout,
             dropout_rate=self.dropout_rate,
             kv_heads=self.kv_heads,
+            rope=self.pos == "rope",
+            rope_theta=self.rope_theta,
         )
 
     def num_params(self) -> int:
@@ -121,7 +131,8 @@ class GPTConfig:
         else:
             attn = 3 * D * D + 3 * D
         per_block = attn + D * D + D + 2 * D * F + D + F + 4 * D
-        return V * D + self.max_seq * D + L * per_block + 2 * D + D * V
+        pos = self.max_seq * D if self.pos == "learned" else 0
+        return V * D + pos + L * per_block + 2 * D + D * V
 
 
 # ------------------------------------------------------------------ embedding
@@ -189,6 +200,8 @@ def gpt_embed(
     owned rows)."""
     S = tokens.shape[-1]
     h = vocab_parallel_embed(params["tok_emb"], tokens, axis)
+    if "pos_emb" not in params:  # rope: positions enter inside attention
+        return h
     if context_axis is None:
         return h + params["pos_emb"][:S]
     if cp_layout == "zigzag":
@@ -571,13 +584,15 @@ def init_gpt_params(key, cfg: GPTConfig) -> Dict[str, PyTree]:
     keys = jax.random.split(kb, cfg.nlayers)
     blocks = [init_block_params(k, cfg.block) for k in keys]
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *blocks)
-    return {
+    out = {
         "tok_emb": (jax.random.normal(ke, (V, D)) * 0.02).astype(dt),
-        "pos_emb": (jax.random.normal(kp, (S, D)) * 0.02).astype(dt),
         "blocks": stacked,
         "ln_f": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
         "head": (jax.random.normal(kh, (D, V)) * (1.0 / math.sqrt(D))).astype(dt),
     }
+    if cfg.pos == "learned":  # rope models carry no position table
+        out["pos_emb"] = (jax.random.normal(kp, (S, D)) * 0.02).astype(dt)
+    return out
 
 
 def gpt_param_specs(
@@ -592,10 +607,12 @@ def gpt_param_specs(
 
     blocks = stacked_block_specs(
         tp_axis, stack_axis=pipe_axis, gqa=cfg.block.is_gqa)
-    return {
+    out = {
         "tok_emb": P(tp_axis, None) if tp_axis else P(),
-        "pos_emb": P(),
         "blocks": blocks,
         "ln_f": {"scale": P(), "bias": P()},
         "head": P(None, tp_axis) if tp_axis else P(),
     }
+    if cfg.pos == "learned":
+        out["pos_emb"] = P()
+    return out

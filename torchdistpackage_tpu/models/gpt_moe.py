@@ -264,13 +264,15 @@ def init_gpt_moe_params(key, cfg: GPTConfig) -> Dict[str, PyTree]:
         else:
             bp = init_block_params(k, cfg.block)
         blocks.append(bp)
-    return {
+    out = {
         "tok_emb": (jax.random.normal(ke, (V, D)) * 0.02).astype(dt),
-        "pos_emb": (jax.random.normal(kp, (S, D)) * 0.02).astype(dt),
         "blocks": blocks,
         "ln_f": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
         "head": (jax.random.normal(kh, (D, V)) * (1.0 / math.sqrt(D))).astype(dt),
     }
+    if cfg.pos == "learned":  # rope models carry no position table
+        out["pos_emb"] = (jax.random.normal(kp, (S, D)) * 0.02).astype(dt)
+    return out
 
 
 # ------------------------------------------------------------------- pipeline
@@ -490,12 +492,14 @@ def gpt_moe_param_specs(
     """Per-block specs: dense blocks get the TP specs, MoE blocks the TP
     attention specs + EP-sharded expert stacks (router replicated) — the
     block list via the shared :func:`moe_blocks_param_specs`."""
-    return {
+    out = {
         "tok_emb": P(tp_axis, None) if tp_axis else P(),
-        "pos_emb": P(),
         "blocks": moe_blocks_param_specs(cfg, tp_axis, ep_axis),
         "ln_f": {"scale": P(), "bias": P()},
         "head": P(None, tp_axis) if tp_axis else P(),
     }
+    if cfg.pos == "learned":
+        out["pos_emb"] = P()
+    return out
 
 

@@ -71,6 +71,13 @@ class TransformerConfig:
     # kv_heads % tp_size (each shard owns whole KV heads).  The flash
     # kernel serves the shared KV blocks via index maps — no repeat.
     kv_heads: "int | None" = None
+    # Rotary position embeddings: rotate q/k by their GLOBAL token position
+    # inside attention (applied pre-kernel, so flash/ring/ulysses and GQA
+    # all compose; under CP each shard rotates its chunk at the chunk's
+    # global offsets — contiguous or zigzag).  The model family drops the
+    # learned pos_emb table when this is on.
+    rope: bool = False
+    rope_theta: float = 10000.0
 
     @property
     def head_dim(self) -> int:
@@ -110,7 +117,58 @@ def layer_norm(x: jnp.ndarray, p: Dict[str, jnp.ndarray], eps: float = 1e-5) -> 
     ).astype(x.dtype)
 
 
-def attention_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
+def rope_cache(
+    pos: jnp.ndarray, head_dim: int, theta: float = 10000.0
+):
+    """(cos, sin) tables [1, 1, S, hd/2] for :func:`apply_rope` — compute
+    once per forward (they are layer-invariant) and reuse across the block
+    stack; ``scan_blocks`` hoists them out of the scan body as closed-over
+    loop constants."""
+    assert head_dim % 2 == 0, f"rope needs an even head_dim, got {head_dim}"
+    half = head_dim // 2
+    inv_freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [S, half]
+    return jnp.cos(ang)[None, None], jnp.sin(ang)[None, None]
+
+
+def apply_rope(
+    x: jnp.ndarray, pos: jnp.ndarray = None, theta: float = 10000.0,
+    cache=None,
+) -> jnp.ndarray:
+    """Rotary embedding, half-split convention: x [B, H, S, hd] (hd even),
+    pos [S] global token positions.  Pairs (x_i, x_{i+hd/2}) rotate by
+    pos * theta^(-2i/hd); f32 trig, result in x's dtype.  Pass ``cache``
+    (from :func:`rope_cache`) to reuse precomputed tables."""
+    if cache is None:
+        cache = rope_cache(pos, x.shape[-1], theta)
+    cos, sin = cache
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+def _rope_positions(cfg: TransformerConfig, S: int) -> jnp.ndarray:
+    """Global positions of the S sequence rows attention sees: arange
+    serially and under SP (attention runs on the gathered full sequence);
+    the chunk's global offsets under CP (contiguous or zigzag)."""
+    if cfg.context_axis is None:
+        return jnp.arange(S)
+    idx = jax.lax.axis_index(cfg.context_axis)
+    if cfg.cp_layout == "zigzag":
+        from ...ops.ring_attention import zigzag_positions
+
+        pos, _ = zigzag_positions(idx, S, jax.lax.axis_size(cfg.context_axis))
+        return pos
+    return idx * S + jnp.arange(S)
+
+
+def attention_partial(
+    p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: TransformerConfig,
+    rope: "tuple | None" = None,
+) -> jnp.ndarray:
     """Core attention on the *local* heads; returns the (partial) output
     projection WITHOUT the TP reduction or output bias — the caller closes the
     row-parallel region.  Mirrors ``TpAttention`` (attn.py:53-91) where each
@@ -148,6 +206,14 @@ def attention_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: Transforme
         kv = jnp.einsum("bsd,tdh->tbsh", x, p["wkv"]) + p["bkv"][:, None, None, :]
         k = kv[0].reshape(B, S, hkv_loc, hd).transpose(0, 2, 1, 3)
         v = kv[1].reshape(B, S, hkv_loc, hd).transpose(0, 2, 1, 3)
+
+    if cfg.rope:
+        # ``rope`` is the precomputed (cos, sin) cache (layer-invariant —
+        # scan_blocks hoists it); self-compute when called standalone
+        cache = rope if rope is not None else rope_cache(
+            _rope_positions(cfg, S), hd, cfg.rope_theta)
+        q = apply_rope(q, cache=cache)
+        k = apply_rope(k, cache=cache)
 
     if cfg.attn_impl == "flash":
         from ...ops.flash_attention import flash_attention
@@ -262,6 +328,7 @@ def block_forward(
     axis: Optional[str] = None,
     sp: bool = False,
     dropout_key: Optional[jax.Array] = None,
+    rope: "tuple | None" = None,
 ) -> jnp.ndarray:
     """Pre-LN transformer block (``ParallelBlock``, transformer.py:48-72):
     LN kept replicated and applied on the sequence shard; SP enters/leaves at
@@ -274,7 +341,7 @@ def block_forward(
         k_attn, k_mlp = jax.random.split(dropout_key)
     h = layer_norm(x, p["ln1"])
     full = gather_from_sp(h, axis) if (axis and sp) else h
-    y = attention_partial(p["attn"], full, cfg)
+    y = attention_partial(p["attn"], full, cfg, rope=rope)
     y = _close_row_parallel(y, p["attn"]["bo"], axis, sp)
     x = x + dropout(y, cfg.dropout_rate, k_attn)
 
@@ -361,13 +428,26 @@ def scan_blocks(
         want = want | _vma(layer_mask)
     x = _mark_varying(x, tuple(want))  # idempotent: only missing axes added
 
+    rope = None
+    if cfg.rope:
+        # layer-invariant (cos, sin): computed ONCE here and closed over by
+        # the scan body (a loop constant), instead of re-deriving the trig
+        # inside every layer iteration.  Attention sees the SP-gathered
+        # full sequence, so the table length is S_local * tp under SP.
+        S_attn = x.shape[1]
+        if axis is not None and sp:
+            S_attn = S_attn * jax.lax.axis_size(axis)
+        rope = rope_cache(
+            _rope_positions(cfg, S_attn), cfg.head_dim, cfg.rope_theta)
+
     def blk(lp, h, i):
         k = (
             jax.random.fold_in(dropout_key, i)
             if dropout_key is not None
             else None
         )
-        return block_forward(lp, h, cfg, axis=axis, sp=sp, dropout_key=k)
+        return block_forward(
+            lp, h, cfg, axis=axis, sp=sp, dropout_key=k, rope=rope)
 
     if remat:
         blk = checkpoint_block(blk, remat, prevent_cse=False)
