@@ -90,9 +90,11 @@ BIG_CANDIDATES = [
 # Long-context candidates (--long): the 125M model at seq 8192 — the
 # single-chip long-S story (CP spreads S across chips; this measures the
 # per-chip leaf: flash tiles at long S + remat='flash' + streamed CE).
-# (1024, 1024) tiles measured fastest through S=4096 on v5e
-# (docs/FLASH_TUNE_v5e.json); the S=8192 tile sweep itself is queued —
-# until it lands these candidates ride the S=4096-validated choice.
+# (1024, 1024) tiles measured fastest at EVERY v5e shape including S=8192
+# (docs/FLASH_TUNE_v5e.json, 4 reports).  Measured 2026-07-31: b2 flash
+# 54,868 tok/s (MFU 0.437) beats b4 52,208 and b2 flash_offload 45,704
+# (the offload is a memory lever; it costs host-DMA bandwidth when the
+# shape fits in HBM — docs/BENCH_AB.md session 5).
 LONG_CANDIDATES = [
     (2, "flash", 512),
     (4, "flash", 512),

@@ -1,0 +1,236 @@
+"""Llama-family golden tests: RMSNorm + SwiGLU (+ RoPE/GQA) through the same
+parallel paths as the GPT family — serial vs TP(+SP), the 1F1B pipeline, and
+the Mixtral-style SwiGLU expert layer under EP.  The reference has no Llama
+models; this family exists because norm/act are framework levers
+(tensor_parallel/layers.py structural dispatch), so the goldens here prove
+the levers, not new parallel machinery."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchdistpackage_tpu.dist import tpc
+from torchdistpackage_tpu.models import (
+    gpt_loss,
+    gpt_param_specs,
+    gpt_pipeline_1f1b,
+    init_gpt_params,
+    llama_config,
+)
+from torchdistpackage_tpu.parallel.tensor_parallel import (
+    mlp_partial,
+    layer_norm,
+    rms_norm,
+)
+
+# tiny Llama: RMSNorm + SwiGLU + RoPE + GQA (4 q heads, 2 kv heads)
+CFG = llama_config(
+    vocab_size=64, dim=32, nheads=4, nlayers=4, max_seq=16,
+    kv_heads=2, ffn_hidden=48, dtype=jnp.float32,
+)
+B, S = 4, 16
+
+
+def _data(key):
+    k1, k2 = jax.random.split(key)
+    return {
+        "tokens": jax.random.randint(k1, (B, S), 0, CFG.vocab_size),
+        "targets": jax.random.randint(k2, (B, S), 0, CFG.vocab_size),
+    }
+
+
+def test_llama_config_shape():
+    assert CFG.norm == "rms" and CFG.act == "swiglu" and CFG.pos == "rope"
+    # default FFN width: ceil(8d/3) rounded up to a multiple of 256
+    c = llama_config(vocab_size=64, dim=96, nheads=4, nlayers=2, max_seq=16)
+    assert c.block.ffn_dim == 256
+
+
+def test_rms_norm_formula():
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 8))
+    p = {"scale": jnp.arange(1.0, 9.0)}
+    got = rms_norm(x, p)
+    want = x / np.sqrt(np.mean(np.square(x), axis=-1, keepdims=True) + 1e-5) * p["scale"]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+    # structural dispatch: biasless params route layer_norm -> rms_norm
+    np.testing.assert_array_equal(np.asarray(layer_norm(x, p)), np.asarray(got))
+
+
+def test_swiglu_mlp_formula():
+    D, F = 8, 12
+    k1, k2, kx = jax.random.split(jax.random.PRNGKey(1), 3)
+    p = {
+        "w1": jax.random.normal(k1, (2, D, F)),
+        "b1": jax.random.normal(jax.random.PRNGKey(2), (2, F)),
+        "w2": jax.random.normal(k2, (F, D)),
+        "b2": jnp.zeros((D,)),
+    }
+    x = jax.random.normal(kx, (2, 5, D))
+    got = mlp_partial(p, x)
+    gate = x @ p["w1"][0] + p["b1"][0]
+    up = x @ p["w1"][1] + p["b1"][1]
+    want = (jax.nn.silu(gate) * up) @ p["w2"]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+
+def test_llama_num_params_matches_leaves():
+    params = init_gpt_params(jax.random.PRNGKey(0), CFG)
+    actual = sum(leaf.size for leaf in jax.tree.leaves(params))
+    assert actual == CFG.num_params(), (actual, CFG.num_params())
+    assert "pos_emb" not in params  # rope carries no position table
+    assert "bias" not in params["ln_f"]  # rms
+    assert params["blocks"]["mlp"]["w1"].shape == (CFG.nlayers, 2, CFG.dim, 48)
+
+
+def test_llama_serial_loss_finite():
+    params = init_gpt_params(jax.random.PRNGKey(0), CFG)
+    loss = jax.jit(lambda p, b: gpt_loss(p, b, CFG))(params, _data(jax.random.PRNGKey(1)))
+    assert np.isfinite(float(loss))
+
+
+@pytest.mark.parametrize("sp", [False, True])
+def test_llama_tp_matches_serial(devices8, sp):
+    params = init_gpt_params(jax.random.PRNGKey(0), CFG)
+    tp = 2  # kv_heads=2 bounds tp (whole KV heads per shard)
+    tpc.setup_process_groups([("tensor", tp)], devices=devices8[:tp])
+    mesh = tpc.get_view()
+    specs = gpt_param_specs(CFG, tp_axis="tensor")
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+    )
+    batch = _data(jax.random.PRNGKey(1))
+
+    def tp_loss(p, b):
+        return gpt_loss(p, b, CFG, axis="tensor", sp=sp)
+
+    got = jax.jit(
+        shard_map(tp_loss, mesh=mesh, in_specs=(specs, P()), out_specs=P())
+    )(sharded, batch)
+    want = gpt_loss(params, batch, CFG)
+    np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+
+    g_got = jax.jit(
+        jax.grad(
+            lambda p, b: shard_map(
+                tp_loss, mesh=mesh, in_specs=(specs, P()), out_specs=P()
+            )(p, b)
+        )
+    )(sharded, batch)
+    g_want = jax.grad(lambda p: gpt_loss(p, batch, CFG))(params)
+    for (path, gw), (_, gg) in zip(
+        jax.tree_util.tree_flatten_with_path(g_want)[0],
+        jax.tree_util.tree_flatten_with_path(g_got)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gw), rtol=5e-4, atol=1e-5,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}",
+        )
+
+
+def test_llama_pipeline_1f1b_matches_serial(devices8):
+    """PP=2 x TP=2 1F1B (sharded transfers auto-on for non-SP TP) on the
+    Llama block stack vs the serial microbatched loss."""
+    M, mbs = 4, 2
+    params = init_gpt_params(jax.random.PRNGKey(0), CFG)
+    tpc.setup_process_groups([("pipe", 2), ("tensor", 2)], devices=devices8[:4])
+    mesh = tpc.get_view()
+    specs = gpt_param_specs(CFG, tp_axis="tensor", pipe_axis="pipe")
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+    )
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    batch = {
+        "tokens": jax.random.randint(k1, (M, mbs, S), 0, CFG.vocab_size),
+        "targets": jax.random.randint(k2, (M, mbs, S), 0, CFG.vocab_size),
+    }
+
+    def pp_step(p, b):
+        loss, grads = gpt_pipeline_1f1b(
+            p, b, CFG, num_microbatches=M, tp_axis="tensor", pipe_axis="pipe"
+        )
+        return loss, grads
+
+    loss, grads = jax.jit(
+        shard_map(
+            pp_step, mesh=mesh, in_specs=(specs, P()),
+            out_specs=(P(), specs),
+        )
+    )(sharded, batch)
+
+    def serial_loss(p):
+        losses = [
+            gpt_loss(p, {"tokens": batch["tokens"][m], "targets": batch["targets"][m]}, CFG)
+            for m in range(M)
+        ]
+        return jnp.mean(jnp.stack(losses))
+
+    want_loss, want_grads = jax.value_and_grad(serial_loss)(params)
+    np.testing.assert_allclose(float(loss), float(want_loss), rtol=2e-5)
+    for (path, gw), (_, gg) in zip(
+        jax.tree_util.tree_flatten_with_path(want_grads)[0],
+        jax.tree_util.tree_flatten_with_path(grads)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gw), rtol=5e-4, atol=1e-5,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}",
+        )
+
+
+def test_mixtral_style_moe_ep_matches_serial(devices8):
+    """SwiGLU experts (Mixtral recipe: llama blocks + MoE FFN) under EP=4
+    must match the serial model — routing/dispatch are act-agnostic, the
+    expert einsum is the only changed code path."""
+    from torchdistpackage_tpu.models import (
+        gpt_moe_loss,
+        gpt_moe_param_specs,
+        init_gpt_moe_params,
+    )
+
+    cfg = llama_config(
+        vocab_size=64, dim=32, nheads=4, nlayers=4, max_seq=16,
+        ffn_hidden=48, dtype=jnp.float32,
+        moe_experts=4, moe_top_k=2, moe_every=2,
+        # no-drop capacity: with drops, per-shard routing under EP and
+        # whole-batch serial routing legitimately drop different tokens;
+        # aux off: the load-balance estimator is batch-nonlinear, so
+        # shard-mean aux != whole-batch aux (same choice as test_moe.py's
+        # composition golden; aux training is covered by
+        # test_gpt_moe_aux_trains)
+        moe_capacity_factor=4.0,
+        moe_aux_weight=0.0,
+    )
+    params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+    # structural check: expert leaves carry the stacked gate/up dim
+    moe_block = params["blocks"][1]["moe"]
+    assert moe_block["experts"]["w1"].shape == (4, 2, cfg.dim, 48)
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    batch = {  # batch dim divisible by the 8-way (moe_dp, moe_ep) sharding
+        "tokens": jax.random.randint(k1, (8, S), 0, cfg.vocab_size),
+        "targets": jax.random.randint(k2, (8, S), 0, cfg.vocab_size),
+    }
+    want = gpt_moe_loss(params, batch, cfg)
+
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    tpc.build_moe_mesh(moe_ep_size=4)
+    mesh = tpc.get_view("moe")
+    specs = gpt_moe_param_specs(cfg, ep_axis="moe_ep")
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs
+    )
+    bspec = {"tokens": P(("moe_dp", "moe_ep")), "targets": P(("moe_dp", "moe_ep"))}
+    b_sh = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), batch, bspec
+    )
+
+    def ep_loss(p, b):
+        loss = gpt_moe_loss(p, b, cfg, ep_axis="moe_ep")
+        return jax.lax.pmean(loss, ("moe_dp", "moe_ep"))
+
+    got = jax.jit(
+        shard_map(ep_loss, mesh=mesh, in_specs=(specs, bspec), out_specs=P())
+    )(sharded, b_sh)
+    np.testing.assert_allclose(float(got), float(want), rtol=2e-5)

@@ -9,6 +9,7 @@ from .gpt import (
     gpt_pipeline_loss,
     init_gpt_params,
     interleave_stage_params,
+    llama_config,
     vocab_parallel_embed,
     vocab_parallel_xent,
 )

@@ -40,7 +40,9 @@ from ..parallel.tensor_parallel import (
     scan_blocks,
     gather_from_sp,
     init_block_params,
+    init_norm_params,
     layer_norm,
+    norm_param_specs,
     split_to_sp,
 )
 
@@ -72,6 +74,16 @@ class GPTConfig:
     # composes with CP (chunk-offset/zigzag positions) and GQA.
     pos: str = "learned"
     rope_theta: float = 10000.0
+    # 'layer' | 'rms' and 'gelu' | 'swiglu' — the Llama family is
+    # norm='rms', act='swiglu', pos='rope' (see :func:`llama_config`);
+    # both are carried structurally by the param tree
+    # (TransformerConfig.norm/act), so every parallel path (TP/SP/PP/CP,
+    # ZeRO, checkpointing) serves both families unchanged.
+    norm: str = "layer"
+    act: str = "gelu"
+    # explicit FFN hidden width (overrides ffn_mult) — Llama-style ~8d/3
+    # widths are not integer multiples of d
+    ffn_hidden: Optional[int] = None
     # Mixture-of-Experts (0 = dense model).  With ``moe_experts > 0`` every
     # ``moe_every``-th block's FFN becomes an expert layer (Switch-style
     # alternation); use the gpt_moe_* family (models/gpt_moe.py) which
@@ -121,18 +133,67 @@ class GPTConfig:
             kv_heads=self.kv_heads,
             rope=self.pos == "rope",
             rope_theta=self.rope_theta,
+            norm=self.norm,
+            act=self.act,
+            ffn_hidden=self.ffn_hidden,
         )
 
     def num_params(self) -> int:
-        D, F, V, L = self.dim, self.dim * self.ffn_mult, self.vocab_size, self.nlayers
+        D, V, L = self.dim, self.vocab_size, self.nlayers
+        F = self.block.ffn_dim
         if self.kv_heads is not None and self.kv_heads != self.nheads:
             Dkv = self.kv_heads * (D // self.nheads)
             attn = (D * D + D) + (2 * D * Dkv + 2 * Dkv)  # wq/bq + wkv/bkv
         else:
             attn = 3 * D * D + 3 * D
-        per_block = attn + D * D + D + 2 * D * F + D + F + 4 * D
+        # swiglu stacks gate/up: one extra [D, F] + [F] vs the gelu MLP
+        mlp = (3 * D * F + 2 * F + D) if self.act == "swiglu" else (2 * D * F + F + D)
+        norm = D if self.norm == "rms" else 2 * D  # per norm site
+        per_block = attn + D * D + D + mlp + 2 * norm
         pos = self.max_seq * D if self.pos == "learned" else 0
-        return V * D + pos + L * per_block + 2 * D + D * V
+        return V * D + pos + L * per_block + norm + D * V
+
+
+def llama_config(
+    vocab_size: int,
+    dim: int,
+    nheads: int,
+    nlayers: int,
+    max_seq: int,
+    kv_heads: Optional[int] = None,
+    ffn_hidden: Optional[int] = None,
+    rope_theta: float = 10000.0,
+    dtype: Any = jnp.bfloat16,
+    **kw,
+) -> GPTConfig:
+    """Llama-family preset: RMSNorm + SwiGLU + RoPE (+ GQA when ``kv_heads``
+    is set) — the modern decoder recipe, composed entirely from existing
+    framework levers, so every parallel path (TP/SP, PP incl. interleaved,
+    CP ring/ulysses/zigzag, ZeRO/FSDP, remat incl. 'flash') serves it
+    unchanged.  ``ffn_hidden`` defaults to the Llama width ceil(8d/3)
+    rounded up to a multiple of 256 (TP- and MXU-friendly).
+
+    One deliberate divergence: the framework keeps its (zero-initialized)
+    bias leaves in attention/MLP where Llama is bias-free — structurally
+    uniform with the GPT family, numerically inert at init."""
+    if ffn_hidden is None:
+        ffn_hidden = -(-8 * dim // 3)  # ceil
+        ffn_hidden = -(-ffn_hidden // 256) * 256
+    return GPTConfig(
+        vocab_size=vocab_size,
+        dim=dim,
+        nheads=nheads,
+        nlayers=nlayers,
+        max_seq=max_seq,
+        kv_heads=kv_heads,
+        ffn_hidden=ffn_hidden,
+        pos="rope",
+        rope_theta=rope_theta,
+        norm="rms",
+        act="swiglu",
+        dtype=dtype,
+        **kw,
+    )
 
 
 # ------------------------------------------------------------------ embedding
@@ -587,7 +648,7 @@ def init_gpt_params(key, cfg: GPTConfig) -> Dict[str, PyTree]:
     out = {
         "tok_emb": (jax.random.normal(ke, (V, D)) * 0.02).astype(dt),
         "blocks": stacked,
-        "ln_f": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
+        "ln_f": init_norm_params(D, dt, cfg.norm),
         "head": (jax.random.normal(kh, (D, V)) * (1.0 / math.sqrt(D))).astype(dt),
     }
     if cfg.pos == "learned":  # rope models carry no position table
@@ -606,11 +667,12 @@ def gpt_param_specs(
     from ..parallel.tensor_parallel import stacked_block_specs
 
     blocks = stacked_block_specs(
-        tp_axis, stack_axis=pipe_axis, gqa=cfg.block.is_gqa)
+        tp_axis, stack_axis=pipe_axis, gqa=cfg.block.is_gqa,
+        norm=cfg.norm, act=cfg.act)
     out = {
         "tok_emb": P(tp_axis, None) if tp_axis else P(),
         "blocks": blocks,
-        "ln_f": {"scale": P(), "bias": P()},
+        "ln_f": norm_param_specs(cfg.norm),
         "head": P(None, tp_axis) if tp_axis else P(),
     }
     if cfg.pos == "learned":

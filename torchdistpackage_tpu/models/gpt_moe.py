@@ -48,9 +48,12 @@ from ..parallel.tensor_parallel.layers import (
     attention_partial,
     block_forward,
     block_param_specs,
+    block_rope_cache,
     dropout,
     init_block_params,
+    init_norm_params,
     layer_norm,
+    norm_param_specs,
 )
 from ..parallel.tensor_parallel.tp_utils import gather_from_sp, split_to_sp
 from .gpt import (
@@ -64,10 +67,11 @@ PyTree = Any
 
 
 def moe_layer_config(cfg: GPTConfig) -> MoEConfig:
-    """The MoEConfig for cfg's expert layers (ffn width = the dense FFN's)."""
+    """The MoEConfig for cfg's expert layers (ffn width and activation = the
+    dense FFN's — act='swiglu' makes the Mixtral-style expert)."""
     return MoEConfig(
         dim=cfg.dim,
-        ffn_dim=cfg.dim * cfg.ffn_mult,
+        ffn_dim=cfg.block.ffn_dim,
         num_experts=cfg.moe_experts,
         top_k=cfg.moe_top_k,
         capacity_factor=cfg.moe_capacity_factor,
@@ -75,6 +79,7 @@ def moe_layer_config(cfg: GPTConfig) -> MoEConfig:
         dtype=cfg.dtype,
         router=cfg.moe_router,
         dispatch=cfg.moe_dispatch,
+        act=cfg.act,
     )
 
 
@@ -95,6 +100,7 @@ def moe_block_forward(
     sp: bool = False,
     ep_axis: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
+    rope: "tuple | None" = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Pre-LN block whose FFN is the MoE layer.  Attention half is identical
     to ``block_forward``; the MoE half runs on the gathered (full-seq) tokens
@@ -110,7 +116,7 @@ def moe_block_forward(
 
     h = layer_norm(x, p["ln1"])
     full = gather_from_sp(h, axis) if (axis and sp) else h
-    y = attention_partial(p["attn"], full, bcfg)
+    y = attention_partial(p["attn"], full, bcfg, rope=rope)
     y = _close_row_parallel(y, p["attn"]["bo"], axis, sp)
     x = x + dropout(y, bcfg.dropout_rate, k_attn)
 
@@ -157,16 +163,21 @@ def gpt_moe_forward(
 def _moe_bodies(cfg, axis, sp, ep_axis, remat):
     """(moe_body, dense_body) with the remat mode applied — the one place
     the per-block checkpoint wiring exists, shared by the serial stack and
-    the pipeline stage loop so the two paths cannot diverge."""
+    the pipeline stage loop so the two paths cannot diverge.  Both bodies
+    take the hoisted rope cache as their 4th arg (compute it once per
+    forward with ``block_rope_cache``; None when rope is off) — re-deriving
+    the trig per layer (and again per remat backward) is the waste
+    ``scan_blocks`` already avoids for the dense stack."""
     moe_body = checkpoint_block(
-        lambda bp, h, k: moe_block_forward(
+        lambda bp, h, k, rope: moe_block_forward(
             bp, h, cfg, axis=axis, sp=sp, ep_axis=ep_axis, dropout_key=k,
+            rope=rope,
         ),
         remat,
     )
     dense_body = checkpoint_block(
-        lambda bp, h, k: block_forward(
-            bp, h, cfg.block, axis=axis, sp=sp, dropout_key=k),
+        lambda bp, h, k, rope: block_forward(
+            bp, h, cfg.block, axis=axis, sp=sp, dropout_key=k, rope=rope),
         remat,
     )
     return moe_body, dense_body
@@ -188,6 +199,7 @@ def moe_block_stack(
     normalization live HERE once.  ``cfg`` is duck-typed (needs ``.block``,
     ``.nlayers`` and the ``moe_*`` fields)."""
     moe_body, dense_body = _moe_bodies(cfg, axis, sp, ep_axis, remat)
+    rope = block_rope_cache(cfg.block, h.shape[1], axis, sp)
     aux_total = jnp.zeros((), jnp.float32)
     n_moe = 0
     for i, bp in enumerate(blocks):
@@ -197,11 +209,11 @@ def moe_block_stack(
             else None
         )
         if is_moe_block(cfg, i):
-            h, aux = moe_body(bp, h, k)
+            h, aux = moe_body(bp, h, k, rope)
             aux_total = aux_total + aux
             n_moe += 1
         else:
-            h = dense_body(bp, h, k)
+            h = dense_body(bp, h, k, rope)
     return h, aux_total / max(n_moe, 1)
 
 
@@ -213,13 +225,14 @@ def moe_blocks_param_specs(
     (router replicated)."""
     blocks = []
     for i in range(cfg.nlayers):
-        bspec = block_param_specs(tp_axis, gqa=cfg.block.is_gqa)
+        bspec = block_param_specs(
+            tp_axis, gqa=cfg.block.is_gqa, norm=cfg.norm, act=cfg.act)
         if is_moe_block(cfg, i):
             bspec = {
                 "ln1": bspec["ln1"],
                 "attn": bspec["attn"],
                 "ln2": bspec["ln2"],
-                "moe": moe_param_specs(ep_axis),
+                "moe": moe_param_specs(ep_axis, act=cfg.act),
             }
         blocks.append(bspec)
     return blocks
@@ -267,7 +280,7 @@ def init_gpt_moe_params(key, cfg: GPTConfig) -> Dict[str, PyTree]:
     out = {
         "tok_emb": (jax.random.normal(ke, (V, D)) * 0.02).astype(dt),
         "blocks": blocks,
-        "ln_f": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
+        "ln_f": init_norm_params(D, dt, cfg.norm),
         "head": (jax.random.normal(kh, (D, V)) * (1.0 / math.sqrt(D))).astype(dt),
     }
     if cfg.pos == "learned":  # rope models carry no position table
@@ -405,6 +418,7 @@ def gpt_moe_pipeline_1f1b(
     def run_blocks(p, x, m, select, v=None):
         """One slab's block loop; ``select`` maps a stacked leaf to the
         slab-local array (closes over the chunk index when interleaved)."""
+        rope = block_rope_cache(cfg.block, x.shape[1], tp_axis, sp)
         aux_total = jnp.zeros((), jnp.float32)
         for i, stacked in enumerate(p["blocks"]):
             bp = jax.tree.map(select, stacked)
@@ -416,10 +430,10 @@ def gpt_moe_pipeline_1f1b(
                 if v is not None:  # distinct masks per chunk slab
                     k = jax.random.fold_in(k, v)
             if pattern[i]:
-                x, aux = moe_body(bp, x, k)
+                x, aux = moe_body(bp, x, k, rope)
                 aux_total = aux_total + aux
             else:
-                x = dense_body(bp, x, k)
+                x = dense_body(bp, x, k, rope)
         return x, aux_scale * aux_total
 
     if num_chunks == 1:
@@ -495,7 +509,7 @@ def gpt_moe_param_specs(
     out = {
         "tok_emb": P(tp_axis, None) if tp_axis else P(),
         "blocks": moe_blocks_param_specs(cfg, tp_axis, ep_axis),
-        "ln_f": {"scale": P(), "bias": P()},
+        "ln_f": norm_param_specs(cfg.norm),
         "head": P(None, tp_axis) if tp_axis else P(),
     }
     if cfg.pos == "learned":

@@ -26,7 +26,9 @@ from ..parallel.tensor_parallel import (
     TransformerConfig,
     block_forward,
     init_block_params,
+    init_norm_params,
     layer_norm,
+    norm_param_specs,
     stacked_block_specs,
 )
 
@@ -60,6 +62,10 @@ class ViTConfig:
     moe_aux_weight: float = 1e-2
     moe_router: str = "topk"  # 'topk' | 'expert_choice' (encoder: both ok)
     moe_dispatch: str = "auto"  # 'dense' | 'sorted' | 'auto' (see MoEConfig)
+    # 'layer' | 'rms' and 'gelu' | 'swiglu' — same structural dispatch as
+    # the GPT family (tensor_parallel/layers.py)
+    norm: str = "layer"
+    act: str = "gelu"
 
     def __post_init__(self):
         if self.context_axis is not None and self.attn_impl not in ("ring", "ulysses"):
@@ -83,7 +89,7 @@ class ViTConfig:
             dim=self.dim, nheads=self.nheads, nlayers=self.nlayers,
             ffn_mult=self.ffn_mult, causal=False, dtype=self.dtype,
             attn_impl=self.attn_impl, context_axis=self.context_axis,
-            dropout_rate=self.dropout_rate,
+            dropout_rate=self.dropout_rate, norm=self.norm, act=self.act,
         )
 
 
@@ -111,7 +117,7 @@ def init_vit_params(key, cfg: ViTConfig) -> Dict[str, PyTree]:
         },
         "pos_emb": (jax.random.normal(kpos, (cfg.num_patches, cfg.dim)) * 0.02).astype(dt),
         "blocks": stacked,
-        "ln_f": {"scale": jnp.ones((cfg.dim,), dt), "bias": jnp.zeros((cfg.dim,), dt)},
+        "ln_f": init_norm_params(cfg.dim, dt, cfg.norm),
         "head": {
             "w": (jax.random.normal(kh, (cfg.dim, cfg.num_classes))
                   / math.sqrt(cfg.dim)).astype(dt),
@@ -227,14 +233,15 @@ def vit_param_specs(
     pipelining, None replicates it); class-sharded head when the class count
     divides the TP size (else keep the head replicated by passing specs with
     ``head`` overridden to P())."""
-    blocks = stacked_block_specs(tp_axis, stack_axis=pipe_axis)
+    blocks = stacked_block_specs(
+        tp_axis, stack_axis=pipe_axis, norm=cfg.norm, act=cfg.act)
     head_w = P(None, tp_axis) if tp_axis else P()
     head_b = P(tp_axis) if tp_axis else P()
     return {
         "patch_proj": {"w": P(), "b": P()},
         "pos_emb": P(),
         "blocks": blocks,
-        "ln_f": {"scale": P(), "bias": P()},
+        "ln_f": norm_param_specs(cfg.norm),
         "head": {"w": head_w, "b": head_b},
     }
 

@@ -24,7 +24,11 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.moe import init_moe_params
-from ..parallel.tensor_parallel import RematMode, init_block_params
+from ..parallel.tensor_parallel import (
+    RematMode,
+    init_block_params,
+    init_norm_params,
+)
 from .gpt_moe import (
     is_moe_block,
     moe_block_stack,
@@ -61,7 +65,7 @@ def init_vit_moe_params(key, cfg: ViTConfig) -> Dict[str, PyTree]:
         },
         "pos_emb": (jax.random.normal(kpos, (cfg.num_patches, cfg.dim)) * 0.02).astype(dt),
         "blocks": blocks,
-        "ln_f": {"scale": jnp.ones((cfg.dim,), dt), "bias": jnp.zeros((cfg.dim,), dt)},
+        "ln_f": init_norm_params(cfg.dim, dt, cfg.norm),
         "head": {
             "w": (jax.random.normal(kh, (cfg.dim, cfg.num_classes))
                   / math.sqrt(cfg.dim)).astype(dt),

@@ -73,12 +73,18 @@ class MoEConfig:
     #   'auto'   — 'sorted' when the dense tensors would exceed
     #              _DENSE_DISPATCH_MAX elements (both are exercised by CI).
     dispatch: str = "auto"
+    # Expert FFN activation: 'gelu' | 'swiglu' (stacked [E, 2, D, F]
+    # gate/up — the Mixtral-style expert; structural dispatch on w1.ndim,
+    # mirroring the dense MLP's convention in tensor_parallel/layers.py).
+    act: str = "gelu"
 
     def __post_init__(self):
         if self.router not in ("topk", "expert_choice"):
             raise ValueError(f"unknown MoE router {self.router!r}")
         if self.dispatch not in ("dense", "sorted", "auto"):
             raise ValueError(f"unknown MoE dispatch {self.dispatch!r}")
+        if self.act not in ("gelu", "swiglu"):
+            raise ValueError(f"unknown MoE act {self.act!r}")
 
 
 # ------------------------------------------------------------------ dispatch
@@ -206,8 +212,14 @@ def _load_balance_loss(probs: jnp.ndarray, dispatched: jnp.ndarray) -> jnp.ndarr
 
 
 def _expert_ffn(p: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
-    """Per-expert MLP on stacked experts.  x: [E, G, D] -> [E, G, D]."""
-    h = jax.nn.gelu(jnp.einsum("egd,edf->egf", x, p["w1"]) + p["b1"][:, None, :])
+    """Per-expert MLP on stacked experts.  x: [E, G, D] -> [E, G, D].
+    A 4-dim ``w1`` ([E, 2, D, F]) is the stacked gate/up SwiGLU expert
+    (``MoEConfig.act='swiglu'``): silu(gate) * up -> w2."""
+    if p["w1"].ndim == 4:
+        gu = jnp.einsum("egd,etdf->tegf", x, p["w1"]) + p["b1"].transpose(1, 0, 2)[:, :, None, :]
+        h = jax.nn.silu(gu[0]) * gu[1]
+    else:
+        h = jax.nn.gelu(jnp.einsum("egd,edf->egf", x, p["w1"]) + p["b1"][:, None, :])
     return jnp.einsum("egf,efd->egd", h, p["w2"]) + p["b2"][:, None, :]
 
 
@@ -360,25 +372,37 @@ def init_moe_params(key, cfg: MoEConfig) -> Dict[str, PyTree]:
     kr, k1, k2 = jax.random.split(key, 3)
     D, F, E = cfg.dim, cfg.ffn_dim, cfg.num_experts
     dt = cfg.dtype
-    return {
-        "router": {"w": (jax.random.normal(kr, (D, E)) / math.sqrt(D)).astype(dt)},
-        "experts": {
+    if cfg.act == "swiglu":
+        experts = {
+            "w1": (jax.random.normal(k1, (E, 2, D, F)) / math.sqrt(D)).astype(dt),
+            "b1": jnp.zeros((E, 2, F), dt),
+            "w2": (jax.random.normal(k2, (E, F, D)) / math.sqrt(F)).astype(dt),
+            "b2": jnp.zeros((E, D), dt),
+        }
+    else:
+        experts = {
             "w1": (jax.random.normal(k1, (E, D, F)) / math.sqrt(D)).astype(dt),
             "b1": jnp.zeros((E, F), dt),
             "w2": (jax.random.normal(k2, (E, F, D)) / math.sqrt(F)).astype(dt),
             "b2": jnp.zeros((E, D), dt),
-        },
+        }
+    return {
+        "router": {"w": (jax.random.normal(kr, (D, E)) / math.sqrt(D)).astype(dt)},
+        "experts": experts,
     }
 
 
-def moe_param_specs(ep_axis: str = EXPERT_AXIS) -> Dict[str, PyTree]:
+def moe_param_specs(ep_axis: str = EXPERT_AXIS, act: str = "gelu") -> Dict[str, PyTree]:
     """Router replicated; stacked expert arrays sharded on the expert dim over
-    the EP axis.  Sharding *is* the expert placement — no manual scatter."""
+    the EP axis.  Sharding *is* the expert placement — no manual scatter.
+    ``act='swiglu'`` matches the [E, 2, D, F] stacked gate/up leaves."""
+    w1 = P(ep_axis, None, None, None) if act == "swiglu" else P(ep_axis, None, None)
+    b1 = P(ep_axis, None, None) if act == "swiglu" else P(ep_axis, None)
     return {
         "router": {"w": P()},
         "experts": {
-            "w1": P(ep_axis, None, None),
-            "b1": P(ep_axis, None),
+            "w1": w1,
+            "b1": b1,
             "w2": P(ep_axis, None, None),
             "b2": P(ep_axis, None),
         },

@@ -78,6 +78,26 @@ class TransformerConfig:
     # learned pos_emb table when this is on.
     rope: bool = False
     rope_theta: float = 10000.0
+    # 'layer' (LayerNorm, scale+bias) | 'rms' (RMSNorm, scale only — the
+    # Llama-family norm).  The choice is carried STRUCTURALLY by the param
+    # tree: rms norm params have no 'bias' leaf and :func:`layer_norm`
+    # dispatches on that, so downstream code (heads, MoE blocks, pipeline
+    # slabs) needs no norm plumbing.
+    norm: str = "layer"
+    # 'gelu' (w1 [D, F] -> gelu -> w2) | 'swiglu' (w1 [2, D, F] stacked
+    # gate/up -> silu(gate) * up -> w2, the Llama FFN).  Also structural:
+    # :func:`mlp_partial` dispatches on w1.ndim.
+    act: str = "gelu"
+    # explicit FFN hidden width; None = dim * ffn_mult.  Llama-style models
+    # use non-integer multipliers (~8/3 d rounded), which ffn_mult can't
+    # express.
+    ffn_hidden: Optional[int] = None
+
+    def __post_init__(self):
+        if self.norm not in ("layer", "rms"):
+            raise ValueError(f"norm must be 'layer' or 'rms', got {self.norm!r}")
+        if self.act not in ("gelu", "swiglu"):
+            raise ValueError(f"act must be 'gelu' or 'swiglu', got {self.act!r}")
 
     @property
     def head_dim(self) -> int:
@@ -96,7 +116,7 @@ class TransformerConfig:
 
     @property
     def ffn_dim(self) -> int:
-        return self.dim * self.ffn_mult
+        return self.ffn_hidden if self.ffn_hidden is not None else self.dim * self.ffn_mult
 
 
 # ------------------------------------------------------------------ primitives
@@ -107,7 +127,14 @@ def layer_norm(x: jnp.ndarray, p: Dict[str, jnp.ndarray], eps: float = 1e-5) -> 
     of ~1e3-element rows lose enough mantissa to visibly perturb the
     normalization (the standard TPU-stack practice is f32 LN statistics;
     the op is VPU-bound and XLA fuses the casts, so the cost is noise).
-    f32 inputs are bit-identical to the plain formulation."""
+    f32 inputs are bit-identical to the plain formulation.
+
+    Structural norm dispatch: params WITHOUT a 'bias' leaf are RMSNorm
+    (``TransformerConfig.norm='rms'`` — see :func:`rms_norm`), so every call
+    site (block norms, final heads, MoE blocks) serves both families with no
+    cfg plumbing."""
+    if "bias" not in p:
+        return rms_norm(x, p, eps)
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.var(xf, axis=-1, keepdims=True)
@@ -115,6 +142,33 @@ def layer_norm(x: jnp.ndarray, p: Dict[str, jnp.ndarray], eps: float = 1e-5) -> 
     return (
         y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
     ).astype(x.dtype)
+
+
+def rms_norm(x: jnp.ndarray, p: Dict[str, jnp.ndarray], eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm (Zhang & Sennrich): x / rms(x) * scale — no mean subtraction,
+    no bias.  The Llama-family norm.  f32 statistics for the same mantissa
+    reason as :func:`layer_norm`."""
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(ms + eps) * p["scale"].astype(jnp.float32)).astype(x.dtype)
+
+
+def init_norm_params(dim: int, dtype, norm: str = "layer") -> Dict[str, jnp.ndarray]:
+    """Norm params whose STRUCTURE encodes the norm kind ('layer' carries a
+    bias leaf, 'rms' does not) — the dispatch key :func:`layer_norm` reads."""
+    out = {"scale": jnp.ones((dim,), dtype)}
+    if norm == "layer":
+        out["bias"] = jnp.zeros((dim,), dtype)
+    return out
+
+
+def norm_param_specs(norm: str = "layer") -> Dict[str, P]:
+    """Spec tree matching :func:`init_norm_params` (norm params are always
+    replicated)."""
+    out = {"scale": P()}
+    if norm == "layer":
+        out["bias"] = P()
+    return out
 
 
 def rope_cache(
@@ -163,6 +217,24 @@ def _rope_positions(cfg: TransformerConfig, S: int) -> jnp.ndarray:
         pos, _ = zigzag_positions(idx, S, jax.lax.axis_size(cfg.context_axis))
         return pos
     return idx * S + jnp.arange(S)
+
+
+def block_rope_cache(
+    cfg: TransformerConfig, s_local: int, axis: Optional[str] = None,
+    sp: bool = False,
+):
+    """The layer-invariant (cos, sin) rope cache for a block stack whose
+    activations have ``s_local`` sequence rows — or None when rope is off.
+    Compute ONCE per forward and thread into every block (``scan_blocks``
+    and the MoE families' heterogeneous loops both do); attention sees the
+    SP-gathered full sequence, so under SP the table length is
+    s_local * tp."""
+    if not cfg.rope:
+        return None
+    s_attn = s_local
+    if axis is not None and sp:
+        s_attn = s_attn * jax.lax.axis_size(axis)
+    return rope_cache(_rope_positions(cfg, s_attn), cfg.head_dim, cfg.rope_theta)
 
 
 def attention_partial(
@@ -239,9 +311,17 @@ def attention_partial(
 
 
 def mlp_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
-    """Col -> gelu -> Row without the closing reduction/bias (``TpMlp``,
-    mlp.py:64-66)."""
-    h = jax.nn.gelu(x @ p["w1"] + p["b1"])
+    """Col -> act -> Row without the closing reduction/bias (``TpMlp``,
+    mlp.py:64-66).  Structural act dispatch: a 3-dim ``w1`` is the stacked
+    [2, D, F] gate/up SwiGLU pair (``TransformerConfig.act='swiglu'``) —
+    silu(gate) * up, the Llama FFN; 2-dim ``w1`` is the gelu MLP.  Stacking
+    gate and up in one leaf keeps the col-parallel TP spec a single rule
+    (shard the last dim) and the einsum one fused matmul."""
+    if p["w1"].ndim == 3:
+        gu = jnp.einsum("bsd,tdf->tbsf", x, p["w1"]) + p["b1"][:, None, None, :]
+        h = jax.nn.silu(gu[0]) * gu[1]
+    else:
+        h = jax.nn.gelu(x @ p["w1"] + p["b1"])
     return h @ p["w2"]  # partial
 
 
@@ -279,14 +359,20 @@ def dropout(
 #: ops/flash_attention._flash_fwd_rule — so the backward skips the Pallas
 #: fwd re-run and recomputes only LN/einsum/MLP; measured +5.3% on the v5e
 #: 125M bench, docs/BENCH_AB.md session 4), and 'flash_offload' ('flash'
-#: whose saved residuals live in ``pinned_host`` memory instead of HBM —
+#: whose saved o residual lives in ``pinned_host`` memory instead of HBM —
 #: XLA schedules the device->host DMA behind the remaining forward and the
 #: host->device prefetch behind the backward, so the HBM cost of the
-#: policy drops to ~one block's residuals in flight; the long-context /
-#: big-batch lever).
+#: policy drops to ~one block's o in flight plus the small on-device lse;
+#: the long-context / big-batch lever).
 RematMode = Union[bool, None, str]
 _REMAT_MODES = (False, None, True, "flash", "flash_offload")
 _FLASH_RESIDUAL_NAMES = ("flash_out", "flash_lse")
+# flash_offload partition of the same names (renames must update the tuple,
+# and these views follow): o offloads to pinned_host; lse stays saved in
+# HBM — offloading it crashes XLA's HostOffloader on current TPU compilers
+# (see checkpoint_block)
+_OFFLOADED_RESIDUAL_NAMES = _FLASH_RESIDUAL_NAMES[:1]  # ("flash_out",)
+_HBM_SAVED_RESIDUAL_NAMES = _FLASH_RESIDUAL_NAMES[1:]  # ("flash_lse",)
 
 
 def checkpoint_block(fn, remat: RematMode, prevent_cse: bool = True):
@@ -307,9 +393,16 @@ def checkpoint_block(fn, remat: RematMode, prevent_cse: bool = True):
         policy = jax.checkpoint_policies.save_only_these_names(
             *_FLASH_RESIDUAL_NAMES)
     elif remat == "flash_offload":
+        # offload the BIG residual (o, [B, S, D] bf16) only; lse
+        # ([B, H, S] f32, ~1/32 of o at head_dim 64) stays saved in HBM.
+        # Offloading lse too crashes XLA's HostOffloader on current TPU
+        # compilers — its consumer path reaches a variadic (2-operand)
+        # reduce the pass can't walk (host_offload_utils.cc:225, observed
+        # on v5e 2026-07-31 on every GPT config tried); keeping lse
+        # on-device costs ~3% of the HBM win and compiles everywhere.
         policy = jax.checkpoint_policies.save_and_offload_only_these_names(
-            names_which_can_be_saved=[],
-            names_which_can_be_offloaded=list(_FLASH_RESIDUAL_NAMES),
+            names_which_can_be_saved=list(_HBM_SAVED_RESIDUAL_NAMES),
+            names_which_can_be_offloaded=list(_OFFLOADED_RESIDUAL_NAMES),
             offload_src="device",
             offload_dst="pinned_host",
         )
@@ -428,17 +521,9 @@ def scan_blocks(
         want = want | _vma(layer_mask)
     x = _mark_varying(x, tuple(want))  # idempotent: only missing axes added
 
-    rope = None
-    if cfg.rope:
-        # layer-invariant (cos, sin): computed ONCE here and closed over by
-        # the scan body (a loop constant), instead of re-deriving the trig
-        # inside every layer iteration.  Attention sees the SP-gathered
-        # full sequence, so the table length is S_local * tp under SP.
-        S_attn = x.shape[1]
-        if axis is not None and sp:
-            S_attn = S_attn * jax.lax.axis_size(axis)
-        rope = rope_cache(
-            _rope_positions(cfg, S_attn), cfg.head_dim, cfg.rope_theta)
+    # layer-invariant (cos, sin): computed ONCE and closed over by the scan
+    # body (a loop constant), instead of re-deriving the trig per layer
+    rope = block_rope_cache(cfg, x.shape[1], axis, sp)
 
     def blk(lp, h, i):
         k = (
@@ -479,12 +564,12 @@ def scan_blocks(
 
 def stacked_block_specs(
     tp_axis: Optional[str] = None, stack_axis: Optional[str] = None,
-    gqa: bool = False,
+    gqa: bool = False, norm: str = "layer", act: str = "gelu",
 ) -> Dict[str, PyTree]:
     """Per-block TP specs with a leading entry for the layer-stack dim —
     ``stack_axis`` shards the stack (pipeline stages), None replicates it.
     Shared by gpt_param_specs / vit_param_specs."""
-    bspecs = block_param_specs(tp_axis, gqa=gqa)
+    bspecs = block_param_specs(tp_axis, gqa=gqa, norm=norm, act=act)
     is_spec = lambda x: isinstance(x, P)
     return jax.tree.map(lambda s: P(stack_axis, *tuple(s)), bspecs, is_leaf=is_spec)
 
@@ -518,17 +603,25 @@ def init_block_params(key, cfg: TransformerConfig, mlp: bool = True) -> Dict[str
             "bo": jnp.zeros((D,), dt),
         }
     out = {
-        "ln1": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
+        "ln1": init_norm_params(D, dt, cfg.norm),
         "attn": attn,
-        "ln2": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
+        "ln2": init_norm_params(D, dt, cfg.norm),
     }
     if mlp:
-        out["mlp"] = {
-            "w1": (jax.random.normal(k1, (D, F)) * s).astype(dt),
-            "b1": jnp.zeros((F,), dt),
-            "w2": (jax.random.normal(k2, (F, D)) * (1.0 / math.sqrt(F))).astype(dt),
-            "b2": jnp.zeros((D,), dt),
-        }
+        if cfg.act == "swiglu":
+            out["mlp"] = {
+                "w1": (jax.random.normal(k1, (2, D, F)) * s).astype(dt),
+                "b1": jnp.zeros((2, F), dt),
+                "w2": (jax.random.normal(k2, (F, D)) * (1.0 / math.sqrt(F))).astype(dt),
+                "b2": jnp.zeros((D,), dt),
+            }
+        else:
+            out["mlp"] = {
+                "w1": (jax.random.normal(k1, (D, F)) * s).astype(dt),
+                "b1": jnp.zeros((F,), dt),
+                "w2": (jax.random.normal(k2, (F, D)) * (1.0 / math.sqrt(F))).astype(dt),
+                "b2": jnp.zeros((D,), dt),
+            }
     return out
 
 
@@ -536,19 +629,24 @@ def init_transformer_params(key, cfg: TransformerConfig) -> Dict[str, PyTree]:
     keys = jax.random.split(key, cfg.nlayers)
     return {
         "blocks": [init_block_params(k, cfg) for k in keys],
-        "ln_f": {"scale": jnp.ones((cfg.dim,), cfg.dtype), "bias": jnp.zeros((cfg.dim,), cfg.dtype)},
+        "ln_f": init_norm_params(cfg.dim, cfg.dtype, cfg.norm),
     }
 
 
 # ----------------------------------------------------------------------- specs
 
 
-def block_param_specs(axis: str = "tensor", gqa: bool = False) -> Dict[str, PyTree]:
+def block_param_specs(
+    axis: str = "tensor", gqa: bool = False, norm: str = "layer",
+    act: str = "gelu",
+) -> Dict[str, PyTree]:
     """PartitionSpec tree for one block under TP.  Column-parallel weights
     shard their output dim, row-parallel their input dim; LN and row biases
     replicated (added post-reduction exactly once).  ``gqa`` selects the
     grouped-query leaf set (separate wq / stacked wkv; requires
-    kv_heads % tp_size == 0 so shards own whole KV heads)."""
+    kv_heads % tp_size == 0 so shards own whole KV heads); ``norm``/``act``
+    select the rms (biasless) norm leaves and the stacked [2, D, F] SwiGLU
+    w1 — match the block's TransformerConfig."""
     attn = (
         {
             "wq": P(None, axis),
@@ -566,24 +664,34 @@ def block_param_specs(axis: str = "tensor", gqa: bool = False) -> Dict[str, PyTr
             "bo": P(),
         }
     )
-    return {
-        "ln1": {"scale": P(), "bias": P()},
-        "attn": attn,
-        "ln2": {"scale": P(), "bias": P()},
-        "mlp": {
+    mlp = (
+        {
+            "w1": P(None, None, axis),  # [2, D, F]: gate/up both col-parallel
+            "b1": P(None, axis),
+            "w2": P(axis, None),
+            "b2": P(),
+        }
+        if act == "swiglu"
+        else {
             "w1": P(None, axis),
             "b1": P(axis),
             "w2": P(axis, None),
             "b2": P(),
-        },
+        }
+    )
+    return {
+        "ln1": norm_param_specs(norm),
+        "attn": attn,
+        "ln2": norm_param_specs(norm),
+        "mlp": mlp,
     }
 
 
 def transformer_param_specs(cfg: TransformerConfig, axis: str = "tensor") -> Dict[str, PyTree]:
     return {
         "blocks": [
-            block_param_specs(axis, gqa=cfg.is_gqa)
+            block_param_specs(axis, gqa=cfg.is_gqa, norm=cfg.norm, act=cfg.act)
             for _ in range(cfg.nlayers)
         ],
-        "ln_f": {"scale": P(), "bias": P()},
+        "ln_f": norm_param_specs(cfg.norm),
     }
