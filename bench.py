@@ -51,6 +51,7 @@ If everything fails it still prints the JSON line with an ``error`` field.
 Run with ``--measure`` to execute the measurement directly in-process.
 """
 
+import dataclasses
 import functools
 import json
 import os
@@ -100,6 +101,20 @@ LONG_CANDIDATES = [
     (4, "flash", 512),
     (2, "flash_offload", 512),
 ]
+# MoE candidates (--moe): GPT-MoE on one chip (EP=1 — expert compute is
+# local; this measures the ROUTING + DISPATCH + expert-FFN leaf the EP
+# all_to_all wraps at scale).  4-tuples: (batch, remat, xent_chunk,
+# dispatch) — the sorted-vs-dense pair at b2 answers docs/ROADMAP.md's
+# open question (is XLA's scatter/gather lowering of the sorted path
+# leaving throughput on the table?) with on-chip numbers; dense at b>=4
+# is untestable (the [T, E, C] one-hots alone exceed HBM).
+MOE_CANDIDATES = [
+    (8, "flash", None, "sorted"),
+    (16, "flash", None, "sorted"),
+    (2, "flash", None, "sorted"),
+    (2, "flash", None, "dense"),
+]
+
 # Retired candidates (recorded in BENCH_BASELINE.json / docs/BENCH_AB.md):
 # (32, True, None) 22,263 collapses (spills); (16, False, 256) OOMs —
 # streamed CE removes the logits but b16 no-remat still saves every block
@@ -152,7 +167,8 @@ def _measure() -> None:
     import jax.numpy as jnp
 
     main(jax, jnp, ab="--ab" in sys.argv, only=_only_index(sys.argv),
-         big="--big" in sys.argv, long="--long" in sys.argv)
+         big="--big" in sys.argv, long="--long" in sys.argv,
+         moe="--moe" in sys.argv)
 
 
 def _load_baselines(path: str) -> dict:
@@ -249,12 +265,22 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
 
     from torchdistpackage_tpu.models import gpt_loss, init_gpt_params
 
-    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    if cfg.moe_experts:
+        from torchdistpackage_tpu.models import gpt_moe_loss, init_gpt_moe_params
+
+        params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+
+        def loss_fn(p, batch):
+            return gpt_moe_loss(p, batch, cfg, remat=remat)
+
+    else:
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+
+        def loss_fn(p, batch):
+            return gpt_loss(p, batch, cfg, remat=remat, xent_chunk=xent_chunk)
+
     opt = optax.adamw(3e-4)
     state = opt.init(params)
-
-    def loss_fn(p, batch):
-        return gpt_loss(p, batch, cfg, remat=remat, xent_chunk=xent_chunk)
 
     # DP mesh over all attached chips so per-chip throughput is honest on
     # multi-chip hosts: params replicated, batch sharded on its leading dim.
@@ -269,13 +295,26 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
 
     # 6N counts only matmul params: tok_emb/pos_emb forwards are gather/add
     # (backward scatter-add), never executed as matmuls — counting them would
-    # inflate MFU ~15% at this vocab size (the head matmul params DO count)
-    n_matmul_params = sum(
-        leaf.size
-        for k, sub in params.items()
-        if k not in ("tok_emb", "pos_emb")
-        for leaf in jax.tree.leaves(sub)
-    )
+    # inflate MFU ~15% at this vocab size (the head matmul params DO count).
+    # MoE: experts count at top_k/E — each token's FLOPs touch only its
+    # routed experts (the standard sparse-MFU accounting); router counts in
+    # full.
+    n_matmul_params = 0
+    for k, sub in params.items():
+        if k in ("tok_emb", "pos_emb"):
+            continue
+        if k == "blocks" and isinstance(sub, list):  # MoE heterogeneous list
+            for bp in sub:
+                for name, leafset in bp.items():
+                    if name == "moe":
+                        ex = sum(l.size for l in jax.tree.leaves(leafset["experts"]))
+                        n_matmul_params += leafset["router"]["w"].size
+                        n_matmul_params += ex * cfg.moe_top_k // cfg.moe_experts
+                    else:
+                        n_matmul_params += sum(
+                            l.size for l in jax.tree.leaves(leafset))
+        else:
+            n_matmul_params += sum(l.size for l in jax.tree.leaves(sub))
     flops_per_token = (
         6 * n_matmul_params + 12 * cfg.nlayers * cfg.max_seq * cfg.dim
     )
@@ -316,7 +355,7 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
 
 
 def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
-         long: bool = False) -> None:
+         long: bool = False, moe: bool = False) -> None:
     from torchdistpackage_tpu.models import GPTConfig
 
     # Backend probe with CPU fallback: an accelerator backend that errors at
@@ -332,7 +371,18 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
     chip = jax.devices()[0].device_kind
     peak = _peak_flops(chip) if on_accel else None
 
-    if on_accel and long:
+    if on_accel and moe:
+        # MoE leaf: the 125M dense trunk with 8 experts every 2nd block
+        # (Switch placement) — 0.57B total params, ~0.18B activated/token
+        cfg = GPTConfig(
+            vocab_size=32768, dim=768, nheads=12, nlayers=12, max_seq=2048,
+            ffn_mult=4, dtype=jnp.bfloat16, attn_impl="flash",
+            moe_experts=8, moe_top_k=2, moe_every=2,
+        )
+        candidates = MOE_CANDIDATES
+        steps, warmup = 10, 2
+        size_tag = "moe8x125m"
+    elif on_accel and long:
         # long-context leaf: 125M at S=8192 (the CP ring's per-chip config)
         cfg = GPTConfig(
             vocab_size=32768, dim=768, nheads=12, nlayers=12, max_seq=8192,
@@ -382,18 +432,25 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
         candidates = candidates[:1]
 
     results = []
-    for batch_size, remat, xent_chunk in candidates:
+    for cand in candidates:
+        batch_size, remat, xent_chunk = cand[:3]
+        dispatch = cand[3] if len(cand) > 3 else None
+        run_cfg = (
+            dataclasses.replace(cfg, moe_dispatch=dispatch) if dispatch else cfg
+        )
         tps, global_batch, fpt = _run_config(
-            jax, jnp, cfg, batch_size, steps, warmup, remat,
+            jax, jnp, run_cfg, batch_size, steps, warmup, remat,
             xent_chunk=xent_chunk)
         # remat: False | True | 'flash' | 'flash_offload' (save the flash
         # kernel's residuals — in HBM or pinned_host — so the backward skips
         # the Pallas fwd re-run; scan_blocks docstring)
         remat_tag = {False: "", True: " remat"}.get(remat, f" remat-{remat}")
+        moe_tag = f"-moe{cfg.moe_experts}" if cfg.moe_experts else ""
         config_str = (
-            f"gpt d{cfg.dim} L{cfg.nlayers} seq{cfg.max_seq} b{global_batch}"
+            f"gpt{moe_tag} d{cfg.dim} L{cfg.nlayers} seq{cfg.max_seq} b{global_batch}"
             f"{remat_tag}"
             f"{f' ce{xent_chunk}' if xent_chunk else ''}"
+            f"{f' {dispatch}' if dispatch else ''}"
         )
         metric = f"gpt-{size_tag}-train-throughput"
         _record_baseline(baselines, baseline_path, backend, config_str, tps,
@@ -529,7 +586,8 @@ def _run_child(env_extra: dict, timeout: float, extra_args=(), capture=False,
 
 
 def _ab_main(timeout: float, allow_cpu: bool = False,
-             big: bool = False, long: bool = False) -> None:
+             big: bool = False, long: bool = False,
+             moe: bool = False) -> None:
     """One child per candidate: an OOM/hang in one config cannot abort the
     sweep (observed: b16 no-remat exhausts v5e HBM and killed the round-3
     sweep's remaining configs), and each child gets a fresh backend — no
@@ -544,9 +602,10 @@ def _ab_main(timeout: float, allow_cpu: bool = False,
     Exception: under an EXPLICIT ``JAX_PLATFORMS=cpu`` (``allow_cpu``) the
     user asked for the CPU sweep, so CPU lines are the legitimate result
     and only the end-of-list marker stops."""
-    cands = (LONG_CANDIDATES if long
+    cands = (MOE_CANDIDATES if moe else LONG_CANDIDATES if long
              else BIG_CANDIDATES if big else TPU_CANDIDATES)
-    extra = ("--long",) if long else ("--big",) if big else ()
+    extra = (("--moe",) if moe else ("--long",) if long
+             else ("--big",) if big else ())
     best = None
     for i in range(len(cands)):
         out = _run_child(
@@ -609,14 +668,18 @@ if __name__ == "__main__":
                 {"ab_winner": None, "error": "accelerator unreachable"}))
             sys.exit(0)
         _ab_main(cpu_timeout if on_cpu else accel_timeout, allow_cpu=on_cpu,
-                 big="--big" in sys.argv, long="--long" in sys.argv)
+                 big="--big" in sys.argv, long="--long" in sys.argv,
+                 moe="--moe" in sys.argv)
         sys.exit(0)
 
-    # `python bench.py --long` measures LONG_CANDIDATES[0] (its own
-    # gpt-125m-s8k series) instead of the S=2048 headline — the flag must
-    # reach the measurement children or results would land in the wrong
-    # baseline series while appearing to succeed
-    long_flag = ("--long",) if "--long" in sys.argv else ()
+    # `python bench.py --long` / `--moe` measure their own series
+    # (gpt-125m-s8k / gpt-moe8x125m) instead of the S=2048 headline — the
+    # flag must reach the measurement children or results would land in the
+    # wrong baseline series while appearing to succeed.  moe-first order
+    # matches _ab_main and main() so every entry point resolves a
+    # conflicting `--long --moe` to the same sweep.
+    long_flag = (("--moe",) if "--moe" in sys.argv
+                 else ("--long",) if "--long" in sys.argv else ())
     if on_cpu:
         ok = _run_child({}, cpu_timeout, long_flag)
     else:

@@ -13,6 +13,11 @@ from .gpt import (
     vocab_parallel_embed,
     vocab_parallel_xent,
 )
+from .generate import (
+    forward_cached,
+    generate,
+    init_kv_cache,
+)
 from .gpt_moe import (
     gpt_moe_forward,
     gpt_moe_loss,

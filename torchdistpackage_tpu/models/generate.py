@@ -1,0 +1,268 @@
+"""KV-cache autoregressive generation for the dense GPT/Llama families.
+
+The reference is a training toolkit — it has no inference path at all.  A
+complete framework needs one, and decode is where TPU-first design choices
+differ most from training:
+
+- **Static shapes end-to-end**: the KV cache is a fixed ``[L, B, Hkv,
+  max_len, hd]`` buffer written with ``dynamic_update_slice``; attention
+  always scores against the full buffer with a position mask (`key_pos <=
+  query_pos`).  No growing tensors, so the whole decode loop is ONE
+  ``lax.scan`` inside ONE jit — no per-token retrace, no host round-trips.
+- **One cached-block implementation serves prefill AND decode**: prefill is
+  the S_in=P case (offset 0), decode the S_in=1 case (offset t) of the same
+  function — the reference-style "two code paths that drift" problem cannot
+  exist.
+- **TP composes exactly like training**: the same param specs shard q/kv
+  heads and the vocab-parallel head; the per-shard last-position logits are
+  psum-assembled into full [B, V] rows (tiny at S_in=1), sampling is
+  replicated-deterministic across shards, and GQA serves grouped KV heads
+  without materializing repeats.
+- RoPE rotates at the true global positions (``offset + arange(S_in)``),
+  traced, so the rotation is correct at every decode step inside the scan.
+
+MoE decode is deliberately not wired yet (capacity-based routing wants a
+different inference-time dispatch); the dense families — including
+``llama_config`` models (RMSNorm/SwiGLU/RoPE/GQA) — are fully served.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.tensor_parallel.layers import (
+    TransformerConfig,
+    _close_row_parallel,
+    compute_qkv,
+    layer_norm,
+    mlp_partial,
+    rope_cache,
+)
+from .gpt import GPTConfig, gpt_head, vocab_parallel_embed
+
+PyTree = Any
+
+
+def init_kv_cache(
+    cfg: GPTConfig, batch: int, max_len: int, axis_size: int = 1
+) -> Dict[str, jnp.ndarray]:
+    """Zeroed cache ``{'k','v': [L, B, Hkv_local, max_len, hd]}`` in
+    ``cfg.dtype``.  ``axis_size`` divides the KV heads for TP (call inside
+    shard_map with ``jax.lax.axis_size(axis)``, or build the global
+    [L, B, Hkv, ...] array outside and shard dim 2 over the tensor axis)."""
+    hkv, rem = divmod(cfg.block.kv_head_count, axis_size)
+    if rem or hkv == 0:
+        raise ValueError(
+            f"kv_heads {cfg.block.kv_head_count} not divisible by tp "
+            f"{axis_size} (whole KV heads per shard)"
+        )
+    shape = (cfg.nlayers, batch, hkv, max_len, cfg.block.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _cached_attention(
+    q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray, offset
+) -> jnp.ndarray:
+    """Grouped-query attention of q [B, H, S_in, hd] against the full cache
+    ck/cv [B, Hkv, T, hd], masked to ``key_pos <= offset + query_row``.
+    f32 softmax, 1/sqrt(hd) scale — the mha_reference conventions."""
+    B, H, S_in, hd = q.shape
+    Hkv, T = ck.shape[1], ck.shape[2]
+    g = H // Hkv
+    qg = q.reshape(B, Hkv, g, S_in, hd)
+    s = jnp.einsum("bkgqh,bkth->bkgqt", qg, ck).astype(jnp.float32)
+    s = s * (1.0 / math.sqrt(hd))
+    qpos = offset + jnp.arange(S_in)
+    mask = jnp.arange(T)[None, :] <= qpos[:, None]  # [S_in, T]
+    s = jnp.where(mask[None, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
+    out = jnp.einsum("bkgqt,bkth->bkgqh", p, cv)
+    return out.reshape(B, H, S_in, hd)
+
+
+def cached_block_forward(
+    p: Dict[str, PyTree],
+    x: jnp.ndarray,
+    cfg: TransformerConfig,
+    ck: jnp.ndarray,
+    cv: jnp.ndarray,
+    offset,
+    axis: Optional[str] = None,
+    rope: "tuple | None" = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One pre-LN block with KV caching: writes this call's k/v into the
+    cache at ``[offset, offset + S_in)`` and attends against the whole
+    buffer.  x: [B, S_in, D].  Returns ``(y, ck, cv)`` with the updated
+    cache.  Prefill is S_in=P at offset 0; decode is S_in=1 at offset t —
+    one implementation, both phases."""
+    B, S_in, D = x.shape
+    h = layer_norm(x, p["ln1"])
+    q, k, v = compute_qkv(p["attn"], h, cfg, rope=rope)
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, offset, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, offset, 0))
+    out = _cached_attention(q, ck, cv, offset)
+    out = out.transpose(0, 2, 1, 3).reshape(B, S_in, q.shape[1] * cfg.head_dim)
+    y = out @ p["attn"]["wo"]
+    y = _close_row_parallel(y, p["attn"]["bo"], axis, False)
+    x = x + y
+
+    h = layer_norm(x, p["ln2"])
+    z = mlp_partial(p["mlp"], h)
+    z = _close_row_parallel(z, p["mlp"]["b2"], axis, False)
+    return x + z, ck, cv
+
+
+def _embed_at(
+    params: Dict[str, PyTree],
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    axis: Optional[str],
+) -> jnp.ndarray:
+    """[B, S_in] ids at the given global positions -> [B, S_in, D]."""
+    h = vocab_parallel_embed(params["tok_emb"], tokens, axis)
+    if "pos_emb" in params:  # learned positions; rope models skip this
+        h = h + jnp.take(params["pos_emb"], positions, axis=0)
+    return h
+
+
+def forward_cached(
+    params: Dict[str, PyTree],
+    tokens: jnp.ndarray,
+    cfg: GPTConfig,
+    cache: Dict[str, jnp.ndarray],
+    offset,
+    axis: Optional[str] = None,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Run ``tokens`` [B, S_in] (occupying global positions
+    ``offset + arange(S_in)``) through the cached stack.  Returns the
+    updated cache and the LAST position's vocab-local logits [B, V_local].
+    The layer dim rides a ``lax.scan`` over the stacked block params with
+    the cache slices as per-layer carries-through (scan ys)."""
+    bcfg = cfg.block
+    S_in = tokens.shape[1]
+    positions = offset + jnp.arange(S_in)
+    h = _embed_at(params, tokens, positions, axis)
+    rope = (
+        rope_cache(positions, bcfg.head_dim, bcfg.rope_theta)
+        if bcfg.rope
+        else None
+    )
+
+    def body(hc, xs):
+        lp, ck, cv = xs
+        y, ck, cv = cached_block_forward(
+            lp, hc, bcfg, ck, cv, offset, axis=axis, rope=rope
+        )
+        return y, (ck, cv)
+
+    h, (ck, cv) = jax.lax.scan(
+        body, h, (params["blocks"], cache["k"], cache["v"])
+    )
+    logits = gpt_head(params, h[:, -1:, :], axis, False)  # [B, 1, V_local]
+    return {"k": ck, "v": cv}, logits[:, 0, :]
+
+
+def _full_logits(logits: jnp.ndarray, cfg: GPTConfig, axis: Optional[str]):
+    """Vocab-local [B, V_local] -> full [B, V] (psum-assembled shard slabs;
+    tiny at one position per sequence).  Identity when serial."""
+    if axis is None:
+        return logits
+    n = jax.lax.axis_size(axis)
+    i = jax.lax.axis_index(axis)
+    full = jnp.zeros((logits.shape[0], cfg.vocab_size), logits.dtype)
+    full = jax.lax.dynamic_update_slice(full, logits, (0, i * logits.shape[1]))
+    return jax.lax.psum(full, axis)
+
+
+def _sample(
+    logits: jnp.ndarray,
+    key: Optional[jax.Array],
+    temperature: float,
+) -> jnp.ndarray:
+    """Greedy argmax when ``key`` is None, else temperature sampling.  On
+    full [B, V] logits, so TP shards make the identical choice."""
+    if key is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits.astype(jnp.float32) / temperature, axis=-1
+    ).astype(jnp.int32)
+
+
+def generate(
+    params: Dict[str, PyTree],
+    prompt: jnp.ndarray,
+    cfg: GPTConfig,
+    max_new_tokens: int,
+    axis: Optional[str] = None,
+    key: Optional[jax.Array] = None,
+    temperature: float = 1.0,
+) -> jnp.ndarray:
+    """Autoregressively extend ``prompt`` [B, P] by ``max_new_tokens``.
+    Greedy when ``key`` is None, else temperature sampling.  Returns
+    [B, P + max_new_tokens] (prompt included).
+
+    Serial when ``axis`` is None; under TP call inside shard_map with the
+    training param specs (``gpt_param_specs(cfg, tp_axis=axis)``) — the
+    returned tokens are psum/argmax-deterministic and identical on every
+    shard.  Jit the whole call: prefill is one batched forward, then ONE
+    ``lax.scan`` of single-token steps — no per-token recompilation.
+
+    Requires ``cfg.moe_experts == 0`` (dense families; see module
+    docstring) and ``P + max_new_tokens <= cfg.max_seq`` for learned
+    positions."""
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "KV-cache decode is wired for the dense families; MoE decode "
+            "needs an inference-time dispatch (no capacity padding) and is "
+            "tracked in docs/ROADMAP.md"
+        )
+    B, P = prompt.shape
+    if max_new_tokens < 1:
+        # the prefill below would still sample one token and
+        # dynamic_update_slice would CLAMP its out-of-bounds write onto the
+        # last prompt position — silently corrupting the prompt
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    total = P + max_new_tokens
+    if cfg.pos == "learned" and total > cfg.max_seq:
+        raise ValueError(
+            f"P + max_new_tokens = {total} exceeds the learned position "
+            f"table ({cfg.max_seq})"
+        )
+    axis_size = 1 if axis is None else jax.lax.axis_size(axis)
+    cache = init_kv_cache(cfg, B, total, axis_size=axis_size)
+
+    cache, logits = forward_cached(params, prompt, cfg, cache, 0, axis)
+    k0 = None
+    if key is not None:
+        key, k0 = jax.random.split(key)
+    first = _sample(_full_logits(logits, cfg, axis), k0, temperature)
+
+    tokens = jnp.zeros((B, total), jnp.int32)
+    tokens = jax.lax.dynamic_update_slice(tokens, prompt.astype(jnp.int32), (0, 0))
+    tokens = jax.lax.dynamic_update_slice(tokens, first[:, None], (0, P))
+
+    def step(carry, i):
+        tokens, cache, key = carry
+        pos = P + i  # position of the token being fed
+        tok = jax.lax.dynamic_slice(tokens, (0, pos), (B, 1))
+        cache, logits = forward_cached(params, tok, cfg, cache, pos, axis)
+        sk = None
+        if key is not None:
+            key, sk = jax.random.split(key)
+        nxt = _sample(_full_logits(logits, cfg, axis), sk, temperature)
+        tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None], (0, pos + 1))
+        return (tokens, cache, key), None
+
+    if max_new_tokens > 1:
+        (tokens, cache, key), _ = jax.lax.scan(
+            step, (tokens, cache, key), jnp.arange(max_new_tokens - 1)
+        )
+    if axis is not None:
+        # every shard computed the identical sequence; pmax re-types the
+        # result as axis-invariant so callers can use out_specs P()
+        tokens = jax.lax.pmax(tokens, axis)
+    return tokens

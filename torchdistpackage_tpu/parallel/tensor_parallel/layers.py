@@ -237,19 +237,14 @@ def block_rope_cache(
     return rope_cache(_rope_positions(cfg, s_attn), cfg.head_dim, cfg.rope_theta)
 
 
-def attention_partial(
+def compute_qkv(
     p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: TransformerConfig,
     rope: "tuple | None" = None,
-) -> jnp.ndarray:
-    """Core attention on the *local* heads; returns the (partial) output
-    projection WITHOUT the TP reduction or output bias — the caller closes the
-    row-parallel region.  Mirrors ``TpAttention`` (attn.py:53-91) where each
-    rank computes ``num_heads // tp_size`` heads.
-
-    x: [B, S, D] — the full sequence, or under context parallelism
-    (attn_impl 'ring'/'ulysses') the context-LOCAL chunk [B, S/cp, D]: the
-    CP op itself sees the rest of the sequence via ppermute/all_to_all over
-    ``cfg.context_axis``.  p['wqkv']: [3, D, H_loc * hd]."""
+):
+    """x [B, S, D] -> rope-rotated (q [B, H_loc, S, hd], k, v
+    [B, Hkv_loc, S, hd]) from either the fused-QKV or the GQA param layout
+    — the projection half of :func:`attention_partial`, shared with the
+    KV-cache prefill (models/generate.py)."""
     B, S, D = x.shape
     hd = cfg.head_dim
     if "wqkv" in p:
@@ -286,6 +281,26 @@ def attention_partial(
             _rope_positions(cfg, S), hd, cfg.rope_theta)
         q = apply_rope(q, cache=cache)
         k = apply_rope(k, cache=cache)
+    return q, k, v
+
+
+def attention_partial(
+    p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: TransformerConfig,
+    rope: "tuple | None" = None,
+) -> jnp.ndarray:
+    """Core attention on the *local* heads; returns the (partial) output
+    projection WITHOUT the TP reduction or output bias — the caller closes the
+    row-parallel region.  Mirrors ``TpAttention`` (attn.py:53-91) where each
+    rank computes ``num_heads // tp_size`` heads.
+
+    x: [B, S, D] — the full sequence, or under context parallelism
+    (attn_impl 'ring'/'ulysses') the context-LOCAL chunk [B, S/cp, D]: the
+    CP op itself sees the rest of the sequence via ppermute/all_to_all over
+    ``cfg.context_axis``.  p['wqkv']: [3, D, H_loc * hd]."""
+    B, S, D = x.shape
+    hd = cfg.head_dim
+    q, k, v = compute_qkv(p, x, cfg, rope=rope)
+    h_loc = q.shape[1]
 
     if cfg.attn_impl == "flash":
         from ...ops.flash_attention import flash_attention
