@@ -15,6 +15,7 @@ from .gpt import (
 )
 from .generate import (
     forward_cached,
+    forward_cached_moe,
     generate,
     init_kv_cache,
 )

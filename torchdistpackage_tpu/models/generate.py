@@ -21,9 +21,12 @@ differ most from training:
 - RoPE rotates at the true global positions (``offset + arange(S_in)``),
   traced, so the rotation is correct at every decode step inside the scan.
 
-MoE decode is deliberately not wired yet (capacity-based routing wants a
-different inference-time dispatch); the dense families — including
-``llama_config`` models (RMSNorm/SwiGLU/RoPE/GQA) — are fully served.
+All families decode: dense GPT, ``llama_config`` models
+(RMSNorm/SwiGLU/RoPE/GQA), and the MoE family — whose inference dispatch
+is the NO-DROP limit of the training router (:func:`forward_cached_moe`:
+capacity raised to >= E/top_k, so token t's routing never depends on what
+other tokens routed — the property that makes incremental decode equal
+the full forward).
 """
 
 from __future__ import annotations
@@ -93,26 +96,45 @@ def cached_block_forward(
     offset,
     axis: Optional[str] = None,
     rope: "tuple | None" = None,
+    ffn=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One pre-LN block with KV caching: writes this call's k/v into the
     cache at ``[offset, offset + S_in)`` and attends against the whole
     buffer.  x: [B, S_in, D].  Returns ``(y, ck, cv)`` with the updated
     cache.  Prefill is S_in=P at offset 0; decode is S_in=1 at offset t —
-    one implementation, both phases."""
+    one implementation, both phases.
+
+    ``ffn``: optional ``(p, h) -> z`` replacing the dense MLP half (h is
+    the post-ln2 activation; z must be the COMPLETE ffn output — no
+    pending TP partial sums) — how the MoE families plug their expert
+    layer into the same cached block."""
     B, S_in, D = x.shape
     h = layer_norm(x, p["ln1"])
     q, k, v = compute_qkv(p["attn"], h, cfg, rope=rope)
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, offset, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, offset, 0))
-    out = _cached_attention(q, ck, cv, offset)
+    if isinstance(offset, int) and offset == 0 and S_in > 1:
+        # prefill: every cached key IS this call's k, so causal attention
+        # over (q, k, v) equals the cache-masked form — and runs the
+        # model's own kernel via the shared core_attention dispatch (flash
+        # on TPU) instead of materializing the [S_in, total] masked score
+        # matrix
+        from ..parallel.tensor_parallel.layers import core_attention
+
+        out = core_attention(q, k, v, cfg)
+    else:
+        out = _cached_attention(q, ck, cv, offset)
     out = out.transpose(0, 2, 1, 3).reshape(B, S_in, q.shape[1] * cfg.head_dim)
     y = out @ p["attn"]["wo"]
     y = _close_row_parallel(y, p["attn"]["bo"], axis, False)
     x = x + y
 
     h = layer_norm(x, p["ln2"])
-    z = mlp_partial(p["mlp"], h)
-    z = _close_row_parallel(z, p["mlp"]["b2"], axis, False)
+    if ffn is None:
+        z = mlp_partial(p["mlp"], h)
+        z = _close_row_parallel(z, p["mlp"]["b2"], axis, False)
+    else:
+        z = ffn(p, h)
     return x + z, ck, cv
 
 
@@ -166,6 +188,66 @@ def forward_cached(
     return {"k": ck, "v": cv}, logits[:, 0, :]
 
 
+def forward_cached_moe(
+    params: Dict[str, PyTree],
+    tokens: jnp.ndarray,
+    cfg: GPTConfig,
+    cache: Dict[str, jnp.ndarray],
+    offset,
+    axis: Optional[str] = None,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """:func:`forward_cached` for the MoE family (heterogeneous block
+    LIST, expert FFN every moe_every-th block).
+
+    Inference-time dispatch = the NO-DROP limit of the training router:
+    the capacity factor is raised to >= E/top_k so ``ceil(T·k·cf/E) >= T``
+    and no token can be evicted — at serving time every token gets its
+    routed experts, and token t's output never depends on what other
+    tokens (batch rows, or the incremental history) routed.  This is what
+    makes incremental decode == full forward: capacity-based drops are a
+    training-batch interaction that has no incremental equivalent.  Expert
+    params are used UNSHARDED here (ep_axis=None — single-host serving;
+    TP still shards attention heads and the vocab head as in training)."""
+    import dataclasses as _dc
+
+    from ..parallel.moe import moe_forward
+    from .gpt_moe import moe_layer_config
+
+    bcfg = cfg.block
+    mcfg = moe_layer_config(cfg)
+    mcfg = _dc.replace(
+        mcfg,
+        capacity_factor=max(
+            mcfg.capacity_factor, mcfg.num_experts / mcfg.top_k
+        ),
+    )
+    S_in = tokens.shape[1]
+    positions = offset + jnp.arange(S_in)
+    h = _embed_at(params, tokens, positions, axis)
+    rope = (
+        rope_cache(positions, bcfg.head_dim, bcfg.rope_theta)
+        if bcfg.rope
+        else None
+    )
+
+    def moe_ffn(p, hh):
+        z, _aux = moe_forward(
+            p["moe"], hh, mcfg, ep_axis=None, causal=bcfg.causal)
+        return z
+
+    ks, vs = [], []
+    for i, bp in enumerate(params["blocks"]):
+        h, ck, cv = cached_block_forward(
+            bp, h, bcfg, cache["k"][i], cache["v"][i], offset, axis=axis,
+            rope=rope, ffn=moe_ffn if "moe" in bp else None,
+        )
+        ks.append(ck)
+        vs.append(cv)
+    cache = {"k": jnp.stack(ks), "v": jnp.stack(vs)}
+    logits = gpt_head(params, h[:, -1:, :], axis, False)
+    return cache, logits[:, 0, :]
+
+
 def _full_logits(logits: jnp.ndarray, cfg: GPTConfig, axis: Optional[str]):
     """Vocab-local [B, V_local] -> full [B, V] (psum-assembled shard slabs;
     tiny at one position per sequence).  Identity when serial."""
@@ -211,15 +293,17 @@ def generate(
     shard.  Jit the whole call: prefill is one batched forward, then ONE
     ``lax.scan`` of single-token steps — no per-token recompilation.
 
-    Requires ``cfg.moe_experts == 0`` (dense families; see module
-    docstring) and ``P + max_new_tokens <= cfg.max_seq`` for learned
-    positions."""
-    if cfg.moe_experts:
+    MoE configs decode through :func:`forward_cached_moe` (no-drop
+    routing, unsharded experts — its docstring has the semantics).
+    ``P + max_new_tokens <= cfg.max_seq`` for learned positions."""
+    if cfg.attn_impl in ("ring", "ulysses"):
         raise NotImplementedError(
-            "KV-cache decode is wired for the dense families; MoE decode "
-            "needs an inference-time dispatch (no capacity padding) and is "
-            "tracked in docs/ROADMAP.md"
+            "context-parallel decode is not supported: the KV cache is not "
+            "sequence-sharded. attn_impl is a runtime choice — decode a "
+            "CP-trained checkpoint with dataclasses.replace(cfg, "
+            "attn_impl='flash', context_axis=None)"
         )
+    fwd = forward_cached_moe if cfg.moe_experts else forward_cached
     B, P = prompt.shape
     if max_new_tokens < 1:
         # the prefill below would still sample one token and
@@ -235,7 +319,7 @@ def generate(
     axis_size = 1 if axis is None else jax.lax.axis_size(axis)
     cache = init_kv_cache(cfg, B, total, axis_size=axis_size)
 
-    cache, logits = forward_cached(params, prompt, cfg, cache, 0, axis)
+    cache, logits = fwd(params, prompt, cfg, cache, 0, axis)
     k0 = None
     if key is not None:
         key, k0 = jax.random.split(key)
@@ -249,7 +333,7 @@ def generate(
         tokens, cache, key = carry
         pos = P + i  # position of the token being fed
         tok = jax.lax.dynamic_slice(tokens, (0, pos), (B, 1))
-        cache, logits = forward_cached(params, tok, cfg, cache, pos, axis)
+        cache, logits = fwd(params, tok, cfg, cache, pos, axis)
         sk = None
         if key is not None:
             key, sk = jax.random.split(key)

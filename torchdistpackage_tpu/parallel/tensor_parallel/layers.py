@@ -301,28 +301,37 @@ def attention_partial(
     hd = cfg.head_dim
     q, k, v = compute_qkv(p, x, cfg, rope=rope)
     h_loc = q.shape[1]
+    out = core_attention(q, k, v, cfg)
+    out = out.transpose(0, 2, 1, 3).reshape(B, S, h_loc * hd)
+    return out @ p["wo"]  # [B,S,D] — partial sum across TP shards
 
+
+def core_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg: TransformerConfig
+) -> jnp.ndarray:
+    """(q, k, v) [B, H(kv), S, hd] -> out [B, H, S, hd] via the configured
+    kernel — the ONE ``attn_impl`` dispatch switch, shared by
+    :func:`attention_partial` and the KV-cache prefill
+    (models/generate.py), so a new impl cannot be wired in one place and
+    silently fall back in the other."""
     if cfg.attn_impl == "flash":
         from ...ops.flash_attention import flash_attention
 
-        out = flash_attention(q, k, v, causal=cfg.causal)
-    elif cfg.attn_impl == "ring":
+        return flash_attention(q, k, v, causal=cfg.causal)
+    if cfg.attn_impl == "ring":
         from ...ops.ring_attention import ring_attention
 
-        out = ring_attention(
+        return ring_attention(
             q, k, v, axis=cfg.context_axis, causal=cfg.causal,
             layout=cfg.cp_layout,
         )
-    elif cfg.attn_impl == "ulysses":
+    if cfg.attn_impl == "ulysses":
         from ...ops.ring_attention import ulysses_attention
 
-        out = ulysses_attention(q, k, v, axis=cfg.context_axis, causal=cfg.causal)
-    else:
-        from ...ops.flash_attention import mha_reference
+        return ulysses_attention(q, k, v, axis=cfg.context_axis, causal=cfg.causal)
+    from ...ops.flash_attention import mha_reference
 
-        out = mha_reference(q, k, v, causal=cfg.causal)
-    out = out.transpose(0, 2, 1, 3).reshape(B, S, h_loc * hd)
-    return out @ p["wo"]  # [B,S,D] — partial sum across TP shards
+    return mha_reference(q, k, v, causal=cfg.causal)
 
 
 def mlp_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
