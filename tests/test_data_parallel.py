@@ -168,9 +168,11 @@ def test_int8_ring_pmean_bounded_error(devices8):
         # compose with TP/PP under check_vma) — pvary back to per-rank form
         # so the test can fetch every rank's copy and prove bit-identity of
         # the VALUES too, not just trust the type
-        approx = jax.lax.pvary(approx, "data")
+        from torchdistpackage_tpu.parallel.data_parallel import _mark_varying
+
+        approx = _mark_varying(approx, ("data",))
         exact = jax.lax.pmean(local[0], "data")
-        exact = jax.lax.pvary(exact, "data")
+        exact = _mark_varying(exact, ("data",))
         return approx[None], exact[None]
 
     approx, exact = jax.jit(

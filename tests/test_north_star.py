@@ -43,3 +43,18 @@ def test_moe_flagship_traces_on_64_device_mesh():
         assert r is None or 2.0 < r["params_b"] < 4.5
     finally:
         sys.path.remove(str(REPO))
+
+
+def test_llama_7b_traces_on_64_device_mesh():
+    """The modern-decoder counterpart: Llama3-8B-class (GQA kv8, SwiGLU,
+    RoPE, RMSNorm via llama_config) under the same hybrid ZeRO x
+    interleaved 1F1B x TP=8+SP x DP=4 64-device layout — the structural
+    norm/act dispatch type-checked at real scale."""
+    sys.path.insert(0, str(REPO))
+    try:
+        import __graft_entry__ as g
+
+        r = g.trace_llama_7b()
+        assert r is None or 6.5 < r["params_b"] < 8.0
+    finally:
+        sys.path.remove(str(REPO))
