@@ -179,3 +179,38 @@ def test_max_new_tokens_guard():
     prompt = jnp.zeros((1, 4), jnp.int32)
     with pytest.raises(ValueError, match="max_new_tokens"):
         generate(params, prompt, GPT_CFG, max_new_tokens=0)
+
+
+def test_top_k_and_top_p_sampling():
+    """Sampled tokens must stay inside the filter's support: with top_k=3
+    every generated token is among the full forward's 3 highest logits at
+    that position; top_p->0 and top_k=1 both degrade to greedy exactly."""
+    params = init_gpt_params(jax.random.PRNGKey(0), GPT_CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, PROMPT), 0, 64)
+
+    out = jax.jit(
+        lambda p, t, k: generate(p, t, GPT_CFG, max_new_tokens=NEW, key=k,
+                                 temperature=1.5, top_k=3)
+    )(params, prompt, jax.random.PRNGKey(5))
+    toks = np.asarray(out)
+    for j in range(PROMPT, PROMPT + NEW):
+        logits = np.asarray(
+            gpt_forward(params, jnp.asarray(toks[:, :j]), GPT_CFG)[:, -1, :]
+        )
+        top3 = np.argsort(logits, axis=-1)[:, -3:]
+        for b in range(B):
+            assert toks[b, j] in top3[b], (b, j, toks[b, j], top3[b])
+
+    greedy = generate(params, prompt, GPT_CFG, max_new_tokens=NEW)
+    k1 = generate(params, prompt, GPT_CFG, max_new_tokens=NEW,
+                  key=jax.random.PRNGKey(5), top_k=1)
+    p0 = generate(params, prompt, GPT_CFG, max_new_tokens=NEW,
+                  key=jax.random.PRNGKey(6), top_p=1e-9)
+    pz = generate(params, prompt, GPT_CFG, max_new_tokens=NEW,
+                  key=jax.random.PRNGKey(6), top_p=0.0)  # the edge itself
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+    np.testing.assert_array_equal(np.asarray(p0), np.asarray(greedy))
+    np.testing.assert_array_equal(np.asarray(pz), np.asarray(greedy))
+    with pytest.raises(ValueError, match="top_k"):
+        generate(params, prompt, GPT_CFG, max_new_tokens=2,
+                 key=jax.random.PRNGKey(6), top_k=0)

@@ -264,14 +264,46 @@ def _sample(
     logits: jnp.ndarray,
     key: Optional[jax.Array],
     temperature: float,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> jnp.ndarray:
-    """Greedy argmax when ``key`` is None, else temperature sampling.  On
-    full [B, V] logits, so TP shards make the identical choice."""
+    """Greedy argmax when ``key`` is None, else temperature sampling with
+    optional top-k and/or top-p (nucleus) filtering.  On full [B, V]
+    logits, so TP shards make the identical choice.
+
+    Filter order is the standard one: temperature -> top-k -> top-p.
+    Masked logits become -inf (zero probability after softmax); top-p
+    keeps the SMALLEST prefix of the probability-sorted vocab whose mass
+    reaches ``top_p`` (the argmax always survives, so top_p -> 0 degrades
+    to greedy rather than an empty support)."""
     if key is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        key, logits.astype(jnp.float32) / temperature, axis=-1
-    ).astype(jnp.int32)
+    x = logits.astype(jnp.float32) / temperature
+    V = x.shape[-1]
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    neg = jnp.array(-jnp.inf, x.dtype)
+    need_k = top_k is not None and top_k < V
+    need_p = top_p is not None and top_p < 1.0
+    if need_k or need_p:
+        sorted_x = jnp.sort(x, axis=-1)[..., ::-1]  # ONE descending sort
+        if need_k:
+            x = jnp.where(x < sorted_x[..., top_k - 1][..., None], neg, x)
+            # the filtered distribution's descending sort, for the nucleus
+            sorted_x = jnp.where(jnp.arange(V) < top_k, sorted_x, neg)
+        if need_p:
+            probs = jax.nn.softmax(sorted_x, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep ranks whose PRECEDING mass is < top_p; rank 0 is kept
+            # unconditionally so top_p -> 0 really is greedy (strict '<'
+            # alone would empty the support at top_p == 0.0)
+            keep = jnp.roll(cum, 1, axis=-1).at[..., 0].set(0.0) < top_p
+            keep = keep.at[..., 0].set(True)
+            cutoff = jnp.min(
+                jnp.where(keep, sorted_x, jnp.inf), axis=-1, keepdims=True
+            )
+            x = jnp.where(x < cutoff, neg, x)
+    return jax.random.categorical(key, x, axis=-1).astype(jnp.int32)
 
 
 def generate(
@@ -282,9 +314,12 @@ def generate(
     axis: Optional[str] = None,
     key: Optional[jax.Array] = None,
     temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> jnp.ndarray:
     """Autoregressively extend ``prompt`` [B, P] by ``max_new_tokens``.
-    Greedy when ``key`` is None, else temperature sampling.  Returns
+    Greedy when ``key`` is None, else temperature sampling with optional
+    ``top_k`` / ``top_p`` (nucleus) filtering (:func:`_sample`).  Returns
     [B, P + max_new_tokens] (prompt included).
 
     Serial when ``axis`` is None; under TP call inside shard_map with the
@@ -323,7 +358,8 @@ def generate(
     k0 = None
     if key is not None:
         key, k0 = jax.random.split(key)
-    first = _sample(_full_logits(logits, cfg, axis), k0, temperature)
+    first = _sample(
+        _full_logits(logits, cfg, axis), k0, temperature, top_k, top_p)
 
     tokens = jnp.zeros((B, total), jnp.int32)
     tokens = jax.lax.dynamic_update_slice(tokens, prompt.astype(jnp.int32), (0, 0))
@@ -337,7 +373,8 @@ def generate(
         sk = None
         if key is not None:
             key, sk = jax.random.split(key)
-        nxt = _sample(_full_logits(logits, cfg, axis), sk, temperature)
+        nxt = _sample(
+            _full_logits(logits, cfg, axis), sk, temperature, top_k, top_p)
         tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None], (0, pos + 1))
         return (tokens, cache, key), None
 
