@@ -13,6 +13,10 @@ from .gpt import (
     vocab_parallel_embed,
     vocab_parallel_xent,
 )
+from .convert import (
+    from_hf_llama,
+    llama_config_from_hf,
+)
 from .generate import (
     forward_cached,
     forward_cached_moe,

@@ -276,16 +276,20 @@ def _sample(
     keeps the SMALLEST prefix of the probability-sorted vocab whose mass
     reaches ``top_p`` (the argmax always survives, so top_p -> 0 degrades
     to greedy rather than an empty support)."""
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
     if key is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     x = logits.astype(jnp.float32) / temperature
     V = x.shape[-1]
-    if top_k is not None and top_k < 1:
-        raise ValueError(f"top_k must be >= 1, got {top_k}")
     neg = jnp.array(-jnp.inf, x.dtype)
     need_k = top_k is not None and top_k < V
     need_p = top_p is not None and top_p < 1.0
-    if need_k or need_p:
+    if need_k and not need_p:
+        # O(V·k) threshold; the full sort is only needed for the nucleus
+        kth = jax.lax.top_k(x, top_k)[0][..., -1:]
+        x = jnp.where(x < kth, neg, x)
+    elif need_k or need_p:
         sorted_x = jnp.sort(x, axis=-1)[..., ::-1]  # ONE descending sort
         if need_k:
             x = jnp.where(x < sorted_x[..., top_k - 1][..., None], neg, x)
