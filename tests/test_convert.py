@@ -106,11 +106,95 @@ def test_attention_bias_checkpoint_loads_biases():
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
-def test_rope_scaling_rejected():
+def test_unsupported_rope_scaling_rejected():
     cfg = transformers.LlamaConfig(
         vocab_size=128, hidden_size=64, intermediate_size=96,
         num_hidden_layers=2, num_attention_heads=4,
-        rope_scaling={"rope_type": "linear", "factor": 2.0},
+        rope_scaling={"rope_type": "yarn", "factor": 2.0,
+                      "original_max_position_embeddings": 16},
     )
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         llama_config_from_hf(cfg)
+
+
+@pytest.mark.parametrize("scaling", [
+    {"rope_type": "llama3", "factor": 4.0, "low_freq_factor": 1.0,
+     "high_freq_factor": 4.0, "original_max_position_embeddings": 16},
+    {"rope_type": "linear", "factor": 2.0},
+], ids=["llama3", "linear"])
+def test_rope_scaling_logits_parity(scaling):
+    """Llama-3.1-style (and position-interpolation) rope scaling must
+    reproduce the HF forward — _scaled_inv_freq vs transformers'
+    _compute_llama3_parameters, checked through full logits."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        attention_bias=False, tie_word_embeddings=False,
+        rope_scaling=dict(scaling),
+    )
+    torch.manual_seed(5)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    tokens = np.random.RandomState(6).randint(0, 128, size=(B, 48))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens)).logits.numpy()
+    mcfg, params = from_hf_llama(
+        hf.state_dict(), hf_config=hf.config, dtype=jnp.float32)
+    assert mcfg.rope_scaling is not None
+    got = np.asarray(
+        jax.jit(lambda p, t: gpt_forward(p, t, mcfg))(params, jnp.asarray(tokens))
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_gpt2_logits_parity():
+    """The GPT family checked against transformers' GPT-2: learned
+    positions, LN, fused QKV, gelu_new == jax.nn.gelu(approximate) — full
+    logits parity plus greedy-decode equality."""
+    from torchdistpackage_tpu.models import from_hf_gpt2
+
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        n_inner=None, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(7)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    tokens = np.random.RandomState(8).randint(0, 128, size=(B, S))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens)).logits.numpy()
+    mcfg, params = from_hf_gpt2(
+        hf.state_dict(), hf_config=hf.config, dtype=jnp.float32)
+    assert mcfg.pos == "learned" and mcfg.norm == "layer" and mcfg.act == "gelu"
+    got = np.asarray(
+        jax.jit(lambda p, t: gpt_forward(p, t, mcfg))(params, jnp.asarray(tokens))
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    prompt = tokens[:1, :8]
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.from_numpy(prompt), max_new_tokens=10, do_sample=False,
+            num_beams=1, pad_token_id=0,
+        ).numpy()
+    ours = np.asarray(jax.jit(
+        lambda p, t: generate(p, t, mcfg, max_new_tokens=10)
+    )(params, jnp.asarray(prompt)))
+    np.testing.assert_array_equal(ours, hf_out)
+
+
+def test_nonstandard_variants_rejected():
+    lcfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, hidden_act="gelu")
+    with pytest.raises(NotImplementedError, match="hidden_act"):
+        llama_config_from_hf(lcfg)
+    from torchdistpackage_tpu.models import gpt2_config_from_hf
+
+    g1 = transformers.GPT2Config(vocab_size=128, n_embd=64, n_layer=2,
+                                 n_head=4, activation_function="gelu")
+    with pytest.raises(NotImplementedError, match="activation_function"):
+        gpt2_config_from_hf(g1)
+    g2 = transformers.GPT2Config(vocab_size=128, n_embd=64, n_layer=2,
+                                 n_head=4, scale_attn_by_inverse_layer_idx=True)
+    with pytest.raises(NotImplementedError, match="scale_attn"):
+        gpt2_config_from_hf(g2)

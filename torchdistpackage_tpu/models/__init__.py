@@ -14,7 +14,9 @@ from .gpt import (
     vocab_parallel_xent,
 )
 from .convert import (
+    from_hf_gpt2,
     from_hf_llama,
+    gpt2_config_from_hf,
     llama_config_from_hf,
 )
 from .generate import (

@@ -25,8 +25,10 @@ golden in tests/test_convert.py):
   zeros; ``attention_bias=True`` / ``mlp_bias=True`` checkpoints
   (Qwen-style architectures served through LlamaForCausalLM) DO carry
   bias tensors and they are loaded into the framework's bias leaves.
-- ``rope_scaling`` (Llama-3.x long-context scaling) is NOT implemented;
-  the import refuses such configs rather than silently diverging.
+- ``rope_scaling`` of type 'llama3' (Llama-3.1 long-context) and
+  'linear' (position interpolation) import and match HF (logits golden);
+  other types ('dynamic', 'yarn') are refused rather than silently
+  diverging.
 
 No torch import at module scope: tensors are duck-typed through
 ``_np`` (works with torch tensors, numpy arrays, or anything exposing
@@ -68,13 +70,26 @@ def llama_config_from_hf(hf_cfg, dtype: Any = jnp.bfloat16) -> GPTConfig:
     :func:`llama_config` preset (RMSNorm + SwiGLU + RoPE, GQA when the
     checkpoint uses it)."""
     scaling = getattr(hf_cfg, "rope_scaling", None)
-    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
-        # Llama-3.x checkpoints ship rope_scaling={'rope_type': 'llama3',...};
-        # importing one with unscaled inv_freq would silently diverge from
-        # the HF forward — refuse instead
+    if scaling:
+        kind = scaling.get("rope_type", scaling.get("type"))
+        if kind == "default":
+            scaling = None
+        elif kind not in ("linear", "llama3"):
+            # e.g. 'dynamic'/'yarn': importing with wrong inv_freq would
+            # silently diverge from the HF forward — refuse instead
+            raise NotImplementedError(
+                f"rope_scaling={scaling!r} is not supported; 'linear' and "
+                f"'llama3' import (tensor_parallel.layers._scaled_inv_freq)"
+            )
+    act = getattr(hf_cfg, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        # LlamaConfig permits any ACT2FN key; the framework's swiglu gates
+        # with silu — importing a gelu-gated derivative would silently
+        # compute wrong MLPs (same refuse-rather-than-diverge policy as
+        # rope_scaling above)
         raise NotImplementedError(
-            f"rope_scaling={scaling!r} is not supported by apply_rope yet; "
-            f"only unscaled rope (rope_scaling None/default) imports"
+            f"hidden_act={act!r}: the Llama import supports silu-gated "
+            f"MLPs only"
         )
     kv = getattr(hf_cfg, "num_key_value_heads", None) or hf_cfg.num_attention_heads
     return llama_config(
@@ -86,6 +101,7 @@ def llama_config_from_hf(hf_cfg, dtype: Any = jnp.bfloat16) -> GPTConfig:
         kv_heads=None if kv == hf_cfg.num_attention_heads else kv,
         ffn_hidden=hf_cfg.intermediate_size,
         rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)),
+        rope_scaling=dict(scaling) if scaling else None,
         dtype=dtype,
     )
 
@@ -185,5 +201,102 @@ def from_hf_llama(
         "blocks": stacked,
         "ln_f": {"scale": jnp.asarray(get("model.norm.weight"), dt)},
         "head": jnp.asarray(head, dt),
+    }
+    return cfg, params
+
+
+def gpt2_config_from_hf(hf_cfg, dtype: Any = jnp.float32) -> GPTConfig:
+    """Map a ``transformers.GPT2Config`` to the framework's GPT family
+    (learned positions, LayerNorm, gelu — the defaults)."""
+    act = getattr(hf_cfg, "activation_function", "gelu_new")
+    if act != "gelu_new":
+        # jax.nn.gelu's default IS the tanh approximation (gelu_new);
+        # 'gelu' (exact erf) or others would silently diverge
+        raise NotImplementedError(
+            f"activation_function={act!r}: the GPT-2 import matches "
+            f"gelu_new only"
+        )
+    for flag in ("scale_attn_by_inverse_layer_idx", "reorder_and_upcast_attn"):
+        if getattr(hf_cfg, flag, False):
+            raise NotImplementedError(
+                f"{flag}=True changes the attention math; the import "
+                f"supports the standard 1/sqrt(hd) scaling only"
+            )
+    return GPTConfig(
+        vocab_size=hf_cfg.vocab_size,
+        dim=hf_cfg.n_embd,
+        nheads=hf_cfg.n_head,
+        nlayers=hf_cfg.n_layer,
+        max_seq=hf_cfg.n_positions,
+        ffn_hidden=hf_cfg.n_inner or 4 * hf_cfg.n_embd,
+        dtype=dtype,
+    )
+
+
+def from_hf_gpt2(
+    state_dict: Mapping[str, Any],
+    cfg: Optional[GPTConfig] = None,
+    hf_config=None,
+    dtype: Any = None,
+) -> Tuple[GPTConfig, Dict[str, PyTree]]:
+    """HF ``GPT2LMHeadModel`` weights -> ``(cfg, params)``.
+
+    GPT-2 is the framework's default family verbatim: learned positions,
+    pre-LN blocks, fused QKV, gelu (HF's ``gelu_new`` tanh approximation
+    == ``jax.nn.gelu``'s default), tied lm_head.  HF stores linears as
+    ``Conv1D`` with ``[in, out]`` weights — the framework's layout, so no
+    transposes; the fused ``c_attn`` [D, 3D] splits into the stacked
+    [3, D, D] ``wqkv`` directly.  Logits-parity golden:
+    tests/test_convert.py::test_hf_gpt2_logits_parity."""
+    if cfg is None:
+        if hf_config is None:
+            raise ValueError("pass cfg or hf_config")
+        cfg = gpt2_config_from_hf(hf_config, dtype=dtype or jnp.float32)
+    dt = dtype or cfg.dtype
+    D, L = cfg.dim, cfg.nlayers
+    F = cfg.block.ffn_dim
+
+    def get(name):
+        # HF serializes with and without the "transformer." prefix
+        if name in state_dict:
+            return _np(state_dict[name])
+        return _np(state_dict["transformer." + name])
+
+    blocks = []
+    for i in range(L):
+        pre = f"h.{i}."
+        ca = get(pre + "attn.c_attn.weight")  # [D, 3D], q|k|v on the out dim
+        assert ca.shape == (D, 3 * D), ca.shape
+        blocks.append({
+            "ln1": {"scale": get(pre + "ln_1.weight"),
+                    "bias": get(pre + "ln_1.bias")},
+            "attn": {
+                "wqkv": np.stack(np.split(ca, 3, axis=1)),  # [3, D, D]
+                "bqkv": get(pre + "attn.c_attn.bias").reshape(3, D),
+                "wo": get(pre + "attn.c_proj.weight"),
+                "bo": get(pre + "attn.c_proj.bias"),
+            },
+            "ln2": {"scale": get(pre + "ln_2.weight"),
+                    "bias": get(pre + "ln_2.bias")},
+            "mlp": {
+                "w1": get(pre + "mlp.c_fc.weight"),  # [D, F]
+                "b1": get(pre + "mlp.c_fc.bias"),
+                "w2": get(pre + "mlp.c_proj.weight"),  # [F, D]
+                "b2": get(pre + "mlp.c_proj.bias"),
+            },
+        })
+
+    stacked = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs), dt), *blocks)
+    emb = get("wte.weight")
+    params = {
+        "tok_emb": jnp.asarray(emb, dt),
+        "pos_emb": jnp.asarray(get("wpe.weight"), dt),
+        "blocks": stacked,
+        "ln_f": {"scale": jnp.asarray(get("ln_f.weight"), dt),
+                 "bias": jnp.asarray(get("ln_f.bias"), dt)},
+        # GPT-2 ties the head to the embedding
+        "head": jnp.asarray(
+            _np(state_dict["lm_head.weight"]).T
+            if "lm_head.weight" in state_dict else emb.T, dt),
     }
     return cfg, params

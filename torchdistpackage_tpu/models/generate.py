@@ -169,7 +169,8 @@ def forward_cached(
     positions = offset + jnp.arange(S_in)
     h = _embed_at(params, tokens, positions, axis)
     rope = (
-        rope_cache(positions, bcfg.head_dim, bcfg.rope_theta)
+        rope_cache(positions, bcfg.head_dim, bcfg.rope_theta,
+                   scaling=bcfg.rope_scaling)
         if bcfg.rope
         else None
     )
@@ -225,7 +226,8 @@ def forward_cached_moe(
     positions = offset + jnp.arange(S_in)
     h = _embed_at(params, tokens, positions, axis)
     rope = (
-        rope_cache(positions, bcfg.head_dim, bcfg.rope_theta)
+        rope_cache(positions, bcfg.head_dim, bcfg.rope_theta,
+                   scaling=bcfg.rope_scaling)
         if bcfg.rope
         else None
     )

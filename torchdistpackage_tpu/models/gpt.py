@@ -74,6 +74,9 @@ class GPTConfig:
     # composes with CP (chunk-offset/zigzag positions) and GQA.
     pos: str = "learned"
     rope_theta: float = 10000.0
+    # optional 'linear'/'llama3' rope-scaling dict (long-context
+    # checkpoints; see tensor_parallel.layers._scaled_inv_freq)
+    rope_scaling: "dict | None" = None
     # 'layer' | 'rms' and 'gelu' | 'swiglu' — the Llama family is
     # norm='rms', act='swiglu', pos='rope' (see :func:`llama_config`);
     # both are carried structurally by the param tree
@@ -133,6 +136,7 @@ class GPTConfig:
             kv_heads=self.kv_heads,
             rope=self.pos == "rope",
             rope_theta=self.rope_theta,
+            rope_scaling=self.rope_scaling,
             norm=self.norm,
             act=self.act,
             ffn_hidden=self.ffn_hidden,
@@ -163,6 +167,7 @@ def llama_config(
     kv_heads: Optional[int] = None,
     ffn_hidden: Optional[int] = None,
     rope_theta: float = 10000.0,
+    rope_scaling: "dict | None" = None,
     dtype: Any = jnp.bfloat16,
     **kw,
 ) -> GPTConfig:
@@ -189,6 +194,7 @@ def llama_config(
         ffn_hidden=ffn_hidden,
         pos="rope",
         rope_theta=rope_theta,
+        rope_scaling=rope_scaling,
         norm="rms",
         act="swiglu",
         dtype=dtype,

@@ -78,6 +78,11 @@ class TransformerConfig:
     # learned pos_emb table when this is on.
     rope: bool = False
     rope_theta: float = 10000.0
+    # optional rope-scaling dict ('linear' or 'llama3' — see
+    # _scaled_inv_freq); carried verbatim from HF configs by
+    # models/convert.py.  NB a dict field makes the (frozen) config
+    # unhashable — nothing in the package hashes configs.
+    rope_scaling: "dict | None" = None
     # 'layer' (LayerNorm, scale+bias) | 'rms' (RMSNorm, scale only — the
     # Llama-family norm).  The choice is carried STRUCTURALLY by the param
     # tree: rms norm params have no 'bias' leaf and :func:`layer_norm`
@@ -98,6 +103,13 @@ class TransformerConfig:
             raise ValueError(f"norm must be 'layer' or 'rms', got {self.norm!r}")
         if self.act not in ("gelu", "swiglu"):
             raise ValueError(f"act must be 'gelu' or 'swiglu', got {self.act!r}")
+        if self.rope_scaling is not None:
+            kind = self.rope_scaling.get(
+                "rope_type", self.rope_scaling.get("type"))
+            if kind not in _ROPE_SCALING_TYPES:
+                raise NotImplementedError(
+                    f"rope_scaling type {kind!r}; supported: "
+                    f"{_ROPE_SCALING_TYPES}")
 
     @property
     def head_dim(self) -> int:
@@ -171,16 +183,50 @@ def norm_param_specs(norm: str = "layer") -> Dict[str, P]:
     return out
 
 
+_ROPE_SCALING_TYPES = ("linear", "llama3")
+
+
+def _scaled_inv_freq(inv_freq: jnp.ndarray, scaling: dict) -> jnp.ndarray:
+    """Apply a rope-scaling recipe to the base inverse frequencies.
+
+    'linear' (position interpolation): every frequency / factor.
+    'llama3' (Llama-3.1 long-context): frequencies whose wavelength exceeds
+    ``original_max_position_embeddings / low_freq_factor`` divide by
+    ``factor``, short wavelengths stay, the band between interpolates
+    smoothly — matches transformers' ``_compute_llama3_parameters``
+    exactly (verified by the HF logits golden in tests/test_convert.py).
+    """
+    kind = scaling.get("rope_type", scaling.get("type"))
+    factor = float(scaling["factor"])
+    if kind == "linear":
+        return inv_freq / factor
+    if kind != "llama3":
+        raise NotImplementedError(f"rope_scaling type {kind!r}")
+    lo = float(scaling["low_freq_factor"])
+    hi = float(scaling["high_freq_factor"])
+    old_len = float(scaling["original_max_position_embeddings"])
+    wavelen = 2.0 * math.pi / inv_freq
+    scaled = jnp.where(wavelen > old_len / lo, inv_freq / factor, inv_freq)
+    smooth = (old_len / wavelen - lo) / (hi - lo)
+    smoothed = (1.0 - smooth) * scaled / factor + smooth * scaled
+    medium = (wavelen >= old_len / hi) & (wavelen <= old_len / lo)
+    return jnp.where(medium, smoothed, scaled)
+
+
 def rope_cache(
-    pos: jnp.ndarray, head_dim: int, theta: float = 10000.0
+    pos: jnp.ndarray, head_dim: int, theta: float = 10000.0,
+    scaling: "dict | None" = None,
 ):
     """(cos, sin) tables [1, 1, S, hd/2] for :func:`apply_rope` — compute
     once per forward (they are layer-invariant) and reuse across the block
     stack; ``scan_blocks`` hoists them out of the scan body as closed-over
-    loop constants."""
+    loop constants.  ``scaling``: optional rope-scaling dict
+    (:func:`_scaled_inv_freq` — 'linear' or 'llama3')."""
     assert head_dim % 2 == 0, f"rope needs an even head_dim, got {head_dim}"
     half = head_dim // 2
     inv_freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if scaling is not None:
+        inv_freq = _scaled_inv_freq(inv_freq, scaling)
     ang = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [S, half]
     return jnp.cos(ang)[None, None], jnp.sin(ang)[None, None]
 
@@ -234,7 +280,8 @@ def block_rope_cache(
     s_attn = s_local
     if axis is not None and sp:
         s_attn = s_attn * jax.lax.axis_size(axis)
-    return rope_cache(_rope_positions(cfg, s_attn), cfg.head_dim, cfg.rope_theta)
+    return rope_cache(_rope_positions(cfg, s_attn), cfg.head_dim,
+                      cfg.rope_theta, scaling=cfg.rope_scaling)
 
 
 def compute_qkv(
@@ -278,7 +325,8 @@ def compute_qkv(
         # ``rope`` is the precomputed (cos, sin) cache (layer-invariant —
         # scan_blocks hoists it); self-compute when called standalone
         cache = rope if rope is not None else rope_cache(
-            _rope_positions(cfg, S), hd, cfg.rope_theta)
+            _rope_positions(cfg, S), hd, cfg.rope_theta,
+            scaling=cfg.rope_scaling)
         q = apply_rope(q, cache=cache)
         k = apply_rope(k, cache=cache)
     return q, k, v
