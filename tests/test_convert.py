@@ -198,3 +198,46 @@ def test_nonstandard_variants_rejected():
                                  n_head=4, scale_attn_by_inverse_layer_idx=True)
     with pytest.raises(NotImplementedError, match="scale_attn"):
         gpt2_config_from_hf(g2)
+
+
+@pytest.mark.parametrize("family", ["mistral", "qwen2"])
+def test_llama_architecture_variants_parity(family):
+    """Mistral and Qwen2 are Llama-architecture models (same module names;
+    Qwen2 adds attention biases) — they import through from_hf_llama with
+    full logits parity.  Sliding-window checkpoints are refused."""
+    if family == "mistral":
+        cfg = transformers.MistralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rms_norm_eps=1e-5,
+            sliding_window=None, tie_word_embeddings=False)
+        hf = transformers.MistralForCausalLM(cfg)
+    else:
+        cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rms_norm_eps=1e-5,
+            use_sliding_window=False, tie_word_embeddings=False)
+        hf = transformers.Qwen2ForCausalLM(cfg)
+    torch.manual_seed(9)
+    hf = hf.eval()
+    for _, p_ in hf.named_parameters():  # re-randomize incl. qwen's biases
+        with torch.no_grad():
+            p_.normal_(0.0, 0.05)
+    tokens = np.random.RandomState(10).randint(0, 128, size=(B, S))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens)).logits.numpy()
+    mcfg, params = from_hf_llama(
+        hf.state_dict(), hf_config=hf.config, dtype=jnp.float32)
+    got = np.asarray(
+        jax.jit(lambda p, t: gpt_forward(p, t, mcfg))(params, jnp.asarray(tokens))
+    )
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_sliding_window_rejected():
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, sliding_window=32)
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        llama_config_from_hf(cfg)

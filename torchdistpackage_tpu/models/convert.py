@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..parallel.tensor_parallel.layers import _ROPE_SCALING_TYPES
 from .gpt import GPTConfig, llama_config
 
 PyTree = Any
@@ -68,19 +69,32 @@ def _np(t) -> np.ndarray:
 def llama_config_from_hf(hf_cfg, dtype: Any = jnp.bfloat16) -> GPTConfig:
     """Map a ``transformers.LlamaConfig`` to the framework's
     :func:`llama_config` preset (RMSNorm + SwiGLU + RoPE, GQA when the
-    checkpoint uses it)."""
+    checkpoint uses it).  The Llama ARCHITECTURE family all imports
+    through here: Mistral (sliding_window=None) and Qwen2 (attention
+    biases load into the framework's bias leaves) use the same module
+    names and conventions — parity goldens in tests/test_convert.py."""
     scaling = getattr(hf_cfg, "rope_scaling", None)
     if scaling:
         kind = scaling.get("rope_type", scaling.get("type"))
         if kind == "default":
             scaling = None
-        elif kind not in ("linear", "llama3"):
+        elif kind not in _ROPE_SCALING_TYPES:
             # e.g. 'dynamic'/'yarn': importing with wrong inv_freq would
             # silently diverge from the HF forward — refuse instead
             raise NotImplementedError(
                 f"rope_scaling={scaling!r} is not supported; 'linear' and "
                 f"'llama3' import (tensor_parallel.layers._scaled_inv_freq)"
             )
+    sw = getattr(hf_cfg, "sliding_window", None)
+    if sw is not None and getattr(hf_cfg, "use_sliding_window", True):
+        # Mistral/Qwen2-style sliding-window attention is a DIFFERENT
+        # attention pattern; importing it as full attention would silently
+        # diverge at S > window
+        raise NotImplementedError(
+            f"sliding_window={sw}: sliding-window attention is not "
+            f"implemented; import only full-attention checkpoints "
+            f"(sliding_window=None)"
+        )
     act = getattr(hf_cfg, "hidden_act", "silu")
     if act not in ("silu", "swish"):
         # LlamaConfig permits any ACT2FN key; the framework's swiglu gates
@@ -222,6 +236,12 @@ def gpt2_config_from_hf(hf_cfg, dtype: Any = jnp.float32) -> GPTConfig:
                 f"{flag}=True changes the attention math; the import "
                 f"supports the standard 1/sqrt(hd) scaling only"
             )
+    if not getattr(hf_cfg, "scale_attn_weights", True):
+        raise NotImplementedError(
+            "scale_attn_weights=False skips the 1/sqrt(hd) scaling the "
+            "framework always applies; such checkpoints would silently "
+            "diverge"
+        )
     return GPTConfig(
         vocab_size=hf_cfg.vocab_size,
         dim=hf_cfg.n_embd,
@@ -256,17 +276,17 @@ def from_hf_gpt2(
     D, L = cfg.dim, cfg.nlayers
     F = cfg.block.ffn_dim
 
-    def get(name):
+    def get(name, shape=None):
         # HF serializes with and without the "transformer." prefix
-        if name in state_dict:
-            return _np(state_dict[name])
-        return _np(state_dict["transformer." + name])
+        a = _np(state_dict[name]) if name in state_dict else _np(
+            state_dict["transformer." + name])
+        assert shape is None or a.shape == shape, (name, a.shape, shape)
+        return a
 
     blocks = []
     for i in range(L):
         pre = f"h.{i}."
-        ca = get(pre + "attn.c_attn.weight")  # [D, 3D], q|k|v on the out dim
-        assert ca.shape == (D, 3 * D), ca.shape
+        ca = get(pre + "attn.c_attn.weight", (D, 3 * D))  # q|k|v on out dim
         blocks.append({
             "ln1": {"scale": get(pre + "ln_1.weight"),
                     "bias": get(pre + "ln_1.bias")},
@@ -279,10 +299,10 @@ def from_hf_gpt2(
             "ln2": {"scale": get(pre + "ln_2.weight"),
                     "bias": get(pre + "ln_2.bias")},
             "mlp": {
-                "w1": get(pre + "mlp.c_fc.weight"),  # [D, F]
-                "b1": get(pre + "mlp.c_fc.bias"),
-                "w2": get(pre + "mlp.c_proj.weight"),  # [F, D]
-                "b2": get(pre + "mlp.c_proj.bias"),
+                "w1": get(pre + "mlp.c_fc.weight", (D, F)),
+                "b1": get(pre + "mlp.c_fc.bias", (F,)),
+                "w2": get(pre + "mlp.c_proj.weight", (F, D)),
+                "b2": get(pre + "mlp.c_proj.bias", (D,)),
             },
         })
 
