@@ -241,3 +241,67 @@ def test_sliding_window_rejected():
         num_hidden_layers=2, num_attention_heads=4, sliding_window=32)
     with pytest.raises(NotImplementedError, match="sliding_window"):
         llama_config_from_hf(cfg)
+
+
+def test_llama_roundtrip():
+    """Framework-trained Llama weights export to (state_dict, config
+    kwargs) that the real LlamaForCausalLM loads (strict) and computes
+    the SAME logits from — the full serving round trip, including
+    non-default rope_theta carried via the returned config kwargs."""
+    from torchdistpackage_tpu.models import (
+        GPTConfig, init_gpt_params, llama_config, to_hf_llama)
+
+    cfg = llama_config(vocab_size=128, dim=64, nheads=4, nlayers=2,
+                       max_seq=64, kv_heads=2, ffn_hidden=96,
+                       rope_theta=50000.0, dtype=jnp.float32)
+    params = init_gpt_params(jax.random.PRNGKey(11), cfg)
+    sd, kw = to_hf_llama(params, cfg)
+    assert kw["rope_theta"] == 50000.0 and not kw["attention_bias"]
+
+    hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(**kw)).eval()
+    missing, unexpected = hf.load_state_dict(
+        {k: torch.from_numpy(v) for k, v in sd.items()}, strict=True)
+    assert not missing and not unexpected
+
+    tokens = np.random.RandomState(12).randint(0, 128, size=(B, S))
+    want = np.asarray(jax.jit(
+        lambda p, t: gpt_forward(p, t, cfg))(params, jnp.asarray(tokens)))
+    with torch.no_grad():
+        got = hf(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    with pytest.raises(ValueError, match="Llama-family"):
+        to_hf_llama(params, GPTConfig(
+            vocab_size=128, dim=64, nheads=4, nlayers=2, max_seq=64))
+
+
+def test_llama_roundtrip_with_biases():
+    """A Qwen2-imported tree (real attention biases) must export those
+    biases with attention_bias=True — not silently drop them."""
+    from torchdistpackage_tpu.models import to_hf_llama
+
+    qcfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        use_sliding_window=False, tie_word_embeddings=False)
+    torch.manual_seed(13)
+    q = transformers.Qwen2ForCausalLM(qcfg).eval()
+    for _, p_ in q.named_parameters():
+        with torch.no_grad():
+            p_.normal_(0.0, 0.05)
+    cfg, params = from_hf_llama(
+        q.state_dict(), hf_config=q.config, dtype=jnp.float32)
+    sd, kw = to_hf_llama(params, cfg)
+    assert kw["attention_bias"] and not kw["mlp_bias"]
+    assert "model.layers.0.self_attn.q_proj.bias" in sd
+
+    hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(**kw)).eval()
+    hf.load_state_dict(
+        {k: torch.from_numpy(v) for k, v in sd.items()}, strict=True)
+    tokens = np.random.RandomState(14).randint(0, 128, size=(B, S))
+    want = np.asarray(jax.jit(
+        lambda p, t: gpt_forward(p, t, cfg))(params, jnp.asarray(tokens)))
+    with torch.no_grad():
+        got = hf(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)

@@ -18,6 +18,7 @@ from .convert import (
     from_hf_llama,
     gpt2_config_from_hf,
     llama_config_from_hf,
+    to_hf_llama,
 )
 from .generate import (
     forward_cached,

@@ -320,3 +320,100 @@ def from_hf_gpt2(
             if "lm_head.weight" in state_dict else emb.T, dt),
     }
     return cfg, params
+
+
+def to_hf_llama(
+    params: Dict[str, PyTree], cfg: GPTConfig
+) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Inverse of :func:`from_hf_llama`: the framework's param tree (a
+    Llama-family config: rms + swiglu + rope) -> ``(state_dict,
+    hf_config_kwargs)``.
+
+    ``state_dict`` holds numpy arrays for ``LlamaForCausalLM`` and
+    ``hf_config_kwargs`` the MATCHING ``transformers.LlamaConfig``
+    arguments — rope_theta, rope_scaling, rms_norm_eps, attention/mlp
+    bias flags are model semantics that live in the config, not the
+    weights, so serving with a default config would silently diverge::
+
+        sd, kw = to_hf_llama(params, cfg)
+        hf = LlamaForCausalLM(LlamaConfig(**kw))
+        hf.load_state_dict({k: torch.from_numpy(v) for k, v in sd.items()})
+
+    Nonzero bias leaves (e.g. a Qwen2-imported or bias-trained tree)
+    export as the HF bias tensors with ``attention_bias``/``mlp_bias``
+    set; all-zero biases are dropped (Llama proper).  Round-trip golden:
+    tests/test_convert.py::test_llama_roundtrip.  Gather a sharded tree
+    to host first (the arrays are copied to writable numpy)."""
+    if not (cfg.norm == "rms" and cfg.act == "swiglu" and cfg.pos == "rope"):
+        raise ValueError(
+            "to_hf_llama exports Llama-family configs only "
+            f"(norm={cfg.norm!r}, act={cfg.act!r}, pos={cfg.pos!r})"
+        )
+
+    def a(x):
+        # np.array (copy) not asarray: jax buffers export read-only views,
+        # and torch.from_numpy on a non-writable array is undefined-behavior
+        # territory the torch side warns about
+        return np.array(jnp.asarray(x, jnp.float32))
+
+    def nonzero(x):
+        return bool(np.any(a(x) != 0.0))
+
+    blocks = params["blocks"]
+    attn_bias = any(
+        nonzero(blocks["attn"][k])
+        for k in ("bq", "bkv", "bo") if k in blocks["attn"]
+    ) or ("bqkv" in blocks["attn"] and (
+        nonzero(blocks["attn"]["bqkv"]) or nonzero(blocks["attn"]["bo"])))
+    mlp_bias = nonzero(blocks["mlp"]["b1"]) or nonzero(blocks["mlp"]["b2"])
+
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": a(params["tok_emb"]),
+        "model.norm.weight": a(params["ln_f"]["scale"]),
+        "lm_head.weight": a(params["head"]).T,
+    }
+    for i in range(cfg.nlayers):
+        pre = f"model.layers.{i}."
+        bp = jax.tree.map(lambda x: x[i], blocks)
+        at = bp["attn"]
+        if cfg.block.is_gqa:
+            q, k, v = at["wq"], at["wkv"][0], at["wkv"][1]
+            bq, bk, bv = at["bq"], at["bkv"][0], at["bkv"][1]
+        else:
+            q, k, v = at["wqkv"][0], at["wqkv"][1], at["wqkv"][2]
+            bq, bk, bv = at["bqkv"][0], at["bqkv"][1], at["bqkv"][2]
+        sd[pre + "self_attn.q_proj.weight"] = a(q).T
+        sd[pre + "self_attn.k_proj.weight"] = a(k).T
+        sd[pre + "self_attn.v_proj.weight"] = a(v).T
+        sd[pre + "self_attn.o_proj.weight"] = a(at["wo"]).T
+        if attn_bias:
+            sd[pre + "self_attn.q_proj.bias"] = a(bq)
+            sd[pre + "self_attn.k_proj.bias"] = a(bk)
+            sd[pre + "self_attn.v_proj.bias"] = a(bv)
+            sd[pre + "self_attn.o_proj.bias"] = a(at["bo"])
+        sd[pre + "input_layernorm.weight"] = a(bp["ln1"]["scale"])
+        sd[pre + "post_attention_layernorm.weight"] = a(bp["ln2"]["scale"])
+        sd[pre + "mlp.gate_proj.weight"] = a(bp["mlp"]["w1"][0]).T
+        sd[pre + "mlp.up_proj.weight"] = a(bp["mlp"]["w1"][1]).T
+        sd[pre + "mlp.down_proj.weight"] = a(bp["mlp"]["w2"]).T
+        if mlp_bias:
+            sd[pre + "mlp.gate_proj.bias"] = a(bp["mlp"]["b1"][0])
+            sd[pre + "mlp.up_proj.bias"] = a(bp["mlp"]["b1"][1])
+            sd[pre + "mlp.down_proj.bias"] = a(bp["mlp"]["b2"])
+
+    hf_kwargs: Dict[str, Any] = {
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.dim,
+        "intermediate_size": cfg.block.ffn_dim,
+        "num_hidden_layers": cfg.nlayers,
+        "num_attention_heads": cfg.nheads,
+        "num_key_value_heads": cfg.kv_heads or cfg.nheads,
+        "max_position_embeddings": cfg.max_seq,
+        "rms_norm_eps": 1e-5,  # the framework's norm eps
+        "rope_theta": cfg.rope_theta,
+        "rope_scaling": dict(cfg.rope_scaling) if cfg.rope_scaling else None,
+        "attention_bias": attn_bias,
+        "mlp_bias": mlp_bias,
+        "tie_word_embeddings": False,
+    }
+    return sd, hf_kwargs
