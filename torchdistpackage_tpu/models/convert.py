@@ -360,11 +360,10 @@ def to_hf_llama(
         return bool(np.any(a(x) != 0.0))
 
     blocks = params["blocks"]
+    # every attn layout (fused-QKV and GQA) stores its bias leaves under
+    # 'b*' keys, so one scan serves both
     attn_bias = any(
-        nonzero(blocks["attn"][k])
-        for k in ("bq", "bkv", "bo") if k in blocks["attn"]
-    ) or ("bqkv" in blocks["attn"] and (
-        nonzero(blocks["attn"]["bqkv"]) or nonzero(blocks["attn"]["bo"])))
+        nonzero(v) for k, v in blocks["attn"].items() if k.startswith("b"))
     mlp_bias = nonzero(blocks["mlp"]["b1"]) or nonzero(blocks["mlp"]["b2"])
 
     sd: Dict[str, np.ndarray] = {
