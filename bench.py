@@ -104,10 +104,11 @@ LONG_CANDIDATES = [
 # MoE candidates (--moe): GPT-MoE on one chip (EP=1 — expert compute is
 # local; this measures the ROUTING + DISPATCH + expert-FFN leaf the EP
 # all_to_all wraps at scale).  4-tuples: (batch, remat, xent_chunk,
-# dispatch) — the sorted-vs-dense pair at b2 answers docs/ROADMAP.md's
-# open question (is XLA's scatter/gather lowering of the sorted path
-# leaving throughput on the table?) with on-chip numbers; dense at b>=4
-# is untestable (the [T, E, C] one-hots alone exceed HBM).
+# dispatch).  Measured 2026-07-31 (docs/BENCH_AB.md): b8 sorted 66,636
+# tok/s (MFU 0.358 activated) wins; sorted beats dense 10.2% at the
+# identical b2 config — XLA's gather/scatter lowering leaves nothing on
+# the table, so no fused Pallas dispatch kernel is needed.  Dense at
+# b>=4 is untestable (the [T, E, C] one-hots alone exceed HBM).
 MOE_CANDIDATES = [
     (8, "flash", None, "sorted"),
     (16, "flash", None, "sorted"),
