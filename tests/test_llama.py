@@ -234,3 +234,86 @@ def test_mixtral_style_moe_ep_matches_serial(devices8):
         shard_map(ep_loss, mesh=mesh, in_specs=(specs, bspec), out_specs=P())
     )(sharded, b_sh)
     np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
+
+
+def test_llama_zero_interleaved_hybrid_matches_serial(devices8):
+    """The north-star composition on the Llama family: hybrid ZeRO
+    (data_intra master shards) x INTERLEAVED 1F1B (V=2) x DP at tiny
+    shapes — the executed counterpart of trace_llama_7b, mirroring
+    test_zero.py::test_zero_1f1b_hybrid for rms/swiglu/rope/GQA leaves
+    (biasless norms and [V, P, Lc, 2, D, F] SwiGLU masters must ride the
+    ZeRO partition algebra)."""
+    import optax
+
+    from torchdistpackage_tpu.models import (
+        gpt_interleaved_param_specs,
+        interleave_stage_params,
+    )
+    from torchdistpackage_tpu.parallel.zero import ZeroOptimizer
+
+    M, mbs = 4, 2
+    tpc.setup_process_groups([("data", 4), ("pipe", 2)], devices=devices8)
+    view = tpc.build_hybrid_mesh(intra_size=2)
+    flat_params = init_gpt_params(jax.random.PRNGKey(0), CFG)
+    params = interleave_stage_params(flat_params, 2, 2)
+    specs = gpt_interleaved_param_specs(CFG, tp_axis=None)
+    opt = optax.adam(1e-2)
+
+    def vg_fn(p, batch):
+        return gpt_pipeline_1f1b(p, batch, CFG, num_microbatches=M, num_chunks=2)
+
+    zero = ZeroOptimizer(
+        opt, mesh=view, shard_axis="data_intra",
+        grad_reduce_axes=("data_inter", "data_intra"), param_specs=specs,
+    )
+    zp = zero.place_params(params)
+    zs = zero.init(zp)
+    # GQA + rms leaves in the master tree: biasless norm, stacked gate/up
+    assert "bias" not in zs["master"]["ln_f"]
+    assert zs["master"]["blocks"]["mlp"]["w1"].ndim == 6  # [V,P,Lc,2,D,F]
+    step = zero.make_train_step(
+        value_and_grad_fn=vg_fn,
+        batch_spec={
+            "tokens": P(None, ("data_inter", "data_intra")),
+            "targets": P(None, ("data_inter", "data_intra")),
+        },
+    )
+
+    sparams, sstate = flat_params, opt.init(flat_params)
+    from tests.test_zero import _gpt_microbatched_serial_step
+
+    serial_step = _gpt_microbatched_serial_step(CFG, M, opt)
+
+    for i in range(3):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(40 + i))
+        batch = {
+            "tokens": jax.random.randint(k1, (M, mbs * 4, S), 0, CFG.vocab_size),
+            "targets": jax.random.randint(k2, (M, mbs * 4, S), 0, CFG.vocab_size),
+        }
+        sparams, sstate, sloss = serial_step(sparams, sstate, batch)
+        dbatch = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(view, P(None, ("data_inter", "data_intra")))
+            ),
+            batch,
+        )
+        zp, zs, dloss = step(zp, zs, dbatch)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-4, atol=1e-5)
+
+    # atol 5e-5: adam's rsqrt(v)+eps amplifies f32 rounding on near-zero
+    # elements over 3 steps (losses above track to 1e-4 each step; the gpt
+    # twin of this test passes at 1e-5 — rope's trig adds the extra ulps)
+    for name in ["tok_emb", "head"]:
+        np.testing.assert_allclose(
+            np.asarray(zp[name]), np.asarray(sparams[name]),
+            rtol=1e-3, atol=5e-5, err_msg=f"param divergence at {name}",
+        )
+    got_w1 = np.asarray(zp["blocks"]["mlp"]["w1"])
+    got_w1 = got_w1.reshape(-1, *got_w1.shape[3:])  # [V,P,Lc,...] -> [L,...]
+    # rtol 5e-3 for the swiglu gate weights: silu's curvature puts a
+    # couple of elements near adam's eps boundary (observed: 1/12288 at
+    # rel 2.1e-3 after 3 steps with losses tracking to 1e-4)
+    np.testing.assert_allclose(
+        got_w1, np.asarray(sparams["blocks"]["mlp"]["w1"]),
+        rtol=5e-3, atol=5e-5,
+    )
