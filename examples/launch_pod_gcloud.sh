@@ -1,0 +1,19 @@
+#!/bin/bash
+# Cloud-TPU (non-SLURM) pod launch — the gcloud twin of launch_pod.sbatch.
+#
+#     TPU_NAME=my-pod ZONE=us-east5-a ./examples/launch_pod_gcloud.sh
+#
+# `--worker=all` runs the command on every host of the pod slice
+# simultaneously; on Cloud TPU the jax.distributed rendezvous needs NO env
+# plumbing (the TPU runtime supplies coordinator + topology —
+# dist/launch.py path 3), so the same train script works under both
+# launchers unchanged.
+
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:?set TPU_NAME}"
+ZONE="${ZONE:?set ZONE}"
+SCRIPT="${SCRIPT:-examples/train_tp_dp.py}"
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+    --command "cd ~/torchdistpackage_tpu && python -m torchdistpackage_tpu.dist.comm_bench && python $SCRIPT"

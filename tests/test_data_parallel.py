@@ -228,6 +228,7 @@ def test_int8_compressed_training_converges(devices8):
         )
 
 
+@pytest.mark.heavy
 def test_int8_compression_composes_with_tp(devices8):
     """grad_compress='int8' on a (data, tensor) mesh — the hybrid scenario
     where wire bytes matter most (reference Intro.md:69-77) and which the

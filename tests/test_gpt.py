@@ -97,6 +97,7 @@ def test_tp_matches_serial(devices8, params, sp):
         )
 
 
+@pytest.mark.heavy
 def test_tp_sp_pp_dp_training_matches_serial(devices8, params):
     """The full composition: DP=2 x PP=2 x TP=2 (+SP), pipelined GPT loss in a
     DataParallel train step, vs the serial model on the full batch."""
@@ -194,6 +195,7 @@ def _ppermute_bytes(fn, *args):
 
 
 @pytest.mark.parametrize("num_chunks", [1, 2])
+@pytest.mark.heavy
 def test_gpt_1f1b_tp_nosp_sharded_transfers_match_serial(
         devices8, params, num_chunks):
     """The scatter_gather_tensors analogue (reference comm.py:108-155): under
@@ -268,6 +270,7 @@ def test_gpt_1f1b_tp_nosp_sharded_transfers_match_serial(
     assert on * 2 == off, (on, off)
 
 
+@pytest.mark.heavy
 def test_gpt_1f1b_remat_flash_matches_serial(devices8):
     """The remat='flash' policy (save the Pallas kernel's o/lse, skip its
     fwd re-run in backward) under the pipelined stack — scan over the block
@@ -484,6 +487,7 @@ def test_gpt_context_parallel_matches_serial(devices8, params, impl, xent_chunk)
     )
 
 
+@pytest.mark.heavy
 def test_gpt_ring_training_matches_serial(devices8, params):
     """Train the ring-CP GPT over a data x context mesh with DataParallel
     treating BOTH axes as data axes (grads pmean over data AND context);
@@ -536,6 +540,7 @@ def test_gpt_ring_training_matches_serial(devices8, params):
         )
 
 
+@pytest.mark.heavy
 def test_gpt_1f1b_with_ring_cp_matches_serial(devices8, params):
     """DP x PP x CP: the 1F1B pipeline with ring-attention stages — sequence
     sharded over 'context' THROUGH the pipeline (stage 0 embeds local chunks
@@ -831,6 +836,7 @@ def test_gpt_remat_grads_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.heavy
 def test_gpt_remat_flash_policy_matches_and_saves_residuals():
     """remat='flash' (save the flash kernel's o/lse, skip its fwd re-run in
     the backward) must be numerically identical to remat=True, and the
@@ -879,6 +885,54 @@ def test_gpt_remat_flash_policy_matches_and_saves_residuals():
         assert f"float32{tag}[{L},{BH},{S},{hd}]" in extra, (mode, dict(extra))
 
 
+def test_offload_guardrail():
+    """remat='flash_offload' where plain 'flash' fits is a measured ~2.4x
+    loss (docs/BENCH_AB.md) — the trace-time advisory must fire there, stay
+    quiet when the footprint is genuinely HBM-scale, and stay quiet on
+    backends that report no memory limit (the CPU sim)."""
+    import warnings
+
+    from torchdistpackage_tpu.parallel.tensor_parallel import (
+        layers as tl,
+    )
+    from torchdistpackage_tpu.parallel.tensor_parallel import offload_advice
+
+    cfg = GPTConfig(vocab_size=64, dim=32, nheads=2, nlayers=3, max_seq=16,
+                    ffn_mult=2, dtype=jnp.float32, attn_impl="flash").block
+    # tiny model vs a 16 GB chip: advice fires
+    msg = offload_advice(cfg, (2, 16, 32), 3, hbm_bytes=16 * 2**30)
+    assert msg is not None and "flash" in msg
+    # footprint at >= half of HBM: offload is load-bearing, no advice
+    assert offload_advice(cfg, (2, 16, 32), 3, hbm_bytes=10_000) is None
+    # unknown HBM (CPU sim): silent
+    assert offload_advice(cfg, (2, 16, 32), 3, hbm_bytes=None) is None
+
+    # end to end: scan_blocks warns under a monkeypatched device limit
+    gcfg = GPTConfig(vocab_size=64, dim=32, nheads=2, nlayers=3, max_seq=16,
+                     ffn_mult=2, dtype=jnp.float32, attn_impl="flash")
+    params = init_gpt_params(jax.random.PRNGKey(0), gcfg)
+    batch = {
+        "tokens": jnp.zeros((2, 16), jnp.int32),
+        "targets": jnp.zeros((2, 16), jnp.int32),
+    }
+    orig = tl._device_hbm_bytes
+    tl._device_hbm_bytes = lambda: 16 * 2**30
+    try:
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            jax.eval_shape(
+                lambda p: gpt_loss(p, batch, gcfg, remat="flash_offload"),
+                params)
+        assert any("flash_offload" in str(w.message) for w in rec), rec
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            jax.eval_shape(
+                lambda p: gpt_loss(p, batch, gcfg, remat="flash"), params)
+        assert not any("flash_offload" in str(w.message) for w in rec)
+    finally:
+        tl._device_hbm_bytes = orig
+
+
 def test_remat_mode_validated():
     """A misspelled remat policy string must raise, not silently degrade to
     plain block remat (checkpoint_block funnels every remat= kwarg)."""
@@ -915,6 +969,7 @@ def test_streamed_head_loss_matches_full():
 
 
 @pytest.mark.parametrize("num_chunks", [1, 2])
+@pytest.mark.heavy
 def test_gpt_1f1b_dropout(devices8, params, num_chunks):
     """Dropout THROUGH the 1F1B pipeline: per-(stage, microbatch, layer)
     masks via the schedule's microbatch-index threading; deterministic for a
@@ -1039,6 +1094,7 @@ def test_streamed_head_loss_under_dp(devices8, params):
     np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
 
 
+@pytest.mark.heavy
 def test_gpt_zigzag_ring_matches_serial(devices8, params):
     """Zigzag (load-balanced) ring CP through the full GPT: tokens/targets
     host-permuted to the zigzag layout, pos-emb gathered at the owned

@@ -130,6 +130,7 @@ def test_llama_tp_matches_serial(devices8, sp):
         )
 
 
+@pytest.mark.heavy
 def test_llama_pipeline_1f1b_matches_serial(devices8):
     """PP=2 x TP=2 1F1B (sharded transfers auto-on for non-SP TP) on the
     Llama block stack vs the serial microbatched loss."""
@@ -236,6 +237,7 @@ def test_mixtral_style_moe_ep_matches_serial(devices8):
     np.testing.assert_allclose(float(got), float(want), rtol=2e-5)
 
 
+@pytest.mark.heavy
 def test_llama_zero_interleaved_hybrid_matches_serial(devices8):
     """The north-star composition on the Llama family: hybrid ZeRO
     (data_intra master shards) x INTERLEAVED 1F1B (V=2) x DP at tiny

@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -52,6 +53,7 @@ def test_moe_serial_matches_dense_golden():
     assert np.isfinite(float(aux)) and float(aux) > 0
 
 
+@pytest.mark.heavy
 def test_gpt_moe_serial_remat_modes_match():
     """The non-pipeline MoE path supports activation checkpointing (before
     this, only the dense family and the MoE pipeline did): every remat mode
@@ -122,6 +124,7 @@ def test_gpt_moe_gqa_specs_match_params(devices8):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.heavy
 def test_sorted_dispatch_matches_dense():
     """The index-based (gather/scatter-add) dispatch must reproduce the
     dense [T,E,C] einsum path — same routing decision, same outputs and
@@ -316,6 +319,7 @@ def test_moedp_training_matches_serial(devices8):
     )
 
 
+@pytest.mark.heavy
 def test_gpt_moe_training_matches_serial(devices8):
     """The BASELINE.md MoE milestone end-to-end: an MoE GPT (expert FFN every
     other block) trained EP x MoE-DP x TP(+SP) on the moe mesh view must
@@ -445,6 +449,7 @@ import pytest as _pytest
 
 @_pytest.mark.parametrize(
     "moe_dispatch", ["dense", "sorted", "sorted+rematflash"])
+@pytest.mark.heavy
 def test_gpt_moe_1f1b_matches_serial_microbatched(devices8, moe_dispatch):
     """MoE × PP: the MoE GPT under the 1F1B schedule (EP × MoE-DP × PP) must
     track a serial model trained on the mean of per-microbatch losses — the
@@ -633,6 +638,7 @@ def test_gpt_moe_aux_trains(devices8):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.heavy
 def test_gpt_moe_interleaved_1f1b_matches_serial(devices8):
     """MoE x INTERLEAVED PP: the MoE GPT under the V=2 virtual-chunk 1F1B
     schedule (EP x MoE-DP x PP x V) — L=8 so each of the 4 slabs carries the
@@ -914,6 +920,7 @@ def test_expert_choice_causal_guard():
         gpt_moe_loss(gp, batch, gcfg)
 
 
+@pytest.mark.heavy
 def test_gpt_moe_with_ring_cp_matches_serial(devices8):
     """MoE × CP (the long-context expert-model pairing): an MoE GPT with
     ring attention over the context axis — attention sees the full sequence
@@ -969,6 +976,7 @@ def test_gpt_moe_with_ring_cp_matches_serial(devices8):
     )
 
 
+@pytest.mark.heavy
 def test_gpt_moe_1f1b_with_tp_nosp_sharded_transfers(devices8):
     """MoE x TP(non-SP) x EP x PP — the expert stack with TENSOR parallelism
     through the pipeline, riding the TP-sharded inter-stage transfers
@@ -1064,3 +1072,65 @@ def test_gpt_moe_1f1b_with_tp_nosp_sharded_transfers(devices8):
         ),
         rtol=1e-3, atol=1e-5,
     )
+
+
+# ------------------------------------------------------ ragged serving dispatch
+
+
+def test_serve_forward_matches_nodrop():
+    """moe_serve_forward (ragged route-then-group, jax.lax.ragged_dot —
+    VERDICT r4 weak #5) must equal the dense mixture golden and the
+    no-drop capacity path exactly (same routing decision, every token
+    kept; only float summation order differs), for gelu AND swiglu
+    experts, prefill-sized and decode-sized T."""
+    import dataclasses
+
+    from torchdistpackage_tpu.parallel.moe import moe_serve_forward
+
+    for act in ("gelu", "swiglu"):
+        cfg = dataclasses.replace(CFG, act=act, capacity_factor=1.25)
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        for shape in ((2, 16), (3, 1)):  # prefill and decode shapes
+            x = jax.random.normal(jax.random.PRNGKey(1), (*shape, cfg.dim))
+            got = jax.jit(lambda p, a: moe_serve_forward(p, a, cfg))(params, x)
+            nodrop = dataclasses.replace(
+                cfg, capacity_factor=cfg.num_experts / cfg.top_k)
+            want, _aux = moe_forward(params, x, nodrop)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5,
+                err_msg=f"act={act} shape={shape}")
+            if act == "gelu":
+                golden = dense_mixture_golden(params, x, cfg)
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(golden), rtol=1e-4, atol=1e-4)
+
+
+def test_serve_forward_row_budget():
+    """The whole point of the ragged path: expert compute touches exactly
+    T*top_k rows — no [T, E, C] tensors, no E/top_k padding.  Verified
+    structurally: the jaxpr contains ragged_dot ops on [T*k, ...] operands
+    and NO dense-dispatch einsum intermediate of T*E*C elements."""
+    params = init_moe_params(jax.random.PRNGKey(0), CFG)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, CFG.dim))
+    T, k, E = 2 * 16, CFG.top_k, CFG.num_experts
+
+    from torchdistpackage_tpu.parallel.moe import moe_serve_forward
+
+    jaxpr = jax.make_jaxpr(lambda p, a: moe_serve_forward(p, a, CFG))(params, x)
+    s = str(jaxpr)
+    assert "ragged_dot" in s
+    # the no-drop capacity path would materialize [T, E, C=T] dispatch
+    # tensors (T*E*T elements); they must not exist here
+    assert f"{T},{E},{T}" not in s.replace(" ", "")
+
+
+def test_serve_forward_rejects_expert_choice():
+    import dataclasses
+
+    from torchdistpackage_tpu.parallel.moe import moe_serve_forward
+
+    cfg = dataclasses.replace(CFG, router="expert_choice")
+    params = init_moe_params(jax.random.PRNGKey(0), CFG)
+    x = jnp.zeros((1, 4, CFG.dim))
+    with pytest.raises(NotImplementedError, match="topk"):
+        moe_serve_forward(params, x, cfg)

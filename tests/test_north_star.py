@@ -13,6 +13,10 @@ shape-preserving step — run in that child; a child failure raises here.
 import pathlib
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
 

@@ -90,6 +90,7 @@ def test_pipeline_forward_matches_serial(devices8, pp):
     np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.heavy
 def test_pipeline_loss_and_grads_match_serial(devices8):
     pp = 4
     tpc.setup_process_groups([("pipe", pp)], devices=devices8[:pp])
@@ -225,6 +226,7 @@ def _1f1b_value_and_grad(mesh, specs, M, pp=4):
 
 
 @pytest.mark.parametrize("pp,m", [(2, 4), (4, 4), (4, 9), (4, 2)])
+@pytest.mark.heavy
 def test_pipeline_1f1b_matches_serial(devices8, pp, m):
     """The 1F1B schedule's (loss, grads) must equal serial AD exactly —
     including M not divisible by / smaller than schedule-derived constants."""
@@ -316,6 +318,7 @@ def test_1f1b_activation_memory_bounded(devices8):
     assert not leaked, f"O(M) float buffers carried through the scan: {leaked}"
 
 
+@pytest.mark.heavy
 def test_heterogeneous_stage_fn_matches_serial(devices8):
     """Per-stage heterogeneous compute — ``stage_fn`` branches on
     :func:`stage_index` (each stage applies a DIFFERENT nonlinearity after its
@@ -462,6 +465,7 @@ def test_pipeline_with_dp(devices8):
     )
 
 
+@pytest.mark.heavy
 def test_balanced_stage_stack_pipelines_skewed_load(devices8):
     """VERDICT r2 item 6: a deliberately SKEWED layer->stage assignment
     (balanced bounds with unequal stage sizes) must pipeline correctly via

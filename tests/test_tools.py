@@ -77,6 +77,47 @@ def test_profile_blocks_and_report():
     assert len(ps) == 2
 
 
+def test_tree_profile_levels():
+    from torchdistpackage_tpu.tools import aggregate_levels, report_tree
+    from torchdistpackage_tpu.tools.profiler import BlockProfile
+
+    # a ragged tree: enc/b0/{attn,mlp}, enc/b1, and a flat lambda next to it
+    mk = lambda name, t, b: BlockProfile(
+        name=name, time_ms=t, act_bytes=b, flops=1e9, bytes_accessed=1e6,
+        temp_bytes=100)
+    ps = [
+        mk("enc/b0/attn", 1.0, 1000),
+        mk("enc/b0/mlp", 2.0, 3000),
+        mk("enc/b1", 1.0, 500),
+        mk("head", 0.5, 200),
+    ]
+    levels = aggregate_levels(ps)
+    assert sorted(levels) == [1, 2, 3]
+    l1 = {p.name: p for p in levels[1]}
+    assert l1["enc"].time_ms == 4.0 and l1["enc"].act_bytes == 4500
+    assert l1["enc"].flops == 3e9 and l1["enc"].temp_bytes == 100  # max, not sum
+    assert l1["head"].time_ms == 0.5
+    l2 = {p.name: p for p in levels[2]}
+    assert l2["enc/b0"].act_bytes == 4000 and l2["enc/b1"].act_bytes == 500
+    assert l2["head"].act_bytes == 200  # shallow names persist at deeper levels
+    rep = report_tree(ps)
+    assert "== level 1 ==" in rep and "== level 3 ==" in rep
+    assert "enc/b0/attn" in rep
+
+    # measured end to end through profile_blocks with slash names
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 16))
+    blocks = [
+        ("enc/attn", lambda x: x @ w),
+        ("enc/mlp", lambda x: jnp.tanh(x)),
+        ("head", lambda x: x.sum(keepdims=True)[None]),
+    ]
+    profs, _ = profile_blocks(blocks, jnp.ones((4, 16)), warmup=1, iters=1)
+    lv = aggregate_levels(profs)
+    assert {p.name for p in lv[1]} == {"enc", "head"}
+    enc = next(p for p in lv[1] if p.name == "enc")
+    assert enc.time_ms == profs[0].time_ms + profs[1].time_ms
+
+
 # ---------------------------------------------------------------- nan tools
 
 

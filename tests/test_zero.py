@@ -147,6 +147,7 @@ def test_hybrid_zero(devices8):
 
 
 @pytest.mark.parametrize("num_chunks", [1, 2])
+@pytest.mark.heavy
 def test_zero_1f1b_hybrid(devices8, num_chunks):
     """North-star composition (VERDICT r2 item 3): hybrid ZeRO x 1F1B
     pipeline x DP.  Mesh data=4 (hybrid intra=2) x pipe=2; the 1F1B schedule
@@ -315,6 +316,7 @@ def test_zero_with_tp(devices8):
     )
 
 
+@pytest.mark.heavy
 def test_zero_with_ring_context_parallel(devices8):
     """ZeRO composed with ring context parallelism: optimizer state shards
     over 'data' while grads reduce over (data, context) — the context axis
@@ -383,6 +385,7 @@ def test_zero_with_ring_context_parallel(devices8):
         )
 
 
+@pytest.mark.heavy
 def test_zero_with_moe_expert_overrides(devices8):
     """ZeRO x MoE (the DeepSpeed-style pairing): optimizer state sharded
     over 'moe_dp' with expert grads reduced over moe_dp ONLY
@@ -486,6 +489,7 @@ def test_zero_override_must_contain_shard_axis():
         )
 
 
+@pytest.mark.heavy
 def test_zero_moe_1f1b_full_stack(devices8):
     """The full expert-model stack: ZeRO(moe_dp) x EP x MoE-DP x PP(1F1B),
     aux ON — sharded optimizer state, expert-override grad reduction, and
@@ -596,6 +600,7 @@ def test_zero_moe_1f1b_full_stack(devices8):
     )
 
 
+@pytest.mark.heavy
 def test_zero_1f1b_tp_nosp_sharded_transfers(devices8):
     """ZeRO x non-SP TP x PP over the TP-SHARDED inter-stage transfers:
     the sharded optimizer consumes the pipeline's (loss, grads) while the
@@ -675,3 +680,131 @@ def test_zero_1f1b_tp_nosp_sharded_transfers(devices8):
         np.asarray(zp["tok_emb"]), np.asarray(sparams["tok_emb"]),
         rtol=1e-3, atol=1e-5,
     )
+
+
+# ------------------------------------------------------- int8 grad compression
+
+
+def test_int8_ring_reduce_scatter_matches_psum_scatter(devices8):
+    """The int8 ring reduce-scatter delivers the same owner tiles as the
+    exact psum_scatter (within the symmetric-quantization bound), for a
+    leading and a non-leading scatter dim, and falls back exactly on
+    ragged tiles."""
+    from jax import shard_map
+
+    from torchdistpackage_tpu.dist.compressed import int8_ring_reduce_scatter
+
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    mesh = tpc.get_view()
+    g = np.asarray(jax.random.normal(jax.random.PRNGKey(0), (8, 64, 24))) * 2.0
+
+    for dim in (0, 1):
+        def body(x):
+            approx = int8_ring_reduce_scatter(x, "data", dim)
+            exact = jax.lax.psum_scatter(
+                x, "data", scatter_dimension=dim, tiled=True)
+            return approx, exact
+
+        out_spec = P("data") if dim == 0 else P(None, "data")
+        approx, exact = jax.jit(
+            shard_map(
+                body, mesh=mesh, in_specs=(P(),),
+                out_specs=(out_spec, out_spec),
+            )
+        )(jnp.asarray(g))
+        bound = 8 * np.abs(g).max() * 8 / 127.0  # 8 addends, n-1 requant hops
+        np.testing.assert_allclose(
+            np.asarray(approx), np.asarray(exact), atol=bound, rtol=0.05)
+
+    # ragged tile (20 % 8 != 0): refused loudly, same contract as tiled
+    # psum_scatter (ZeRO never routes such leaves here — they replicate)
+    with pytest.raises(ValueError, match="must divide"):
+        jax.jit(
+            shard_map(
+                lambda x: int8_ring_reduce_scatter(x, "data", 2),
+                mesh=mesh, in_specs=(P(),), out_specs=P(None, None, "data"))
+        )(jnp.zeros((8, 64, 20)))
+
+
+@pytest.mark.parametrize("hybrid", [False, True], ids=["flat", "hybrid"])
+def test_zero_int8_compression_tracks_exact(devices8, hybrid):
+    """ZeroOptimizer(grad_compress='int8') — VERDICT r4 weak #4: the int8
+    ring composed into the ZeRO reduce-to-owner.  The compressed trajectory
+    must track the exact ZeRO run within quantization noise on both the
+    flat layout (ring scatter over 'data') and the hybrid layout (ring
+    scatter over 'data_intra' + int8 ring over the 'data_inter' DCN leg)."""
+    from jax.sharding import NamedSharding
+
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    params = make_mlp_params(jax.random.PRNGKey(0))
+    opt = optax.sgd(1e-2)
+
+    if hybrid:
+        mesh = tpc.build_hybrid_mesh(intra_size=4)
+        kw = dict(mesh=mesh, shard_axis="data_intra",
+                  grad_reduce_axes=("data_inter", "data_intra"))
+        bspec = P(("data_inter", "data_intra"))
+    else:
+        mesh = tpc.get_view()
+        kw = dict(mesh=mesh)
+        bspec = P("data")
+
+    def run(compress):
+        zero = ZeroOptimizer(opt, grad_compress=compress,
+                             compress_min_size=0, **kw)
+        zp = zero.place_params(jax.tree.map(np.asarray, params))
+        zs = zero.init(zp)
+        step = zero.make_train_step(mlp_loss)
+        losses = []
+        batch = jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, bspec)),
+            _data(jax.random.PRNGKey(100)),
+        )
+        for _ in range(5):
+            zp, zs, loss = step(zp, zs, batch)
+            losses.append(float(loss))
+        return zp, losses
+
+    p_exact, l_exact = run(None)
+    p_q, l_q = run("int8")
+    assert l_q[-1] < l_q[0]
+    np.testing.assert_allclose(l_q, l_exact, rtol=0.05)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(p_q[k]), np.asarray(p_exact[k]), rtol=0.1, atol=5e-3)
+
+
+def test_zero_int8_wire_format_in_jaxpr(devices8):
+    """The compressed reduce really moves int8 over the wire: the step's
+    jaxpr must contain s8 ppermutes with grad_compress='int8' and none
+    without (the non-compressed path may still ppermute activations in
+    other tests' pipelines — here the MLP has no other ring traffic)."""
+    from jax import shard_map
+
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    mesh = tpc.get_view()
+    params = make_mlp_params(jax.random.PRNGKey(0))
+
+    def jaxpr_for(compress):
+        zero = ZeroOptimizer(optax.sgd(1e-2), mesh=mesh,
+                             grad_compress=compress, compress_min_size=0)
+        _, zspecs, sdims = zero._specs_for(params)
+
+        def reduce_body(g):
+            return zero.reduce_grads_to_shard(g, sdims)
+
+        return str(jax.make_jaxpr(
+            shard_map(reduce_body, mesh=mesh,
+                      in_specs=(jax.tree.map(lambda _: P(), params),),
+                      out_specs=zspecs)
+        )(params))
+
+    import re
+
+    compressed = jaxpr_for("int8")
+    exact = jaxpr_for(None)
+    def s8_permutes(j):
+        return [ln for ln in j.splitlines()
+                if "ppermute" in ln and re.search(r"\b[si]8\[", ln)]
+    assert s8_permutes(compressed), "no int8 ppermute in compressed jaxpr"
+    assert not s8_permutes(exact)

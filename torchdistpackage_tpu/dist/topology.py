@@ -11,6 +11,28 @@ ICI-adjacent devices.  Every group-getter / predicate of the reference maps to
 a mesh-axis query; collectives use axis *names* inside ``shard_map`` instead of
 group handles.
 
+**Physical placement** (the reference's core value prop — its stride algorithm
+deliberately decides which group lands intra-node, process_topo.py:32-51,
+motivated at Intro.md:15-44): on real TPU devices the enumeration order of
+``jax.devices()`` does NOT guarantee that a C-order reshape puts an axis's
+members on ICI neighbors (2D/3D torus wraparound, multi-slice DCN).  So
+:meth:`ParallelContext.setup_process_groups` routes TPU device lists through
+``jax.experimental.mesh_utils``:
+
+- single slice: ``create_device_mesh(sizes, devices)`` assigns logical axes to
+  physical ICI torus axes from device *coords* — the last-listed (stride-1)
+  axis gets the most network-local placement, honoring the ordered-config
+  contract on real hardware, not just in enumeration order;
+- multi-slice (devices carrying distinct ``slice_index``, i.e. a DCN-connected
+  multislice job): ``create_hybrid_device_mesh`` — the DCN dimension is
+  absorbed by the OUTERMOST config axes (largest stride = cross-slice, exactly
+  the reference's outer-axes-cross-node semantics), overridable per axis via
+  ``dcn_config``.
+
+Non-TPU devices (CPU sim, tests) keep the plain C-order reshape, so the
+8-device CI sim and the driver dryrun behave exactly as before.
+
+
 Key translations (reference -> here):
 
 - ``tpc.setup_process_groups(cfg)``   -> :meth:`ParallelContext.setup_process_groups`
@@ -51,6 +73,103 @@ MOE_DATA_AXIS = "moe_dp"
 CONTEXT_AXIS = "context"
 
 
+def _slice_ids(devices: Sequence) -> List[int]:
+    """Distinct ``slice_index`` values (sorted).  Devices without the
+    attribute (or with ``None``) count as one slice — single-slice TPU jobs
+    and CPU sims don't set it."""
+    ids = {getattr(d, "slice_index", None) for d in devices}
+    if ids == {None}:
+        return [0]
+    if None in ids:
+        raise ValueError(
+            "mixed device list: some devices carry slice_index, some don't"
+        )
+    return sorted(ids)
+
+
+def _derive_dcn_shape(
+    names: Sequence[str],
+    sizes: Sequence[int],
+    num_slices: int,
+    dcn_config: Optional[Dict[str, int]],
+) -> List[int]:
+    """Per-axis DCN factors (product == num_slices).
+
+    Explicit ``dcn_config`` wins; otherwise the slice count is absorbed
+    greedily from the LEFT (outermost axes — largest stride — go cross-slice,
+    the reference's outer-axes-cross-node layout, process_topo.py:32-51)."""
+    if dcn_config is not None:
+        unknown = set(dcn_config) - set(names)
+        if unknown:
+            raise ValueError(f"dcn_config axes {unknown} not in config {list(names)}")
+        shape = [int(dcn_config.get(nm, 1)) for nm in names]
+        if math.prod(shape) != num_slices:
+            raise ValueError(
+                f"dcn_config {dcn_config} multiplies to {math.prod(shape)}, "
+                f"but the device list spans {num_slices} slices"
+            )
+        for nm, s, d in zip(names, sizes, shape):
+            if s % d != 0:
+                raise ValueError(
+                    f"axis {nm!r} of size {s} not divisible by its DCN factor {d}"
+                )
+        return shape
+    shape = []
+    remaining = num_slices
+    for s in sizes:
+        d = math.gcd(remaining, s)
+        shape.append(d)
+        remaining //= d
+    if remaining != 1:
+        raise ValueError(
+            f"cannot distribute {num_slices} slices over axis sizes "
+            f"{list(sizes)}; pass dcn_config explicitly"
+        )
+    return shape
+
+
+def _assign_devices(
+    names: Sequence[str],
+    sizes: Sequence[int],
+    devices: Sequence,
+    topology: str,
+    dcn_config: Optional[Dict[str, int]],
+) -> np.ndarray:
+    """Device ndarray of shape ``sizes`` with physical-topology-aware
+    placement on TPU (see module docstring), C-order reshape otherwise."""
+    if topology not in ("auto", "ici", "flat"):
+        raise ValueError(f"topology must be 'auto'|'ici'|'flat', got {topology!r}")
+    is_tpu = (
+        getattr(devices[-1], "platform", None) == "tpu"
+        and hasattr(devices[-1], "coords")
+    )
+    if topology == "flat" or (topology == "auto" and not is_tpu):
+        if dcn_config:
+            raise ValueError("dcn_config requires the topology-aware path")
+        return np.array(devices, dtype=object).reshape(sizes)
+    if not is_tpu:
+        raise ValueError(
+            "topology='ici' needs TPU devices with coords; got "
+            f"{getattr(devices[-1], 'platform', None)!r}"
+        )
+    from jax.experimental import mesh_utils
+
+    slices = _slice_ids(devices)
+    if len(slices) > 1:
+        dcn_shape = _derive_dcn_shape(names, sizes, len(slices), dcn_config)
+        per_slice = [s // d for s, d in zip(sizes, dcn_shape)]
+        return mesh_utils.create_hybrid_device_mesh(
+            per_slice, dcn_shape, devices, allow_split_physical_axes=True
+        )
+    if dcn_config and math.prod(dcn_config.values()) != 1:
+        raise ValueError(
+            f"dcn_config {dcn_config} given but the device list is a single slice"
+        )
+    return mesh_utils.create_device_mesh(
+        sizes, devices, allow_split_physical_axes=True
+    )
+
+
 class ParallelContext:
     """Singleton-ish registry of the device mesh and its named-axis views.
 
@@ -83,6 +202,8 @@ class ParallelContext:
         self,
         config: Sequence[Tuple[str, int]],
         devices: Optional[Sequence[jax.Device]] = None,
+        topology: str = "auto",
+        dcn_config: Optional[Dict[str, int]] = None,
     ) -> Mesh:
         """Build the base mesh from an ordered ``[(axis, size), ...]`` config.
 
@@ -99,7 +220,22 @@ class ParallelContext:
 
         Axis sizes may use ``-1`` for at most one axis, which absorbs the
         remaining device count (convenience over the reference).
-        """
+
+        ``topology`` selects the physical placement strategy:
+
+        - ``'auto'`` (default): TPU devices with coords go through
+          ``mesh_utils`` (torus-aware, multi-slice-aware); anything else
+          (CPU sim) is a plain C-order reshape.
+        - ``'ici'``: require the torus-aware path (raise on non-TPU devices).
+        - ``'flat'``: force the C-order reshape even on TPU (the pre-round-5
+          behavior; also the escape hatch for exotic device lists).
+
+        ``dcn_config`` (multi-slice only) maps axis name -> how many slices
+        that axis spans, e.g. ``{'data': 4}`` for pure dp-over-DCN.  The
+        product must equal the number of slices; unlisted axes span 1.  By
+        default the OUTERMOST config axes absorb the slice count greedily —
+        the reference's outer-axes-are-cross-node semantics
+        (process_topo.py:32-51)."""
         if devices is None:
             devices = jax.devices()
         devices = list(devices)
@@ -119,9 +255,13 @@ class ParallelContext:
         if math.prod(sizes) != n:
             raise ValueError(f"config sizes {sizes} do not multiply to device count {n}")
 
+        arr = _assign_devices(names, sizes, devices, topology, dcn_config)
         self._config = list(zip(names, sizes))
-        self._devices = np.array(devices, dtype=object)
-        self.mesh = Mesh(self._devices.reshape(sizes), axis_names=tuple(names))
+        # flat logical order (C-order of the assigned mesh): every view mesh
+        # factors THIS order, so moe/hybrid views inherit the physical
+        # placement
+        self._devices = arr.reshape(-1)
+        self.mesh = Mesh(arr, axis_names=tuple(names))
         self._views = {"default": self.mesh}
         return self.mesh
 
@@ -260,6 +400,11 @@ class ParallelContext:
             return True
         except KeyError:
             return False
+
+    def num_slices(self) -> int:
+        """Number of DCN-connected slices the mesh spans (1 on single-slice
+        jobs and CPU sims)."""
+        return len(_slice_ids(list(self._require_mesh().devices.flat)))
 
     def model_axes(self) -> Tuple[str, ...]:
         """Axis names forming the auto-derived 'model' group.  Collectives

@@ -17,18 +17,18 @@ golden in tests/test_convert.py):
   carries over.
 - Attention is head-major in the flattened projection dim on both sides,
   so transposes alone line the heads up.
-- HF ``rms_norm_eps`` is whatever the checkpoint says (1e-5 or 1e-6); the
-  framework's norms run eps=1e-5.  At 1e-6-checkpoints this is a ~1e-5
-  relative perturbation on normalized activations — far below bf16
-  resolution; the logits golden runs at eps parity (1e-5).
+- HF ``rms_norm_eps`` is whatever the checkpoint says (1e-5 or 1e-6); it is
+  preserved into ``GPTConfig.norm_eps`` on import and round-trips through
+  :func:`to_hf_llama`.
 - Llama proper has no attention/MLP biases, so those leaves import as
   zeros; ``attention_bias=True`` / ``mlp_bias=True`` checkpoints
   (Qwen-style architectures served through LlamaForCausalLM) DO carry
   bias tensors and they are loaded into the framework's bias leaves.
-- ``rope_scaling`` of type 'llama3' (Llama-3.1 long-context) and
-  'linear' (position interpolation) import and match HF (logits golden);
-  other types ('dynamic', 'yarn') are refused rather than silently
-  diverging.
+- ``rope_scaling`` of types 'llama3' (Llama-3.1 long-context), 'linear'
+  (position interpolation), 'dynamic' (NTK — current-length-aware, traced)
+  and 'yarn' (incl. the attention temperature) import and match HF (logits
+  goldens); unknown types (e.g. 'longrope') are refused rather than
+  silently diverging.
 
 No torch import at module scope: tensors are duck-typed through
 ``_np`` (works with torch tensors, numpy arrays, or anything exposing
@@ -79,11 +79,28 @@ def llama_config_from_hf(hf_cfg, dtype: Any = jnp.bfloat16) -> GPTConfig:
         if kind == "default":
             scaling = None
         elif kind not in _ROPE_SCALING_TYPES:
-            # e.g. 'dynamic'/'yarn': importing with wrong inv_freq would
-            # silently diverge from the HF forward — refuse instead
+            # e.g. 'longrope': importing with wrong inv_freq would silently
+            # diverge from the HF forward — refuse instead
             raise NotImplementedError(
-                f"rope_scaling={scaling!r} is not supported; 'linear' and "
-                f"'llama3' import (tensor_parallel.layers._scaled_inv_freq)"
+                f"rope_scaling={scaling!r} is not supported; "
+                f"{_ROPE_SCALING_TYPES} import "
+                f"(tensor_parallel.layers._scaled_inv_freq)"
+            )
+        elif kind == "dynamic":
+            # transformers' _compute_dynamic_ntk_parameters keys the scaling
+            # off config.max_position_embeddings (NOT any
+            # original_max_position_embeddings in the dict — its own TODO);
+            # bake that in so the framework needs no back-reference to the
+            # HF config
+            scaling = dict(
+                scaling,
+                original_max_position_embeddings=hf_cfg.max_position_embeddings,
+            )
+        elif kind == "yarn" and "original_max_position_embeddings" not in scaling:
+            # transformers falls back to max_position_embeddings
+            scaling = dict(
+                scaling,
+                original_max_position_embeddings=hf_cfg.max_position_embeddings,
             )
     sw = getattr(hf_cfg, "sliding_window", None)
     if sw is not None and getattr(hf_cfg, "use_sliding_window", True):
@@ -105,6 +122,17 @@ def llama_config_from_hf(hf_cfg, dtype: Any = jnp.bfloat16) -> GPTConfig:
             f"hidden_act={act!r}: the Llama import supports silu-gated "
             f"MLPs only"
         )
+    hd = getattr(hf_cfg, "head_dim", None)
+    if hd is not None and hd != hf_cfg.hidden_size // hf_cfg.num_attention_heads:
+        # modern LlamaConfig allows a decoupled head_dim; the framework
+        # derives head_dim = dim // nheads, so importing such a checkpoint
+        # would mis-shape every attention projection — refuse loudly rather
+        # than let shape asserts (stripped under -O) be the only guard
+        raise NotImplementedError(
+            f"head_dim={hd} != hidden_size//num_attention_heads="
+            f"{hf_cfg.hidden_size // hf_cfg.num_attention_heads}: decoupled "
+            f"head_dim checkpoints are not supported"
+        )
     kv = getattr(hf_cfg, "num_key_value_heads", None) or hf_cfg.num_attention_heads
     return llama_config(
         vocab_size=hf_cfg.vocab_size,
@@ -116,6 +144,7 @@ def llama_config_from_hf(hf_cfg, dtype: Any = jnp.bfloat16) -> GPTConfig:
         ffn_hidden=hf_cfg.intermediate_size,
         rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)),
         rope_scaling=dict(scaling) if scaling else None,
+        norm_eps=float(getattr(hf_cfg, "rms_norm_eps", 1e-5)),
         dtype=dtype,
     )
 
@@ -249,6 +278,7 @@ def gpt2_config_from_hf(hf_cfg, dtype: Any = jnp.float32) -> GPTConfig:
         nlayers=hf_cfg.n_layer,
         max_seq=hf_cfg.n_positions,
         ffn_hidden=hf_cfg.n_inner or 4 * hf_cfg.n_embd,
+        norm_eps=float(getattr(hf_cfg, "layer_norm_epsilon", 1e-5)),
         dtype=dtype,
     )
 
@@ -408,7 +438,7 @@ def to_hf_llama(
         "num_attention_heads": cfg.nheads,
         "num_key_value_heads": cfg.kv_heads or cfg.nheads,
         "max_position_embeddings": cfg.max_seq,
-        "rms_norm_eps": 1e-5,  # the framework's norm eps
+        "rms_norm_eps": cfg.norm_eps,
         "rope_theta": cfg.rope_theta,
         "rope_scaling": dict(cfg.rope_scaling) if cfg.rope_scaling else None,
         "attention_bias": attn_bias,

@@ -31,6 +31,7 @@ the full forward).
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Any, Dict, Optional, Tuple
 
@@ -41,6 +42,7 @@ from ..parallel.tensor_parallel.layers import (
     TransformerConfig,
     _close_row_parallel,
     compute_qkv,
+    dense,
     layer_norm,
     mlp_partial,
     rope_cache,
@@ -109,7 +111,7 @@ def cached_block_forward(
     pending TP partial sums) — how the MoE families plug their expert
     layer into the same cached block."""
     B, S_in, D = x.shape
-    h = layer_norm(x, p["ln1"])
+    h = layer_norm(x, p["ln1"], cfg.norm_eps)
     q, k, v = compute_qkv(p["attn"], h, cfg, rope=rope)
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, offset, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, offset, 0))
@@ -125,11 +127,11 @@ def cached_block_forward(
     else:
         out = _cached_attention(q, ck, cv, offset)
     out = out.transpose(0, 2, 1, 3).reshape(B, S_in, q.shape[1] * cfg.head_dim)
-    y = out @ p["attn"]["wo"]
+    y = dense(out, p["attn"]["wo"])
     y = _close_row_parallel(y, p["attn"]["bo"], axis, False)
     x = x + y
 
-    h = layer_norm(x, p["ln2"])
+    h = layer_norm(x, p["ln2"], cfg.norm_eps)
     if ffn is None:
         z = mlp_partial(p["mlp"], h)
         z = _close_row_parallel(z, p["mlp"]["b2"], axis, False)
@@ -185,7 +187,7 @@ def forward_cached(
     h, (ck, cv) = jax.lax.scan(
         body, h, (params["blocks"], cache["k"], cache["v"])
     )
-    logits = gpt_head(params, h[:, -1:, :], axis, False)  # [B, 1, V_local]
+    logits = gpt_head(params, h[:, -1:, :], axis, False, eps=cfg.norm_eps)  # [B, 1, V_local]
     return {"k": ck, "v": cv}, logits[:, 0, :]
 
 
@@ -196,22 +198,31 @@ def forward_cached_moe(
     cache: Dict[str, jnp.ndarray],
     offset,
     axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
     """:func:`forward_cached` for the MoE family (heterogeneous block
     LIST, expert FFN every moe_every-th block).
 
-    Inference-time dispatch = the NO-DROP limit of the training router:
-    the capacity factor is raised to >= E/top_k so ``ceil(T·k·cf/E) >= T``
-    and no token can be evicted — at serving time every token gets its
-    routed experts, and token t's output never depends on what other
-    tokens (batch rows, or the incremental history) routed.  This is what
-    makes incremental decode == full forward: capacity-based drops are a
-    training-batch interaction that has no incremental equivalent.  Expert
-    params are used UNSHARDED here (ep_axis=None — single-host serving;
-    TP still shards attention heads and the vocab head as in training)."""
+    Inference-time dispatch is EXACT no-drop routing — every token reaches
+    every expert it routed to, so token t's output never depends on what
+    other tokens (batch rows, or the incremental history) routed.  This is
+    what makes incremental decode == full forward: capacity-based drops
+    are a training-batch interaction with no incremental equivalent.
+
+    - ``ep_axis=None`` (single-host serving): the ragged route-then-group
+      path (:func:`..parallel.moe.moe_serve_forward`) — ``jax.lax.
+      ragged_dot`` grouped GEMMs over exactly ``T*top_k`` rows, no
+      ``E/top_k`` capacity-padding tax at prefill.
+    - ``ep_axis`` set (EP-sharded serving, inside shard_map on the moe
+      mesh view): experts stay sharded over ``moe_ep`` at inference —
+      each device holds ``E/ep`` experts and tokens ride the training
+      all_to_all exchange, with capacity raised to the no-drop bound
+      (``cf >= E/top_k`` ⇒ no token evicted).  Composes with TP decode
+      (``axis``): attention heads/vocab shard over ``tensor``, experts
+      over ``moe_ep``."""
     import dataclasses as _dc
 
-    from ..parallel.moe import moe_forward
+    from ..parallel.moe import moe_forward, moe_serve_forward
     from .gpt_moe import moe_layer_config
 
     bcfg = cfg.block
@@ -232,10 +243,14 @@ def forward_cached_moe(
         else None
     )
 
-    def moe_ffn(p, hh):
-        z, _aux = moe_forward(
-            p["moe"], hh, mcfg, ep_axis=None, causal=bcfg.causal)
-        return z
+    if ep_axis is None:
+        def moe_ffn(p, hh):
+            return moe_serve_forward(p["moe"], hh, mcfg)
+    else:
+        def moe_ffn(p, hh):
+            z, _aux = moe_forward(
+                p["moe"], hh, mcfg, ep_axis=ep_axis, causal=bcfg.causal)
+            return z
 
     ks, vs = [], []
     for i, bp in enumerate(params["blocks"]):
@@ -246,7 +261,7 @@ def forward_cached_moe(
         ks.append(ck)
         vs.append(cv)
     cache = {"k": jnp.stack(ks), "v": jnp.stack(vs)}
-    logits = gpt_head(params, h[:, -1:, :], axis, False)
+    logits = gpt_head(params, h[:, -1:, :], axis, False, eps=cfg.norm_eps)
     return cache, logits[:, 0, :]
 
 
@@ -280,8 +295,12 @@ def _sample(
     to greedy rather than an empty support)."""
     if top_k is not None and top_k < 1:
         raise ValueError(f"top_k must be >= 1, got {top_k}")
-    if key is None:
+    # temperature == 0 is the common shorthand for greedy — honor it instead
+    # of dividing by zero (NaN logits -> undefined categorical draws)
+    if key is None or temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
     x = logits.astype(jnp.float32) / temperature
     V = x.shape[-1]
     neg = jnp.array(-jnp.inf, x.dtype)
@@ -322,6 +341,7 @@ def generate(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    ep_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """Autoregressively extend ``prompt`` [B, P] by ``max_new_tokens``.
     Greedy when ``key`` is None, else temperature sampling with optional
@@ -334,9 +354,13 @@ def generate(
     shard.  Jit the whole call: prefill is one batched forward, then ONE
     ``lax.scan`` of single-token steps — no per-token recompilation.
 
-    MoE configs decode through :func:`forward_cached_moe` (no-drop
-    routing, unsharded experts — its docstring has the semantics).
-    ``P + max_new_tokens <= cfg.max_seq`` for learned positions."""
+    MoE configs decode through :func:`forward_cached_moe` — exact no-drop
+    routing; ragged grouped GEMMs when ``ep_axis`` is None, EP-SHARDED
+    experts (all_to_all over ``ep_axis``, e.g. the moe view's 'moe_ep')
+    when set — its docstring has the semantics.  ``P + max_new_tokens <=
+    cfg.max_seq`` for learned positions."""
+    if ep_axis is not None and not cfg.moe_experts:
+        raise ValueError("ep_axis is only meaningful for MoE configs")
     if cfg.attn_impl in ("ring", "ulysses"):
         raise NotImplementedError(
             "context-parallel decode is not supported: the KV cache is not "
@@ -344,7 +368,10 @@ def generate(
             "CP-trained checkpoint with dataclasses.replace(cfg, "
             "attn_impl='flash', context_axis=None)"
         )
-    fwd = forward_cached_moe if cfg.moe_experts else forward_cached
+    if cfg.moe_experts:
+        fwd = functools.partial(forward_cached_moe, ep_axis=ep_axis)
+    else:
+        fwd = forward_cached
     B, P = prompt.shape
     if max_new_tokens < 1:
         # the prefill below would still sample one token and

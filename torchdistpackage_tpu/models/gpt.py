@@ -37,6 +37,7 @@ from ..parallel.tensor_parallel import (
     TransformerConfig,
     block_forward,
     block_param_specs,
+    dense,
     scan_blocks,
     gather_from_sp,
     init_block_params,
@@ -87,6 +88,9 @@ class GPTConfig:
     # explicit FFN hidden width (overrides ffn_mult) — Llama-style ~8d/3
     # widths are not integer multiples of d
     ffn_hidden: Optional[int] = None
+    # norm epsilon: preserved from HF checkpoints (rms_norm_eps is 1e-5 or
+    # 1e-6 depending on the family) by models/convert.py
+    norm_eps: float = 1e-5
     # Mixture-of-Experts (0 = dense model).  With ``moe_experts > 0`` every
     # ``moe_every``-th block's FFN becomes an expert layer (Switch-style
     # alternation); use the gpt_moe_* family (models/gpt_moe.py) which
@@ -140,6 +144,7 @@ class GPTConfig:
             norm=self.norm,
             act=self.act,
             ffn_hidden=self.ffn_hidden,
+            norm_eps=self.norm_eps,
         )
 
     def num_params(self) -> int:
@@ -281,13 +286,19 @@ def gpt_embed(
     return h + jax.lax.dynamic_slice_in_dim(params["pos_emb"], off, S, axis=0)
 
 
-def gpt_head(params: Dict[str, PyTree], h: jnp.ndarray, axis: Optional[str] = None, sp: bool = False):
+def gpt_head(
+    params: Dict[str, PyTree],
+    h: jnp.ndarray,
+    axis: Optional[str] = None,
+    sp: bool = False,
+    eps: float = 1e-5,
+):
     """Final LN + column-parallel LM head.  Returns vocab-local logits
     [B, S, V_local] (full V when serial)."""
-    h = layer_norm(h, params["ln_f"])
+    h = layer_norm(h, params["ln_f"], eps)
     if axis is not None and sp:
         h = gather_from_sp(h, axis)
-    return h @ params["head"]
+    return dense(h, params["head"])
 
 
 def gpt_forward(
@@ -321,7 +332,7 @@ def gpt_forward(
         params, tokens, cfg, axis=axis, sp=sp, remat=remat,
         dropout_key=dropout_key,
     )
-    return gpt_head(params, h, axis, sp)
+    return gpt_head(params, h, axis, sp, eps=cfg.norm_eps)
 
 
 def gpt_hidden(
@@ -351,6 +362,7 @@ def streamed_head_loss(
     targets: jnp.ndarray,
     axis: Optional[str] = None,
     chunk: int = 256,
+    eps: float = 1e-5,
 ) -> jnp.ndarray:
     """Head + CE scanned over SEQUENCE chunks: the [B, S, V] logits are never
     materialized — each scan step computes one [B, chunk, V] slab, reduces it
@@ -359,7 +371,7 @@ def streamed_head_loss(
     full logits are ~2 GB of HBM traffic per step).  Equal chunks, so the
     mean of chunk means is the token mean.  ``h``: post-blocks hidden
     [B, S, D] (pre final-LN)."""
-    h = layer_norm(h, params["ln_f"])
+    h = layer_norm(h, params["ln_f"], eps)
     B, S, D = h.shape
     if S % chunk != 0:
         raise ValueError(
@@ -376,7 +388,7 @@ def streamed_head_loss(
     @functools.partial(jax.checkpoint, prevent_cse=False)
     def body(acc, xt):
         hh, tt = xt
-        return acc + vocab_parallel_xent(hh @ params["head"], tt, axis), None
+        return acc + vocab_parallel_xent(dense(hh, params["head"]), tt, axis), None
 
     # the carry must be closed over the body's varying axes (DESIGN.md §2):
     # under a DP mesh h/targets are data-varying, so the accumulator is too
@@ -411,7 +423,8 @@ def gpt_loss(
         if axis is not None and sp:
             h = gather_from_sp(h, axis)
         return streamed_head_loss(
-            params, h, batch["targets"], axis, chunk=xent_chunk
+            params, h, batch["targets"], axis, chunk=xent_chunk,
+            eps=cfg.norm_eps,
         )
     logits = gpt_forward(
         params, batch["tokens"], cfg, axis=axis, sp=sp, remat=remat,
@@ -457,7 +470,7 @@ def gpt_pipeline_loss(
         return scan_blocks(stacked, x, cfg.block, tp_axis, sp)
 
     def mb_loss(y, tgt):
-        logits = gpt_head(params, y, tp_axis, sp)
+        logits = gpt_head(params, y, tp_axis, sp, eps=cfg.norm_eps)
         return vocab_parallel_xent(logits, tgt, tp_axis)
 
     return pipeline_loss(
@@ -623,7 +636,7 @@ def gpt_pipeline_1f1b(
             )
 
     def last_fn(p, y, tgt):
-        logits = gpt_head(p, y, tp_axis, sp)
+        logits = gpt_head(p, y, tp_axis, sp, eps=cfg.norm_eps)
         return vocab_parallel_xent(logits, tgt, tp_axis)
 
     return pipeline_1f1b(

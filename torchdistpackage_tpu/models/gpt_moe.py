@@ -114,13 +114,13 @@ def moe_block_forward(
     if dropout_key is not None and bcfg.dropout_rate > 0.0:
         k_attn, k_mlp = jax.random.split(dropout_key)
 
-    h = layer_norm(x, p["ln1"])
+    h = layer_norm(x, p["ln1"], bcfg.norm_eps)
     full = gather_from_sp(h, axis) if (axis and sp) else h
     y = attention_partial(p["attn"], full, bcfg, rope=rope)
     y = _close_row_parallel(y, p["attn"]["bo"], axis, sp)
     x = x + dropout(y, bcfg.dropout_rate, k_attn)
 
-    h = layer_norm(x, p["ln2"])
+    h = layer_norm(x, p["ln2"], bcfg.norm_eps)
     full = gather_from_sp(h, axis) if (axis and sp) else h
     # causality follows the model config: autoregressive configs (GPT,
     # cfg.block.causal=True) reject the non-causal expert_choice router at
@@ -157,7 +157,7 @@ def gpt_moe_forward(
         params["blocks"], h, cfg, axis=axis, sp=sp, ep_axis=ep_axis,
         dropout_key=dropout_key, remat=remat,
     )
-    return gpt_head(params, h, axis, sp), aux_mean
+    return gpt_head(params, h, axis, sp, eps=cfg.norm_eps), aux_mean
 
 
 def _moe_bodies(cfg, axis, sp, ep_axis, remat):
@@ -449,7 +449,7 @@ def gpt_moe_pipeline_1f1b(
             )
 
     def last_fn(p, y, tgt):
-        logits = gpt_head(p, y, tp_axis, sp)
+        logits = gpt_head(p, y, tp_axis, sp, eps=cfg.norm_eps)
         return vocab_parallel_xent(logits, tgt, tp_axis)
 
     from ..parallel.pipeline_parallel import pipeline_1f1b

@@ -5,6 +5,7 @@ from .moe import (
     moe_forward,
     moe_grad_reduce_overrides,
     moe_param_specs,
+    moe_serve_forward,
 )
 from .zero import ZeroOptimizer, zero_partition_spec
 from .ema import ShardedEMA

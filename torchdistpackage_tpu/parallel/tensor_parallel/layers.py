@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict, Optional, Union
+from typing import Any, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -97,6 +97,9 @@ class TransformerConfig:
     # use non-integer multipliers (~8/3 d rounded), which ffn_mult can't
     # express.
     ffn_hidden: Optional[int] = None
+    # norm epsilon — HF checkpoints carry 1e-5 or 1e-6 (rms_norm_eps) and
+    # models/convert.py preserves whichever the checkpoint says
+    norm_eps: float = 1e-5
 
     def __post_init__(self):
         if self.norm not in ("layer", "rms"):
@@ -110,6 +113,18 @@ class TransformerConfig:
                 raise NotImplementedError(
                     f"rope_scaling type {kind!r}; supported: "
                     f"{_ROPE_SCALING_TYPES}")
+            need = {
+                "linear": ("factor",),
+                "llama3": ("factor", "low_freq_factor", "high_freq_factor",
+                           "original_max_position_embeddings"),
+                "dynamic": ("factor", "original_max_position_embeddings"),
+                "yarn": ("factor", "original_max_position_embeddings"),
+            }[kind]
+            missing = [k for k in need if k not in self.rope_scaling]
+            if missing:
+                raise ValueError(
+                    f"rope_scaling type {kind!r} needs keys {missing} "
+                    f"(models/convert.py injects them on HF import)")
 
     @property
     def head_dim(self) -> int:
@@ -183,34 +198,100 @@ def norm_param_specs(norm: str = "layer") -> Dict[str, P]:
     return out
 
 
-_ROPE_SCALING_TYPES = ("linear", "llama3")
+_ROPE_SCALING_TYPES = ("linear", "llama3", "dynamic", "yarn")
 
 
-def _scaled_inv_freq(inv_freq: jnp.ndarray, scaling: dict) -> jnp.ndarray:
+def _scaled_inv_freq(
+    inv_freq: jnp.ndarray,
+    scaling: dict,
+    theta: float = 10000.0,
+    pos: "jnp.ndarray | None" = None,
+) -> Tuple[jnp.ndarray, float]:
     """Apply a rope-scaling recipe to the base inverse frequencies.
+    Returns ``(inv_freq, attention_factor)`` — the factor multiplies the
+    cos/sin tables (1.0 for every type but yarn).
 
-    'linear' (position interpolation): every frequency / factor.
-    'llama3' (Llama-3.1 long-context): frequencies whose wavelength exceeds
-    ``original_max_position_embeddings / low_freq_factor`` divide by
-    ``factor``, short wavelengths stay, the band between interpolates
-    smoothly — matches transformers' ``_compute_llama3_parameters``
-    exactly (verified by the HF logits golden in tests/test_convert.py).
+    All four recipes match transformers' ``modeling_rope_utils`` exactly
+    (verified by HF logits goldens in tests/test_convert.py):
+
+    - 'linear' (position interpolation): every frequency / factor.
+    - 'llama3' (Llama-3.1 long-context): frequencies whose wavelength
+      exceeds ``original_max_position_embeddings / low_freq_factor`` divide
+      by ``factor``, short wavelengths stay, the band between interpolates
+      smoothly (``_compute_llama3_parameters``).
+    - 'dynamic' (NTK-by-parts, /u/bloc97-style): the base theta grows with
+      the CURRENT sequence length past
+      ``original_max_position_embeddings`` —
+      ``theta' = theta * ((f*s/orig) - (f-1))^(d/(d-2))``; at or below the
+      original length it is exactly the unscaled rope
+      (``_compute_dynamic_ntk_parameters``).  The current length is read
+      from ``pos`` (max position + 1), TRACED — so one jitted decode loop
+      reproduces HF's recompute-on-growth behavior with no retrace.
+    - 'yarn': interpolated (freq/factor) below ``beta_slow`` rotations,
+      extrapolated (unscaled) above ``beta_fast``, linear ramp between,
+      plus the attention temperature ``0.1*ln(factor)+1`` returned as the
+      attention_factor (``_compute_yarn_parameters``, incl. the
+      mscale/mscale_all_dim variant used by Deepseek-style checkpoints).
     """
     kind = scaling.get("rope_type", scaling.get("type"))
     factor = float(scaling["factor"])
     if kind == "linear":
-        return inv_freq / factor
-    if kind != "llama3":
+        return inv_freq / factor, 1.0
+    if kind == "llama3":
+        lo = float(scaling["low_freq_factor"])
+        hi = float(scaling["high_freq_factor"])
+        old_len = float(scaling["original_max_position_embeddings"])
+        wavelen = 2.0 * math.pi / inv_freq
+        scaled = jnp.where(wavelen > old_len / lo, inv_freq / factor, inv_freq)
+        smooth = (old_len / wavelen - lo) / (hi - lo)
+        smoothed = (1.0 - smooth) * scaled / factor + smooth * scaled
+        medium = (wavelen >= old_len / hi) & (wavelen <= old_len / lo)
+        return jnp.where(medium, smoothed, scaled), 1.0
+    half = inv_freq.shape[0]
+    dim = 2 * half
+    if kind == "dynamic":
+        orig = float(scaling["original_max_position_embeddings"])
+        if pos is None:
+            seq_len = jnp.float32(orig)
+        else:
+            seq_len = jnp.maximum(jnp.max(pos) + 1, orig).astype(jnp.float32)
+        base = theta * ((factor * seq_len / orig) - (factor - 1.0)) ** (
+            dim / (dim - 2.0))
+        return base ** (-jnp.arange(0, half, dtype=jnp.float32) / half), 1.0
+    if kind != "yarn":
         raise NotImplementedError(f"rope_scaling type {kind!r}")
-    lo = float(scaling["low_freq_factor"])
-    hi = float(scaling["high_freq_factor"])
-    old_len = float(scaling["original_max_position_embeddings"])
-    wavelen = 2.0 * math.pi / inv_freq
-    scaled = jnp.where(wavelen > old_len / lo, inv_freq / factor, inv_freq)
-    smooth = (old_len / wavelen - lo) / (hi - lo)
-    smoothed = (1.0 - smooth) * scaled / factor + smooth * scaled
-    medium = (wavelen >= old_len / hi) & (wavelen <= old_len / lo)
-    return jnp.where(medium, smoothed, scaled)
+    orig = float(scaling["original_max_position_embeddings"])
+    beta_fast = float(scaling.get("beta_fast") or 32)
+    beta_slow = float(scaling.get("beta_slow") or 1)
+
+    def get_mscale(scale, m=1.0):
+        return 0.1 * m * math.log(scale) + 1.0 if scale > 1 else 1.0
+
+    af = scaling.get("attention_factor")
+    if af is None:
+        ms, msad = scaling.get("mscale"), scaling.get("mscale_all_dim")
+        af = (
+            get_mscale(factor, ms) / get_mscale(factor, msad)
+            if ms and msad
+            else get_mscale(factor)
+        )
+
+    def correction_dim(n_rot):
+        return dim * math.log(orig / (n_rot * 2 * math.pi)) / (2 * math.log(theta))
+
+    low = correction_dim(beta_fast)
+    high = correction_dim(beta_slow)
+    if scaling.get("truncate", True):
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, dim - 1)
+    if low == high:
+        high += 0.001  # transformers' singularity guard
+    ramp = jnp.clip(
+        (jnp.arange(half, dtype=jnp.float32) - low) / (high - low), 0.0, 1.0
+    )
+    extrap_w = 1.0 - ramp
+    inv = inv_freq / factor * (1.0 - extrap_w) + inv_freq * extrap_w
+    return inv, float(af)
 
 
 def rope_cache(
@@ -221,14 +302,17 @@ def rope_cache(
     once per forward (they are layer-invariant) and reuse across the block
     stack; ``scan_blocks`` hoists them out of the scan body as closed-over
     loop constants.  ``scaling``: optional rope-scaling dict
-    (:func:`_scaled_inv_freq` — 'linear' or 'llama3')."""
+    (:func:`_scaled_inv_freq` — 'linear'/'llama3'/'dynamic'/'yarn'; yarn's
+    attention temperature is folded into the tables, dynamic reads the
+    current length from ``pos``)."""
     assert head_dim % 2 == 0, f"rope needs an even head_dim, got {head_dim}"
     half = head_dim // 2
     inv_freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    af = 1.0
     if scaling is not None:
-        inv_freq = _scaled_inv_freq(inv_freq, scaling)
+        inv_freq, af = _scaled_inv_freq(inv_freq, scaling, theta=theta, pos=pos)
     ang = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [S, half]
-    return jnp.cos(ang)[None, None], jnp.sin(ang)[None, None]
+    return jnp.cos(ang)[None, None] * af, jnp.sin(ang)[None, None] * af
 
 
 def apply_rope(
@@ -284,6 +368,32 @@ def block_rope_cache(
                       cfg.rope_theta, scaling=cfg.rope_scaling)
 
 
+def dense(x: jnp.ndarray, w, spec: Optional[str] = None) -> jnp.ndarray:
+    """``x @ w`` (or ``einsum(spec, x, w)`` for stacked weights) with
+    structural int8 dispatch: a ``tools.surgery.QuantizedLinear`` leaf
+    (attrs ``q``/``scale``) upcasts its int8 weight in-register on the way
+    into the MXU and folds the per-channel scale into the epilogue — the
+    weight-only-quantized serving path (HBM weight reads halve vs bf16).
+    Dense array weights take the exact path, so one model implementation
+    serves both; every matmul site of the model families funnels here.
+
+    ``spec`` must contract the weight's -2 dim and emit its stack dims
+    leading (the families' two forms: ``"bsd,tdh->tbsh"`` / and the plain
+    2-D matmul) — that is what aligns the ``[*stack, 1, out]`` scale."""
+    q = getattr(w, "q", None)
+    if q is None:
+        return jnp.einsum(spec, x, w) if spec else x @ w
+    qc = q.astype(x.dtype)
+    if spec:
+        y = jnp.einsum(spec, x, qc, preferred_element_type=jnp.float32)
+        # scale [t, 1, h] -> [t, 1, 1, h] against y [t, B, S, h]
+        scale = w.scale.astype(jnp.float32)[:, None]
+    else:
+        y = jnp.dot(x, qc, preferred_element_type=jnp.float32)
+        scale = w.scale.astype(jnp.float32)  # [1, h] or [h] broadcasts
+    return (y * scale).astype(x.dtype)
+
+
 def compute_qkv(
     p: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg: TransformerConfig,
     rope: "tuple | None" = None,
@@ -296,7 +406,7 @@ def compute_qkv(
     hd = cfg.head_dim
     if "wqkv" in p:
         h_loc = p["wqkv"].shape[-1] // hd
-        qkv = jnp.einsum("bsd,tdh->tbsh", x, p["wqkv"]) + p["bqkv"][:, None, None, :]
+        qkv = dense(x, p["wqkv"], "bsd,tdh->tbsh") + p["bqkv"][:, None, None, :]
         q, k, v = qkv[0], qkv[1], qkv[2]
         q = q.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)  # [B,h,S,hd]
         k = k.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
@@ -316,8 +426,8 @@ def compute_qkv(
                 f"{p['wkv'].shape[-1] / hd:g} heads of dim {hd}; GQA under "
                 f"TP needs kv_heads % tp_size == 0 (whole heads per shard)"
             )
-        q = (x @ p["wq"] + p["bq"]).reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
-        kv = jnp.einsum("bsd,tdh->tbsh", x, p["wkv"]) + p["bkv"][:, None, None, :]
+        q = (dense(x, p["wq"]) + p["bq"]).reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
+        kv = dense(x, p["wkv"], "bsd,tdh->tbsh") + p["bkv"][:, None, None, :]
         k = kv[0].reshape(B, S, hkv_loc, hd).transpose(0, 2, 1, 3)
         v = kv[1].reshape(B, S, hkv_loc, hd).transpose(0, 2, 1, 3)
 
@@ -351,7 +461,7 @@ def attention_partial(
     h_loc = q.shape[1]
     out = core_attention(q, k, v, cfg)
     out = out.transpose(0, 2, 1, 3).reshape(B, S, h_loc * hd)
-    return out @ p["wo"]  # [B,S,D] — partial sum across TP shards
+    return dense(out, p["wo"])  # [B,S,D] — partial sum across TP shards
 
 
 def core_attention(
@@ -390,11 +500,11 @@ def mlp_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
     gate and up in one leaf keeps the col-parallel TP spec a single rule
     (shard the last dim) and the einsum one fused matmul."""
     if p["w1"].ndim == 3:
-        gu = jnp.einsum("bsd,tdf->tbsf", x, p["w1"]) + p["b1"][:, None, None, :]
+        gu = dense(x, p["w1"], "bsd,tdf->tbsf") + p["b1"][:, None, None, :]
         h = jax.nn.silu(gu[0]) * gu[1]
     else:
-        h = jax.nn.gelu(x @ p["w1"] + p["b1"])
-    return h @ p["w2"]  # partial
+        h = jax.nn.gelu(dense(x, p["w1"]) + p["b1"])
+    return dense(h, p["w2"])  # partial
 
 
 def _close_row_parallel(
@@ -445,6 +555,54 @@ _FLASH_RESIDUAL_NAMES = ("flash_out", "flash_lse")
 # (see checkpoint_block)
 _OFFLOADED_RESIDUAL_NAMES = _FLASH_RESIDUAL_NAMES[:1]  # ("flash_out",)
 _HBM_SAVED_RESIDUAL_NAMES = _FLASH_RESIDUAL_NAMES[1:]  # ("flash_lse",)
+
+
+def _device_hbm_bytes() -> Optional[int]:
+    """Per-device memory capacity, or None when the backend doesn't report
+    one (the CPU sim)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return stats.get("bytes_limit")
+    except Exception:
+        return None
+
+
+def offload_advice(
+    cfg: "TransformerConfig",
+    x_shape: Tuple[int, ...],
+    nlayers: int,
+    hbm_bytes: Optional[int] = None,
+) -> Optional[str]:
+    """Guard-rail for ``remat='flash_offload'``: the offload trades HBM for
+    a measured ~2.4x step-time loss at S=2048 and only reaches parity with
+    plain ``'flash'`` at S>=8192 (docs/BENCH_AB.md) — so flag configs where
+    the flash-resident footprint comfortably fits HBM and the flag is pure
+    loss.
+
+    Returns a human-readable warning string, or None when the offload is
+    plausibly load-bearing (footprint >= half of HBM, or HBM unknown).
+    The estimate is the per-chip bytes the 'flash' policy keeps resident
+    across the scan: per block one boundary carry [B, S_local, D] in
+    ``cfg.dtype``, the saved o (same shape/dtype) and the f32 lse
+    [B, H, S_local].  Params/optimizer/temps are NOT modeled — hence the
+    conservative 50% threshold rather than a tight fit."""
+    if hbm_bytes is None:
+        hbm_bytes = _device_hbm_bytes()
+    if not hbm_bytes:
+        return None
+    B, S_local, D = x_shape
+    dt = jnp.dtype(cfg.dtype).itemsize
+    per_block = 2 * B * S_local * D * dt + B * cfg.nheads * S_local * 4
+    total = nlayers * per_block
+    if total >= 0.5 * hbm_bytes:
+        return None
+    return (
+        f"remat='flash_offload': the 'flash' policy's resident activations "
+        f"are ~{total / 1e9:.2f} GB for this config vs ~{hbm_bytes / 1e9:.1f} GB "
+        f"HBM — plain remat='flash' should fit and measures ~2.4x FASTER at "
+        f"short/medium sequence (parity only from S~8192, docs/BENCH_AB.md). "
+        f"Use 'flash_offload' only when 'flash' actually OOMs."
+    )
 
 
 def checkpoint_block(fn, remat: RematMode, prevent_cse: bool = True):
@@ -504,13 +662,13 @@ def block_forward(
     k_attn = k_mlp = None
     if dropout_key is not None and cfg.dropout_rate > 0.0:
         k_attn, k_mlp = jax.random.split(dropout_key)
-    h = layer_norm(x, p["ln1"])
+    h = layer_norm(x, p["ln1"], cfg.norm_eps)
     full = gather_from_sp(h, axis) if (axis and sp) else h
     y = attention_partial(p["attn"], full, cfg, rope=rope)
     y = _close_row_parallel(y, p["attn"]["bo"], axis, sp)
     x = x + dropout(y, cfg.dropout_rate, k_attn)
 
-    h = layer_norm(x, p["ln2"])
+    h = layer_norm(x, p["ln2"], cfg.norm_eps)
     full = gather_from_sp(h, axis) if (axis and sp) else h
     z = mlp_partial(p["mlp"], full)
     z = _close_row_parallel(z, p["mlp"]["b2"], axis, sp)
@@ -537,7 +695,7 @@ def transformer_forward(
         x = split_to_sp(x, axis)
     for bp in params["blocks"]:
         x = block_forward(bp, x, cfg, axis=axis, sp=sp)
-    x = layer_norm(x, params["ln_f"])
+    x = layer_norm(x, params["ln_f"], cfg.norm_eps)
     if axis and sp and gather_output:
         x = gather_from_sp(x, axis)
     return x
@@ -606,10 +764,18 @@ def scan_blocks(
         return block_forward(
             lp, h, cfg, axis=axis, sp=sp, dropout_key=k, rope=rope)
 
+    L = jax.tree.leaves(stacked)[0].shape[0]
+
+    if remat == "flash_offload":
+        # trace-time advisory (shapes are static): offloading when 'flash'
+        # fits is a measured ~2.4x loss — never let that happen silently
+        advice = offload_advice(cfg, x.shape, L)
+        if advice:
+            import warnings
+
+            warnings.warn(advice, stacklevel=2)
     if remat:
         blk = checkpoint_block(blk, remat, prevent_cse=False)
-
-    L = jax.tree.leaves(stacked)[0].shape[0]
 
     if layer_mask is None:
         def body(h, xs):

@@ -6,6 +6,8 @@ debug_nan, module_replace, bnb_fc/bminf_int8, slurm_job_monitor).
 """
 
 from .profiler import (
+    aggregate_levels,
+    report_tree,
     BlockProfile,
     get_model_profile,
     profile_blocks,
@@ -32,8 +34,10 @@ from .flash_tune import tune_flash_blocks
 __all__ = [
     "tune_flash_blocks",
     "BlockProfile",
+    "aggregate_levels",
     "get_model_profile",
     "profile_blocks",
+    "report_tree",
     "report_prof",
     "check_model_params",
     "check_tensors",

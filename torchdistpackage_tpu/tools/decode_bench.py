@@ -1,0 +1,112 @@
+"""Decode throughput benchmark: bf16 vs int8 weight-only serving.
+
+Measures incremental decode tokens/sec for a ~1B GPT on the local chip(s),
+A/B-ing the dense tree against ``quantize_decode_params`` — the
+measured-decode half of the int8 serving story (docs/ROADMAP.md analysis:
+decode reads every weight once per token, so weight-only int8 has up to
+~2x of HBM bandwidth to win back; training-side numbers live in bench.py).
+
+    python -m torchdistpackage_tpu.tools.decode_bench            # on-chip
+    TDP_CPU_SIM=1 python -m torchdistpackage_tpu.tools.decode_bench  # smoke
+
+Prints one JSON line per (batch, context) cell with both rates and the
+speedup.  Results are recorded in docs/BENCH_AB.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def bench_decode(jax, jnp, cfg, params, B, ctx, steps=64, reps=3):
+    """Decode tokens/sec through the REAL serving path — ``generate()``'s
+    single-jit scan (static cache, no host round trips).  Prefill cost is
+    cancelled by differencing two generation lengths; best of ``reps``."""
+    from ..models import generate
+
+    prompt = jnp.ones((B, ctx), jnp.int32)
+    short, long_ = max(steps // 8, 1), steps
+
+    def sync(out):
+        # host transfer, NOT block_until_ready: over the axon TPU tunnel
+        # block_until_ready can return before execution (same guard as
+        # bench.py's float(loss) sync)
+        return int(out[0, -1])
+
+    fns = {}
+    for n in (short, long_):
+        f = jax.jit(lambda p, t, n=n: generate(p, t, cfg, max_new_tokens=n))
+        sync(f(params, prompt))  # compile
+        fns[n] = f
+
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(fns[short](params, prompt))
+        t1 = time.perf_counter()
+        sync(fns[long_](params, prompt))
+        t2 = time.perf_counter()
+        dt = (t2 - t1) - (t1 - t0)  # decode-only: prefill cancels
+        if dt > 0:
+            best = max(best, B * (long_ - short) / dt)
+    return best
+
+
+def main():
+    if os.environ.get("TDP_CPU_SIM"):
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    if os.environ.get("TDP_CPU_SIM"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ..models import GPTConfig, init_gpt_params
+    from .surgery import quantize_decode_params
+
+    smoke = bool(os.environ.get("TDP_CPU_SIM")) or "--smoke" in sys.argv
+    dt = jnp.bfloat16
+    if smoke:
+        cfg = GPTConfig(vocab_size=256, dim=128, nheads=4, nlayers=2,
+                        max_seq=512, ffn_mult=4, dtype=dt)
+        cells = [(1, 32)]
+        steps = 4
+    else:
+        # the bench.py --big config (d2048/L16 ≈ 0.94B params)
+        cfg = GPTConfig(vocab_size=32000, dim=2048, nheads=16, nlayers=16,
+                        max_seq=4096, ffn_mult=4, dtype=dt)
+        cells = [(1, 128), (1, 1024), (8, 128), (8, 1024)]
+        steps = 64
+
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    params = jax.device_put(jax.tree.map(lambda x: x.astype(dt), params))
+    qp = jax.device_put(quantize_decode_params(params))
+    nb = sum(x.nbytes for x in jax.tree.leaves(params))
+    nq = sum(x.nbytes for x in jax.tree.leaves(qp))
+    print(f"param bytes: bf16={nb / 1e9:.2f} GB, int8 tree={nq / 1e9:.2f} GB",
+          file=sys.stderr)
+
+    for B, ctx in cells:
+        r_bf = bench_decode(jax, jnp, cfg, params, B, ctx, steps)
+        r_q = bench_decode(jax, jnp, cfg, qp, B, ctx, steps)
+        if r_bf <= 0 or r_q <= 0:
+            # every rep's length-difference fell inside timing noise (tiny
+            # smoke shapes): report the degenerate cell instead of a
+            # fictitious rate / ZeroDivisionError
+            print(json.dumps({"B": B, "ctx": ctx, "degenerate": True,
+                              "bf16_tok_s": round(r_bf, 1),
+                              "int8_tok_s": round(r_q, 1)}), flush=True)
+            continue
+        print(json.dumps({
+            "B": B, "ctx": ctx,
+            "bf16_tok_s": round(r_bf, 1),
+            "int8_tok_s": round(r_q, 1),
+            "speedup": round(r_q / r_bf, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

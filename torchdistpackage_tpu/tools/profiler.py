@@ -149,6 +149,56 @@ def report_prof(profiles: Sequence[BlockProfile], sort_by_ratio: bool = True) ->
     return "\n".join(lines)
 
 
+def aggregate_levels(
+    profiles: Sequence[BlockProfile],
+) -> "dict[int, List[BlockProfile]]":
+    """Per-depth aggregation over a module TREE, keyed by slash-paths.
+
+    The reference profiler hooks every submodule of an arbitrary nested
+    model and reports per depth-level (module_profiler.py:97-144: level 1 =
+    top modules, level 2 = their children, ...).  Here the tree lives in the
+    block names: profile leaf blocks named like ``'encoder/blocks/0/attn'``
+    and this rolls them up — depth d groups by the first d path segments,
+    summing time/activation/FLOPs/bytes (temp memory takes the max: blocks
+    run sequentially, so temps don't coexist).
+
+    Returns ``{depth: [BlockProfile aggregated at that depth, ...]}``;
+    names shallower than ``depth`` aggregate as themselves, so ragged trees
+    (a lambda next to a deep stack — flatten_model's CallableModule case,
+    pipeline_helper.py:131) report correctly at every level."""
+    if not profiles:
+        return {}
+    out: "dict[int, List[BlockProfile]]" = {}
+    max_depth = max(p.name.count("/") + 1 for p in profiles)
+    for d in range(1, max_depth + 1):
+        groups: "dict[str, BlockProfile]" = {}
+        for p in profiles:
+            key = "/".join(p.name.split("/")[:d])
+            g = groups.get(key)
+            if g is None:
+                groups[key] = dataclasses.replace(p, name=key)
+            else:
+                g.time_ms += p.time_ms
+                g.act_bytes += p.act_bytes
+                g.flops += p.flops
+                g.bytes_accessed += p.bytes_accessed
+                g.temp_bytes = max(g.temp_bytes, p.temp_bytes)
+        out[d] = list(groups.values())
+    return out
+
+
+def report_tree(profiles: Sequence[BlockProfile]) -> str:
+    """Per-depth-level report over slash-path block names — the reference's
+    tree report (module_profiler.py:97-144): one MB/ms-sorted table per
+    level, so remat decisions can be made at whichever granularity (whole
+    encoder vs single attention) pays best."""
+    sections = []
+    for depth, rows in sorted(aggregate_levels(profiles).items()):
+        sections.append(f"== level {depth} ==")
+        sections.append(report_prof(rows))
+    return "\n".join(sections)
+
+
 def get_model_profile(
     blocks: Sequence[Tuple[str, Callable]],
     x: PyTree,
@@ -157,8 +207,10 @@ def get_model_profile(
     print_report: bool = True,
 ) -> List[BlockProfile]:
     """One-call profile + report — analogue of ``get_model_profile``
-    (module_profiler.py:146-171)."""
+    (module_profiler.py:146-171).  Slash-path block names get the per-level
+    tree report (:func:`report_tree`), flat names the single table."""
     profiles, _ = profile_blocks(blocks, x, warmup=warmup, iters=iters)
     if print_report:
-        print(report_prof(profiles))
+        tree = any("/" in p.name for p in profiles)
+        print(report_tree(profiles) if tree else report_prof(profiles))
     return profiles

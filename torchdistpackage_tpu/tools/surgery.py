@@ -79,6 +79,10 @@ class QuantizedLinear:
         return self.q.shape
 
     @property
+    def ndim(self) -> int:
+        return self.q.ndim
+
+    @property
     def nbytes(self) -> int:
         return self.q.nbytes + self.scale.nbytes
 
@@ -101,6 +105,60 @@ def int8_matmul(x: jax.Array, ql: QuantizedLinear) -> jax.Array:
     reads vs bf16) and the per-channel scale multiplies the product."""
     y = jnp.dot(x, ql.q.astype(x.dtype), preferred_element_type=jnp.float32)
     return (y * ql.scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def quantize_stacked_int8(w: jax.Array, scale_dtype=jnp.float32) -> QuantizedLinear:
+    """Symmetric int8 with per-(stack, output-channel) scales: absmax over
+    the CONTRACTION dim (-2) only, keepdims, so a layer-stacked ``[L, ...,
+    d, out]`` weight keeps one scale row per layer per channel — and both
+    ``q`` and ``scale`` slice their leading dim through ``lax.scan``
+    (QuantizedLinear is a pytree), which is what lets the decode scan carry
+    int8 weights with the dequant INSIDE the scan body.  For a plain 2-D
+    weight the scale is ``[1, out]`` (broadcast-equivalent to
+    :func:`quantize_int8`'s ``[out]``)."""
+    absmax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)
+    scale = (absmax / 127.0 + 1e-12).astype(scale_dtype)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return QuantizedLinear(q=q, scale=scale)
+
+
+#: weight leaf names of the GPT/Llama/MoE families that carry matmul
+#: weights (attention projections, MLP/expert matrices, LM head) — the
+#: decode-quantization sweep targets exactly these
+DECODE_WEIGHT_KEYS = ("wqkv", "wq", "wkv", "wo", "w1", "w2", "head")
+
+
+def quantize_decode_params(
+    params: PyTree, min_size: int = 16384
+) -> PyTree:
+    """int8 weight-only quantization of a model param tree for SERVING.
+
+    Replaces every matmul weight (:data:`DECODE_WEIGHT_KEYS`; stacked
+    ``[L, ...]`` block leaves keep per-layer scales) with a
+    :class:`QuantizedLinear`.  Embeddings, biases and norms stay dense —
+    the win is HBM weight bandwidth on the matmuls, which is what bounds
+    incremental decode (docs/ROADMAP.md analysis: decode reads every
+    weight once per token).  The model functions dispatch structurally
+    (``tensor_parallel.layers.dense``), so the quantized tree drops into
+    ``models.generate``/``forward_cached`` unchanged — golden + jaxpr
+    proof in tests/test_generate.py."""
+
+    def pred(key: str, leaf: Any) -> bool:
+        name = key.rsplit("/", 1)[-1]
+        # MoE expert/router leaves reuse the w1/w2 names but run through the
+        # expert einsums (parallel/moe.py), not the `dense` dispatch — they
+        # stay dense until the expert paths learn the quantized layout
+        if "experts" in key or "router" in key:
+            return False
+        return (
+            name in DECODE_WEIGHT_KEYS
+            and hasattr(leaf, "ndim")
+            and leaf.ndim >= 2
+            and jnp.issubdtype(leaf.dtype, jnp.floating)
+            and leaf.size >= min_size
+        )
+
+    return replace_params(params, pred, lambda _k, w: quantize_stacked_int8(w))
 
 
 def quantize_params_int8(

@@ -29,10 +29,12 @@ from .profiling import (
 )
 from .checkpoint import (
     CheckpointManager,
+    auto_resume,
     get_mp_ckpt_suffix,
     load_checkpoint,
     save_checkpoint,
 )
+from .preemption import GracefulShutdown
 
 __all__ = [
     "MetricsLogger",
@@ -54,6 +56,8 @@ __all__ = [
     "prof_stop",
     "scope_decorator",
     "CheckpointManager",
+    "GracefulShutdown",
+    "auto_resume",
     "get_mp_ckpt_suffix",
     "load_checkpoint",
     "save_checkpoint",

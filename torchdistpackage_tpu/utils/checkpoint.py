@@ -124,6 +124,30 @@ def load_checkpoint(
         return ckptr.restore(path, template)
 
 
+def auto_resume(
+    mgr: "CheckpointManager",
+    template: PyTree,
+    mesh: Optional[Mesh] = None,
+    specs: Optional[PyTree] = None,
+):
+    """``(start_step, state)`` for a preemption-safe loop: restore the
+    latest checkpoint when one exists (resuming at ``latest + 1``), else
+    start fresh from ``template``.  One call makes any training script
+    relaunch-safe::
+
+        start, state = auto_resume(mgr, {'params': params, 'opt': opt_state})
+        with GracefulShutdown() as stop:
+            for step in range(start, total): ...
+
+    ``mesh``/``specs`` flow through to :meth:`CheckpointManager.restore`
+    for resharding resumes (checkpoint from one mesh layout, resume on
+    another)."""
+    step = mgr.latest_step()
+    if step is None:
+        return 0, template
+    return step + 1, mgr.restore(step, template=template, mesh=mesh, specs=specs)
+
+
 class CheckpointManager:
     """Step-numbered checkpoints with retention + latest-step resume.
 
