@@ -329,3 +329,80 @@ def test_zigzag_permute_roundtrip():
     np.testing.assert_array_equal(np.asarray(zigzag_unpermute(z, 4, seq_dim=1)), np.asarray(x))
     pos, (lo, hi) = zigzag_positions(0, 8, 4)
     np.testing.assert_array_equal(np.asarray(pos), [0, 1, 2, 3, 28, 29, 30, 31])
+
+
+def test_flash_sliding_window_matches_reference():
+    """Sliding-window flash (Mistral semantics: key in (q-window, q]) must
+    equal the masked reference for fwd AND all grads, across windows
+    smaller than / equal to / larger than a KV block, GQA included, and
+    the out-of-window KV block range must actually be SKIPPED (the
+    O(S*window) compute claim)."""
+    import numpy as np
+
+    from torchdistpackage_tpu.ops.flash_attention import (
+        flash_attention,
+        mha_reference,
+    )
+
+    B, H, S, D = 2, 4, 256, 32
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, S, D)) for kk in ks)
+    kg, vg = k[:, ::2], v[:, ::2]  # GQA: 2 kv heads
+
+    for W in (1, 17, 64, 100, 256, 300):
+        ref = mha_reference(q, k, v, causal=True, window=W)
+        out = flash_attention(q, k, v, causal=True, window=W,
+                              block_q=64, block_k=128)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5, err_msg=f"W={W}")
+        gr = jax.grad(lambda *a: jnp.sum(
+            mha_reference(*a, causal=True, window=W) ** 2), argnums=(0, 1, 2)
+        )(q, k, v)
+        gf = jax.grad(lambda *a: jnp.sum(
+            flash_attention(*a, causal=True, window=W, block_q=64,
+                            block_k=128) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=3e-4, err_msg=f"W={W}")
+
+    # GQA + window
+    ref = mha_reference(q, kg, vg, causal=True, window=48)
+    out = flash_attention(q, kg, vg, causal=True, window=48,
+                          block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    # window requires causal; bad window rejected
+    import pytest
+
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=8)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, causal=True, window=0)
+
+
+def test_sliding_window_core_attention_and_cfg_guards():
+    import numpy as np
+    import pytest
+
+    from torchdistpackage_tpu.parallel.tensor_parallel import TransformerConfig
+    from torchdistpackage_tpu.parallel.tensor_parallel.layers import (
+        core_attention,
+    )
+    from torchdistpackage_tpu.ops.flash_attention import mha_reference
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 64, 16))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 64, 16))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 64, 16))
+    for impl in ("naive", "flash"):
+        cfg = TransformerConfig(dim=32, nheads=2, attn_impl=impl,
+                                sliding_window=16)
+        out = core_attention(q, k, v, cfg)
+        ref = mha_reference(q, k, v, causal=True, window=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5, err_msg=impl)
+    with pytest.raises(NotImplementedError, match="context-parallel"):
+        TransformerConfig(dim=32, nheads=2, attn_impl="ring",
+                          context_axis="context", sliding_window=16)
+    with pytest.raises(ValueError, match="causal"):
+        TransformerConfig(dim=32, nheads=2, causal=False, sliding_window=16)

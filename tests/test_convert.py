@@ -277,12 +277,40 @@ def test_llama_architecture_variants_parity(family):
     np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
 
 
-def test_sliding_window_rejected():
+def test_sliding_window_import_policy():
+    """Round 5: uniform sliding windows IMPORT (full golden in
+    test_mistral_sliding_window_logits_and_decode_parity); Qwen2-style
+    use_sliding_window=False means full attention; heterogeneous
+    full/sliding layer_types (Gemma-2 style) are refused."""
     cfg = transformers.MistralConfig(
         vocab_size=128, hidden_size=64, intermediate_size=96,
         num_hidden_layers=2, num_attention_heads=4, sliding_window=32)
-    with pytest.raises(NotImplementedError, match="sliding_window"):
+    assert llama_config_from_hf(cfg).sliding_window == 32
+
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, sliding_window=32,
+        use_sliding_window=False)
+    assert llama_config_from_hf(cfg).sliding_window is None
+
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, sliding_window=32)
+    cfg.layer_types = ["full_attention", "sliding_attention"]
+    with pytest.raises(NotImplementedError, match="layer_types"):
         llama_config_from_hf(cfg)
+
+    # a sliding-window tree cannot round-trip to LlamaConfig (which would
+    # silently run FULL attention) — the export refuses
+    from torchdistpackage_tpu.models.convert import to_hf_llama
+    from torchdistpackage_tpu.models import init_gpt_params, llama_config
+
+    wcfg = llama_config(vocab_size=64, dim=32, nheads=4, nlayers=2,
+                        max_seq=32, ffn_hidden=48, dtype=jnp.float32,
+                        sliding_window=8)
+    params = init_gpt_params(jax.random.PRNGKey(0), wcfg)
+    with pytest.raises(ValueError, match="sliding_window"):
+        to_hf_llama(params, wcfg)
 
 
 def test_llama_roundtrip():
@@ -347,3 +375,50 @@ def test_llama_roundtrip_with_biases():
     with torch.no_grad():
         got = hf(torch.from_numpy(tokens)).logits.numpy()
     np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_mistral_sliding_window_logits_and_decode_parity():
+    """A real MistralForCausalLM with sliding_window < S (the window
+    actually bites): import must preserve the window, full-forward logits
+    must match transformers, and greedy decode must match transformers'
+    generate — including past the window, where the cache mask matters."""
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        sliding_window=8, tie_word_embeddings=False,
+    )
+    torch.manual_seed(11)
+    hf = transformers.MistralForCausalLM(cfg).eval()
+    tokens = np.random.RandomState(12).randint(0, 128, size=(B, 32))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens)).logits.numpy()
+    mcfg, params = from_hf_llama(
+        hf.state_dict(), hf_config=hf.config, dtype=jnp.float32)
+    assert mcfg.sliding_window == 8
+    got = np.asarray(jax.jit(
+        lambda p, t: gpt_forward(p, t, mcfg))(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    # windowed logits must DIFFER from the full-attention forward at
+    # positions past the window (otherwise the mask is dead code)
+    import dataclasses
+
+    full = np.asarray(gpt_forward(
+        params, jnp.asarray(tokens),
+        dataclasses.replace(mcfg, sliding_window=None)))
+    assert np.abs(got[:, 16:] - full[:, 16:]).max() > 1e-3
+
+    prompt = np.random.RandomState(13).randint(0, 128, size=(1, 6))
+    with torch.no_grad():
+        want_t = hf.generate(
+            torch.from_numpy(prompt), max_new_tokens=20, do_sample=False,
+            num_beams=1).numpy()
+    got_t = np.asarray(jax.jit(
+        lambda p, t: generate(p, t, mcfg, max_new_tokens=20))(
+        params, jnp.asarray(prompt)))
+    # HF generate may stop at a (random-init) EOS token; compare the
+    # tokens it did emit — still >8 decode steps past the window
+    n = want_t.shape[1]
+    assert n > prompt.shape[1] + 8
+    np.testing.assert_array_equal(got_t[:, :n], want_t)

@@ -343,3 +343,70 @@ def test_moe_ep_sharded_decode_matches_serial(devices8):
         shard_map(run, mesh=moe_mesh, in_specs=(specs, P()), out_specs=P())
     )(sharded, prompt)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_int8_kv_cache_decode():
+    """int8 KV-cache quantization (the decode-bandwidth lever AFTER
+    weight-only int8 — docs/BENCH_AB.md 6b: at long ctx the cache bytes,
+    not the weights, bound decode).  (a) quality: per-vector-scaled int8
+    KV keeps greedy decode token-identical to the dense cache on both
+    families at these seeds, and the prefill-position logits stay close.
+    (b) structure: the decode scan CARRIES int8 cache leaves (jaxpr), so
+    HBM holds int8 KV between steps."""
+    for cfg in (GPT_CFG, LLAMA_CFG):
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (B, PROMPT), 0, cfg.vocab_size)
+        want = jax.jit(
+            lambda p, t: generate(p, t, cfg, max_new_tokens=NEW))(params, prompt)
+        got = jax.jit(
+            lambda p, t: generate(p, t, cfg, max_new_tokens=NEW,
+                                  kv_quant=True))(params, prompt)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    cfg = GPT_CFG
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((B, PROMPT), jnp.int32)
+    jaxpr = jax.make_jaxpr(
+        lambda p, t: generate(p, t, cfg, max_new_tokens=NEW, kv_quant=True)
+    )(params, prompt)
+    found = False
+    for e in jaxpr.jaxpr.eqns:
+        if e.primitive.name == "scan":
+            if any(getattr(v.aval, "dtype", None) == jnp.int8
+                   for v in e.params["jaxpr"].jaxpr.invars):
+                found = True
+    assert found, "decode scan does not carry int8 KV leaves"
+
+
+def test_int8_kv_cache_moe_and_tp():
+    """kv_quant composes with the MoE cached path (tuple-safe per-layer
+    slicing) and with TP decode."""
+    cfg = MOE_CFGS["mixtral"]
+    from torchdistpackage_tpu.models import (
+        gpt_moe_param_specs, init_gpt_moe_params)
+
+    params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, PROMPT), 0, 64)
+    want = generate(params, prompt, cfg, max_new_tokens=NEW)
+    got = jax.jit(lambda p, t: generate(
+        p, t, cfg, max_new_tokens=NEW, kv_quant=True))(params, prompt)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # TP x kv_quant on the dense family
+    dcfg = LLAMA_CFG
+    dparams = init_gpt_params(jax.random.PRNGKey(0), dcfg)
+    dwant = generate(dparams, prompt, dcfg, max_new_tokens=NEW)
+    from torchdistpackage_tpu.models import gpt_param_specs
+
+    tpc.setup_process_groups([("tensor", 2)], devices=jax.devices()[:2])
+    mesh = tpc.get_view()
+    specs = gpt_param_specs(dcfg, tp_axis="tensor")
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), dparams, specs)
+    got = jax.jit(shard_map(
+        lambda p, t: generate(p, t, dcfg, max_new_tokens=NEW, axis="tensor",
+                              kv_quant=True),
+        mesh=mesh, in_specs=(specs, P()), out_specs=P(),
+    ))(sharded, prompt)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(dwant))

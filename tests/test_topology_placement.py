@@ -130,8 +130,13 @@ def test_multi_slice_dcn_config_explicit():
 
 def test_derive_dcn_shape():
     assert _derive_dcn_shape(["data", "tensor"], [8, 4], 2, None) == [2, 1]
-    assert _derive_dcn_shape(["a", "b"], [6, 8], 4, None) == [2, 2]
+    assert _derive_dcn_shape(["a", "b", "c"], [6, 4, 8], 4, None) == [2, 2, 1]
+    # explicit dcn_config may put DCN anywhere — including the inner axis
     assert _derive_dcn_shape(["a", "b"], [8, 4], 4, {"b": 4}) == [1, 4]
+    # ...but the IMPLICIT derivation must never leak DCN onto the
+    # stride-1 axis (TP collectives crossing DCN silently — review r5)
+    with pytest.raises(ValueError, match="innermost axis"):
+        _derive_dcn_shape(["data", "tensor"], [2, 8], 4, None)
     with pytest.raises(ValueError, match="cannot distribute"):
         _derive_dcn_shape(["a", "b"], [5, 7], 2, None)
     with pytest.raises(ValueError, match="multiplies to"):

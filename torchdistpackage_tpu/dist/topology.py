@@ -125,6 +125,18 @@ def _derive_dcn_shape(
             f"cannot distribute {num_slices} slices over axis sizes "
             f"{list(sizes)}; pass dcn_config explicitly"
         )
+    if len(shape) > 1 and shape[-1] != 1:
+        # the greedy fallback would put DCN on the stride-1 axis — the one
+        # the ordered-config contract promises is the most network-LOCAL
+        # (e.g. [('data', 2), ('tensor', 8)] on 4 slices: TP collectives
+        # would silently cross DCN every layer).  Never silently: the
+        # operator must say so explicitly.
+        raise ValueError(
+            f"distributing {num_slices} slices over {list(zip(names, sizes))} "
+            f"would put a DCN factor on the innermost axis "
+            f"{names[-1]!r} (derived {shape}); if that is intended, pass "
+            f"dcn_config explicitly"
+        )
     return shape
 
 

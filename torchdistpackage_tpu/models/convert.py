@@ -20,6 +20,11 @@ golden in tests/test_convert.py):
 - HF ``rms_norm_eps`` is whatever the checkpoint says (1e-5 or 1e-6); it is
   preserved into ``GPTConfig.norm_eps`` on import and round-trips through
   :func:`to_hf_llama`.
+- Mistral-style ``sliding_window`` checkpoints import with the window
+  preserved (``GPTConfig.sliding_window`` — flash kernel, naive reference
+  and KV-cache decode all honor it; MistralForCausalLM logits golden);
+  Qwen2's ``use_sliding_window=False`` means full attention and imports
+  as such.
 - Llama proper has no attention/MLP biases, so those leaves import as
   zeros; ``attention_bias=True`` / ``mlp_bias=True`` checkpoints
   (Qwen-style architectures served through LlamaForCausalLM) DO carry
@@ -103,15 +108,17 @@ def llama_config_from_hf(hf_cfg, dtype: Any = jnp.bfloat16) -> GPTConfig:
                 original_max_position_embeddings=hf_cfg.max_position_embeddings,
             )
     sw = getattr(hf_cfg, "sliding_window", None)
-    if sw is not None and getattr(hf_cfg, "use_sliding_window", True):
-        # Mistral/Qwen2-style sliding-window attention is a DIFFERENT
-        # attention pattern; importing it as full attention would silently
-        # diverge at S > window
-        raise NotImplementedError(
-            f"sliding_window={sw}: sliding-window attention is not "
-            f"implemented; import only full-attention checkpoints "
-            f"(sliding_window=None)"
-        )
+    if sw is not None and not getattr(hf_cfg, "use_sliding_window", True):
+        # Qwen2-style: the field is populated but the feature is off
+        sw = None
+    if sw is not None:
+        layer_types = getattr(hf_cfg, "layer_types", None)
+        if layer_types and len(set(layer_types)) > 1:
+            # per-layer full/sliding alternation (Gemma-2 style) is a
+            # different pattern from the uniform window this import carries
+            raise NotImplementedError(
+                f"heterogeneous layer_types {set(layer_types)}: only "
+                f"uniform sliding-window checkpoints import")
     act = getattr(hf_cfg, "hidden_act", "silu")
     if act not in ("silu", "swish"):
         # LlamaConfig permits any ACT2FN key; the framework's swiglu gates
@@ -145,6 +152,7 @@ def llama_config_from_hf(hf_cfg, dtype: Any = jnp.bfloat16) -> GPTConfig:
         rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)),
         rope_scaling=dict(scaling) if scaling else None,
         norm_eps=float(getattr(hf_cfg, "rms_norm_eps", 1e-5)),
+        sliding_window=int(sw) if sw is not None else None,
         dtype=dtype,
     )
 
@@ -378,6 +386,15 @@ def to_hf_llama(
         raise ValueError(
             "to_hf_llama exports Llama-family configs only "
             f"(norm={cfg.norm!r}, act={cfg.act!r}, pos={cfg.pos!r})"
+        )
+    if cfg.sliding_window is not None:
+        # LlamaForCausalLM ignores a sliding_window kwarg — serving the
+        # export would silently run FULL attention past the window
+        raise ValueError(
+            f"sliding_window={cfg.sliding_window}: LlamaConfig has no "
+            f"sliding-window attention; export such trees to a Mistral "
+            f"architecture instead (same state-dict names — use these "
+            f"weights with transformers.MistralConfig)"
         )
 
     def a(x):

@@ -53,12 +53,23 @@ PyTree = Any
 
 
 def init_kv_cache(
-    cfg: GPTConfig, batch: int, max_len: int, axis_size: int = 1
-) -> Dict[str, jnp.ndarray]:
+    cfg: GPTConfig, batch: int, max_len: int, axis_size: int = 1,
+    quantized: bool = False,
+) -> Dict[str, Any]:
     """Zeroed cache ``{'k','v': [L, B, Hkv_local, max_len, hd]}`` in
     ``cfg.dtype``.  ``axis_size`` divides the KV heads for TP (call inside
     shard_map with ``jax.lax.axis_size(axis)``, or build the global
-    [L, B, Hkv, ...] array outside and shard dim 2 over the tensor axis)."""
+    [L, B, Hkv, ...] array outside and shard dim 2 over the tensor axis).
+
+    ``quantized=True``: int8 KV storage — each 'k'/'v' entry becomes a
+    ``(q8, scale)`` pair (scale [L, B, Hkv, max_len] f32, one symmetric
+    scale per written position-vector, computed at append time).  Decode
+    reads the cache once per token, so at long context the KV bytes — not
+    the weights — bound throughput (docs/BENCH_AB.md 6b); int8 halves
+    them vs bf16.  Dequant happens in-register inside the attention
+    einsums (:func:`_cached_attention` folds the k-scale into the score
+    and the v-scale into the probabilities).  The pair is a pytree, so
+    the decode scan slices/stacks it like any dense cache leaf."""
     hkv, rem = divmod(cfg.block.kv_head_count, axis_size)
     if rem or hkv == 0:
         raise ValueError(
@@ -66,26 +77,75 @@ def init_kv_cache(
             f"{axis_size} (whole KV heads per shard)"
         )
     shape = (cfg.nlayers, batch, hkv, max_len, cfg.block.head_dim)
+    if quantized:
+        def entry():
+            return (jnp.zeros(shape, jnp.int8),
+                    jnp.ones(shape[:-1], jnp.float32))
+        return {"k": entry(), "v": entry()}
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
-def _cached_attention(
-    q: jnp.ndarray, ck: jnp.ndarray, cv: jnp.ndarray, offset
-) -> jnp.ndarray:
+def _kv_quant(x: jnp.ndarray):
+    """[..., hd] -> (int8 [..., hd], scale [...]) — symmetric per-vector."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def _cache_write(c, val: jnp.ndarray, offset):
+    """Append ``val`` [B, Hkv, S_in, hd] at ``offset`` — dense array or
+    quantized (q8, scale) pair, one code path for both."""
+    if isinstance(c, tuple):
+        q8, scale = c
+        vq, vs = _kv_quant(val)
+        return (
+            jax.lax.dynamic_update_slice(q8, vq, (0, 0, offset, 0)),
+            jax.lax.dynamic_update_slice(scale, vs, (0, 0, offset)),
+        )
+    return jax.lax.dynamic_update_slice(c, val.astype(c.dtype), (0, 0, offset, 0))
+
+
+def _cached_attention(q: jnp.ndarray, ck, cv, offset, window=None) -> jnp.ndarray:
     """Grouped-query attention of q [B, H, S_in, hd] against the full cache
     ck/cv [B, Hkv, T, hd], masked to ``key_pos <= offset + query_row``.
-    f32 softmax, 1/sqrt(hd) scale — the mha_reference conventions."""
+    f32 softmax, 1/sqrt(hd) scale — the mha_reference conventions.
+
+    Quantized caches pass ``(q8, scale)`` pairs: the int8 payload is upcast
+    in-register and the per-position scale folds into the scores (k) or
+    the probabilities (v) — both exact because the scale is constant along
+    the contracted hd dim, so HBM only ever moves int8 cache bytes."""
     B, H, S_in, hd = q.shape
+    k_scale = v_scale = None
+    if isinstance(ck, tuple):
+        ck, k_scale = ck
+    if isinstance(cv, tuple):
+        cv, v_scale = cv
     Hkv, T = ck.shape[1], ck.shape[2]
     g = H // Hkv
     qg = q.reshape(B, Hkv, g, S_in, hd)
-    s = jnp.einsum("bkgqh,bkth->bkgqt", qg, ck).astype(jnp.float32)
+    s = jnp.einsum(
+        "bkgqh,bkth->bkgqt", qg.astype(jnp.float32) if k_scale is not None else qg,
+        ck.astype(qg.dtype if k_scale is None else jnp.float32),
+    ).astype(jnp.float32)
+    if k_scale is not None:
+        s = s * k_scale[:, :, None, None, :]
     s = s * (1.0 / math.sqrt(hd))
     qpos = offset + jnp.arange(S_in)
     mask = jnp.arange(T)[None, :] <= qpos[:, None]  # [S_in, T]
+    if window is not None:  # Mistral: key in (qpos - window, qpos]
+        mask = mask & (jnp.arange(T)[None, :] > qpos[:, None] - window)
     s = jnp.where(mask[None, None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
-    out = jnp.einsum("bkgqt,bkth->bkgqh", p, cv)
+    p = jax.nn.softmax(s, axis=-1)
+    if v_scale is not None:
+        p = p * v_scale[:, :, None, None, :]
+        out = jnp.einsum("bkgqt,bkth->bkgqh", p, cv.astype(jnp.float32))
+        out = out.astype(q.dtype)
+    else:
+        p = p.astype(cv.dtype)
+        out = jnp.einsum("bkgqt,bkth->bkgqh", p, cv)
     return out.reshape(B, H, S_in, hd)
 
 
@@ -113,8 +173,8 @@ def cached_block_forward(
     B, S_in, D = x.shape
     h = layer_norm(x, p["ln1"], cfg.norm_eps)
     q, k, v = compute_qkv(p["attn"], h, cfg, rope=rope)
-    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, offset, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, offset, 0))
+    ck = _cache_write(ck, k, offset)
+    cv = _cache_write(cv, v, offset)
     if isinstance(offset, int) and offset == 0 and S_in > 1:
         # prefill: every cached key IS this call's k, so causal attention
         # over (q, k, v) equals the cache-masked form — and runs the
@@ -125,7 +185,8 @@ def cached_block_forward(
 
         out = core_attention(q, k, v, cfg)
     else:
-        out = _cached_attention(q, ck, cv, offset)
+        out = _cached_attention(q, ck, cv, offset,
+                                window=cfg.sliding_window)
     out = out.transpose(0, 2, 1, 3).reshape(B, S_in, q.shape[1] * cfg.head_dim)
     y = dense(out, p["attn"]["wo"])
     y = _close_row_parallel(y, p["attn"]["bo"], axis, False)
@@ -253,14 +314,16 @@ def forward_cached_moe(
             return z
 
     ks, vs = [], []
+    layer = lambda c, i: jax.tree.map(lambda a: a[i], c)  # tuple-safe (int8)
     for i, bp in enumerate(params["blocks"]):
         h, ck, cv = cached_block_forward(
-            bp, h, bcfg, cache["k"][i], cache["v"][i], offset, axis=axis,
-            rope=rope, ffn=moe_ffn if "moe" in bp else None,
+            bp, h, bcfg, layer(cache["k"], i), layer(cache["v"], i), offset,
+            axis=axis, rope=rope, ffn=moe_ffn if "moe" in bp else None,
         )
         ks.append(ck)
         vs.append(cv)
-    cache = {"k": jnp.stack(ks), "v": jnp.stack(vs)}
+    stack = lambda cs: jax.tree.map(lambda *xs: jnp.stack(xs), *cs)
+    cache = {"k": stack(ks), "v": stack(vs)}
     logits = gpt_head(params, h[:, -1:, :], axis, False, eps=cfg.norm_eps)
     return cache, logits[:, 0, :]
 
@@ -342,6 +405,7 @@ def generate(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     ep_axis: Optional[str] = None,
+    kv_quant: bool = False,
 ) -> jnp.ndarray:
     """Autoregressively extend ``prompt`` [B, P] by ``max_new_tokens``.
     Greedy when ``key`` is None, else temperature sampling with optional
@@ -385,7 +449,8 @@ def generate(
             f"table ({cfg.max_seq})"
         )
     axis_size = 1 if axis is None else jax.lax.axis_size(axis)
-    cache = init_kv_cache(cfg, B, total, axis_size=axis_size)
+    cache = init_kv_cache(cfg, B, total, axis_size=axis_size,
+                          quantized=kv_quant)
 
     cache, logits = fwd(params, prompt, cfg, cache, 0, axis)
     k0 = None

@@ -91,6 +91,9 @@ class GPTConfig:
     # norm epsilon: preserved from HF checkpoints (rms_norm_eps is 1e-5 or
     # 1e-6 depending on the family) by models/convert.py
     norm_eps: float = 1e-5
+    # sliding-window attention (Mistral family) — see
+    # TransformerConfig.sliding_window
+    sliding_window: Optional[int] = None
     # Mixture-of-Experts (0 = dense model).  With ``moe_experts > 0`` every
     # ``moe_every``-th block's FFN becomes an expert layer (Switch-style
     # alternation); use the gpt_moe_* family (models/gpt_moe.py) which
@@ -145,6 +148,7 @@ class GPTConfig:
             act=self.act,
             ffn_hidden=self.ffn_hidden,
             norm_eps=self.norm_eps,
+            sliding_window=self.sliding_window,
         )
 
     def num_params(self) -> int:

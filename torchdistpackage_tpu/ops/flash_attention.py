@@ -105,6 +105,7 @@ def mha_reference(
     v: jnp.ndarray,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Plain softmax(QK^T)V golden — [B, H, S, D] layout.  Grouped-query
     attention: ``k``/``v`` may carry fewer heads (H_q % H_kv == 0); each
@@ -121,7 +122,13 @@ def mha_reference(
     if causal:
         Sq, Sk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((Sq, Sk), dtype=bool), k=Sk - Sq)
+        if window is not None:
+            # Mistral semantics: key in (qpos - window, qpos]
+            mask = mask & jnp.triu(
+                jnp.ones((Sq, Sk), dtype=bool), k=Sk - Sq - window + 1)
         s = jnp.where(mask, s, NEG_INF)
+    elif window is not None:
+        raise ValueError("sliding window requires causal attention")
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
@@ -141,12 +148,30 @@ def _causal_hi(qi, block_q, block_k, num_kv):
     return jnp.minimum(hi, num_kv)
 
 
+def _window_lo(qi, block_q, block_k, window):
+    """First KV block with any in-window key for q row-block ``qi``
+    (lowest needed key position = qi*block_q - window + 1)."""
+    return jnp.maximum(jax.lax.div(qi * block_q - window + 1, block_k), 0)
+
+
+def _window_mask(s, qi, kj, block_q, block_k, window):
+    """Causal + sliding-window in-block mask: key in (qpos-window, qpos]."""
+    qpos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    keep = kpos <= qpos
+    if window is not None:
+        keep = keep & (kpos > qpos - window)
+    return jnp.where(keep, s, NEG_INF)
+
+
 # ------------------------------------------------------------------- forward
 
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, sm_scale, causal, num_kv,
+    *, sm_scale, causal, num_kv, window=None,
 ):
     block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
@@ -154,6 +179,7 @@ def _fwd_kernel(
     kj = pl.program_id(2)
 
     hi = _causal_hi(qi, block_q, block_k, num_kv) if causal else num_kv
+    lo = _window_lo(qi, block_q, block_k, window) if window is not None else 0
 
     @pl.when(kj == 0)
     def _init():
@@ -161,7 +187,7 @@ def _fwd_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    @pl.when(kj < hi)
+    @pl.when((kj >= lo) & (kj < hi))
     def _compute():
         q = q_ref[0]  # [Bq, D] storage dtype — MXU takes bf16 in, f32 out
         kblk = k_ref[0]
@@ -170,13 +196,7 @@ def _fwd_kernel(
         l = l_ref[:, :1]
         s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(kpos <= qpos, s, NEG_INF)
+            s = _window_mask(s, qi, kj, block_q, block_k, window)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
@@ -195,13 +215,14 @@ def _fwd_kernel(
         lse_ref[0] = m + jnp.log(l)  # [Bq, 1]
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k, groups=1):
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, groups=1, window=None):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     num_kv = Sk // block_k
     grid = (BH, Sq // block_q, num_kv)
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, num_kv=num_kv
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, num_kv=num_kv,
+        window=window,
     )
     # GQA: q is flattened [B*Hq, ...] b-major with the G q-heads of a group
     # consecutive, kv is [B*Hkv, ...] — kv block for q-program b is b//G
@@ -238,7 +259,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, groups=1):
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
-    *, sm_scale, causal, num_kv,
+    *, sm_scale, causal, num_kv, window=None,
 ):
     block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
@@ -246,12 +267,13 @@ def _bwd_dq_kernel(
     kj = pl.program_id(2)
 
     hi = _causal_hi(qi, block_q, block_k, num_kv) if causal else num_kv
+    lo = _window_lo(qi, block_q, block_k, window) if window is not None else 0
 
     @pl.when(kj == 0)
     def _init():
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-    @pl.when(kj < hi)
+    @pl.when((kj >= lo) & (kj < hi))
     def _compute():
         q = q_ref[0]
         do = do_ref[0]
@@ -261,13 +283,7 @@ def _bwd_dq_kernel(
         vblk = v_ref[0]
         s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(kpos <= qpos, s, NEG_INF)
+            s = _window_mask(s, qi, kj, block_q, block_k, window)
         p = jnp.exp(s - lse)  # [Bq, Bk]
         dp = jnp.dot(do, vblk.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(kblk.dtype)
@@ -283,22 +299,29 @@ def _bwd_dq_kernel(
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc_ref, dv_acc_ref,
-    *, sm_scale, causal, num_q,
+    *, sm_scale, causal, num_q, window=None,
 ):
     block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
-    # causal: only q blocks at or after this kv block contribute
+    # causal: only q blocks at or after this kv block contribute; a window
+    # additionally bounds ABOVE (no q past kpos_max + window - 1 sees it)
     lo = jax.lax.div(ki * block_k, block_q) if causal else 0
+    if window is not None:
+        hi_q = jnp.minimum(
+            jax.lax.div((ki + 1) * block_k - 1 + window - 1, block_q) + 1,
+            num_q)
+    else:
+        hi_q = num_q
 
     @pl.when(qi == 0)
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    @pl.when(qi >= lo)
+    @pl.when((qi >= lo) & (qi < hi_q))
     def _compute():
         k = k_ref[0]
         v = v_ref[0]
@@ -308,13 +331,7 @@ def _bwd_dkv_kernel(
         delta = delta_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale  # [Bq, Bk]
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(kpos <= qpos, s, NEG_INF)
+            s = _window_mask(s, qi, ki, block_q, block_k, window)
         p = jnp.exp(s - lse)
         dv_acc_ref[...] = dv_acc_ref[...] + jnp.dot(
             p.T.astype(do.dtype), do, preferred_element_type=jnp.float32
@@ -331,7 +348,7 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, groups, res, cts):
+def _bwd(sm_scale, causal, block_q, block_k, groups, window, res, cts):
     q, k, v, o, lse = res
     dout, dlse = cts
     BH, Sq, D = q.shape
@@ -349,7 +366,8 @@ def _bwd(sm_scale, causal, block_q, block_k, groups, res, cts):
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, num_kv=num_kv
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, num_kv=num_kv,
+            window=window,
         ),
         grid=(BH, num_q, num_kv),
         in_specs=[
@@ -374,7 +392,8 @@ def _bwd(sm_scale, causal, block_q, block_k, groups, res, cts):
     dkv_dtype = k.dtype if groups == 1 else jnp.float32
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, num_q=num_q
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, num_q=num_q,
+            window=window,
         ),
         grid=(BH, num_kv, num_q),
         in_specs=[
@@ -410,13 +429,14 @@ def _bwd(sm_scale, causal, block_q, block_k, groups, res, cts):
 # ------------------------------------------------------------------ public op
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, groups=1):
-    return _fwd(q, k, v, sm_scale, causal, block_q, block_k, groups)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, groups=1, window=None):
+    return _fwd(q, k, v, sm_scale, causal, block_q, block_k, groups, window)
 
 
-def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, groups=1):
-    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, groups)
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, groups=1,
+                    window=None):
+    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, groups, window)
     # Name the kernel's residuals so rematerialization policies can elect to
     # save them: under jax.checkpoint with
     # save_only_these_names('flash_out', 'flash_lse') (scan_blocks
@@ -432,8 +452,9 @@ def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, groups=1):
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(sm_scale, causal, block_q, block_k, groups, res, cts):
-    return _bwd(sm_scale, causal, block_q, block_k, groups, res, cts)
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, groups, window,
+                    res, cts):
+    return _bwd(sm_scale, causal, block_q, block_k, groups, window, res, cts)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -471,8 +492,14 @@ def flash_attention(
     sm_scale: Optional[float] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Blockwise (flash) attention.  [B, H, S, D] layout, differentiable.
+
+    ``window``: sliding-window attention (Mistral semantics — query q
+    attends keys in ``(q - window, q]``; requires ``causal``).  Both the
+    in-block mask AND the KV block range are bounded (``_window_lo``), so
+    compute drops to O(S*window) like the causal bound drops it to half.
 
     **Grouped-query attention**: ``k``/``v`` may carry fewer heads than
     ``q`` (``H_q % H_kv == 0`` — MQA is ``H_kv == 1``); each group of
@@ -494,10 +521,15 @@ def flash_attention(
     MXU busier; VMEM per program stays ~2 MB, well under budget at
     head_dim 64.
     """
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     B, H, Sq, D = q.shape
     qf, kf, vf, sm_scale, block_q, block_k, groups = _prep(
         q, k, v, sm_scale, block_q, block_k)
-    o, _ = _flash(qf, kf, vf, sm_scale, bool(causal), block_q, block_k, groups)
+    o, _ = _flash(qf, kf, vf, sm_scale, bool(causal), block_q, block_k,
+                  groups, None if window is None else int(window))
     return o.reshape(B, H, Sq, D)
 
 

@@ -100,8 +100,24 @@ class TransformerConfig:
     # norm epsilon — HF checkpoints carry 1e-5 or 1e-6 (rms_norm_eps) and
     # models/convert.py preserves whichever the checkpoint says
     norm_eps: float = 1e-5
+    # sliding-window attention (Mistral): query q attends keys in
+    # (q - window, q].  None = full causal.  Served by the flash kernel
+    # (block-range bounded — O(S*window) compute), the naive reference and
+    # the KV-cache decode mask; rejected for the CP impls (a ring shard
+    # boundary would silently change the window's reach).
+    sliding_window: "int | None" = None
 
     def __post_init__(self):
+        if self.sliding_window is not None:
+            if self.attn_impl in ("ring", "ulysses"):
+                raise NotImplementedError(
+                    "sliding_window is not supported with context-parallel "
+                    "attention (ring/ulysses)")
+            if not self.causal:
+                raise ValueError("sliding_window requires causal attention")
+            if self.sliding_window < 1:
+                raise ValueError(
+                    f"sliding_window must be >= 1, got {self.sliding_window}")
         if self.norm not in ("layer", "rms"):
             raise ValueError(f"norm must be 'layer' or 'rms', got {self.norm!r}")
         if self.act not in ("gelu", "swiglu"):
@@ -475,7 +491,8 @@ def core_attention(
     if cfg.attn_impl == "flash":
         from ...ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=cfg.causal)
+        return flash_attention(q, k, v, causal=cfg.causal,
+                               window=cfg.sliding_window)
     if cfg.attn_impl == "ring":
         from ...ops.ring_attention import ring_attention
 
@@ -489,7 +506,8 @@ def core_attention(
         return ulysses_attention(q, k, v, axis=cfg.context_axis, causal=cfg.causal)
     from ...ops.flash_attention import mha_reference
 
-    return mha_reference(q, k, v, causal=cfg.causal)
+    return mha_reference(q, k, v, causal=cfg.causal,
+                         window=cfg.sliding_window)
 
 
 def mlp_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:

@@ -21,7 +21,8 @@ import sys
 import time
 
 
-def bench_decode(jax, jnp, cfg, params, B, ctx, steps=64, reps=3):
+def bench_decode(jax, jnp, cfg, params, B, ctx, steps=64, reps=3,
+                 kv_quant=False):
     """Decode tokens/sec through the REAL serving path — ``generate()``'s
     single-jit scan (static cache, no host round trips).  Prefill cost is
     cancelled by differencing two generation lengths; best of ``reps``."""
@@ -38,7 +39,8 @@ def bench_decode(jax, jnp, cfg, params, B, ctx, steps=64, reps=3):
 
     fns = {}
     for n in (short, long_):
-        f = jax.jit(lambda p, t, n=n: generate(p, t, cfg, max_new_tokens=n))
+        f = jax.jit(lambda p, t, n=n: generate(
+            p, t, cfg, max_new_tokens=n, kv_quant=kv_quant))
         sync(f(params, prompt))  # compile
         fns[n] = f
 
@@ -92,6 +94,17 @@ def main():
     for B, ctx in cells:
         r_bf = bench_decode(jax, jnp, cfg, params, B, ctx, steps)
         r_q = bench_decode(jax, jnp, cfg, qp, B, ctx, steps)
+        r_qkv = bench_decode(jax, jnp, cfg, qp, B, ctx, steps, kv_quant=True)
+        if r_bf > 0 and r_qkv > 0:
+            print(json.dumps({
+                "B": B, "ctx": ctx, "int8w+int8kv_tok_s": round(r_qkv, 1),
+                "speedup_vs_bf16": round(r_qkv / r_bf, 3),
+            }), flush=True)
+        else:
+            print(json.dumps({"B": B, "ctx": ctx, "kv_quant": True,
+                              "degenerate": True,
+                              "int8w+int8kv_tok_s": round(r_qkv, 1)}),
+                  flush=True)
         if r_bf <= 0 or r_q <= 0:
             # every rep's length-difference fell inside timing noise (tiny
             # smoke shapes): report the degenerate cell instead of a
