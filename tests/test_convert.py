@@ -293,6 +293,21 @@ def test_sliding_window_import_policy():
         use_sliding_window=False)
     assert llama_config_from_hf(cfg).sliding_window is None
 
+    # Qwen2 semantics (review r5 finding): use_sliding_window=True but
+    # max_window_layers >= num_layers means every layer runs FULL
+    # attention in HF — importing it windowed would silently diverge
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=4, num_attention_heads=4, sliding_window=32,
+        use_sliding_window=True, max_window_layers=4)
+    assert llama_config_from_hf(cfg).sliding_window is None
+    # ...and max_window_layers=0 means every layer slides
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=4, num_attention_heads=4, sliding_window=32,
+        use_sliding_window=True, max_window_layers=0)
+    assert llama_config_from_hf(cfg).sliding_window == 32
+
     cfg = transformers.MistralConfig(
         vocab_size=128, hidden_size=64, intermediate_size=96,
         num_hidden_layers=2, num_attention_heads=4, sliding_window=32)

@@ -410,3 +410,52 @@ def test_int8_kv_cache_moe_and_tp():
         mesh=mesh, in_specs=(specs, P()), out_specs=P(),
     ))(sharded, prompt)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(dwant))
+
+
+def test_speculative_decode_lossless():
+    """Speculative decode must be LOSSLESS: bit-equal to plain greedy
+    generate for a perfect draft (self), a realistic draft (int8
+    quantized), and an adversarial draft (different random model — near
+    0% acceptance), on both families, composing with kv_quant.  The
+    draft can only change speed, never output."""
+    from torchdistpackage_tpu.models import speculative_generate
+    from torchdistpackage_tpu.tools.surgery import quantize_decode_params
+
+    for cfg in (GPT_CFG, LLAMA_CFG):
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, max_seq=64)  # room for K+1 slack
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (1, PROMPT), 0, cfg.vocab_size)
+        want = np.asarray(jax.jit(
+            lambda p, t: generate(p, t, cfg, max_new_tokens=16))(params, prompt))
+        drafts = {
+            "self": params,
+            "int8": quantize_decode_params(params, min_size=512),
+            "adversarial": init_gpt_params(jax.random.PRNGKey(99), cfg),
+        }
+        for name, dp in drafts.items():
+            got = np.asarray(jax.jit(
+                lambda p, d, t: speculative_generate(
+                    p, d, t, cfg, max_new_tokens=16))(params, dp, prompt))
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"{cfg.norm} draft={name}")
+        # x kv_quant and a different K
+        got = np.asarray(jax.jit(
+            lambda p, d, t: speculative_generate(
+                p, d, t, cfg, max_new_tokens=16, num_draft=7,
+                kv_quant=True))(params, drafts["int8"], prompt))
+        np.testing.assert_array_equal(got, want, err_msg=f"{cfg.norm} kvq")
+
+
+def test_speculative_decode_guards():
+    from torchdistpackage_tpu.models import speculative_generate
+
+    params = init_gpt_params(jax.random.PRNGKey(0), GPT_CFG)
+    with pytest.raises(ValueError, match="B == 1"):
+        speculative_generate(params, params, jnp.zeros((2, 4), jnp.int32),
+                             GPT_CFG, max_new_tokens=4)
+    with pytest.raises(ValueError, match="num_draft"):
+        speculative_generate(params, params, jnp.zeros((1, 4), jnp.int32),
+                             GPT_CFG, max_new_tokens=4, num_draft=0)

@@ -24,6 +24,7 @@ from .generate import (
     forward_cached,
     forward_cached_moe,
     generate,
+    speculative_generate,
     init_kv_cache,
 )
 from .gpt_moe import (

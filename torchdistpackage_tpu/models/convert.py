@@ -113,12 +113,31 @@ def llama_config_from_hf(hf_cfg, dtype: Any = jnp.bfloat16) -> GPTConfig:
         sw = None
     if sw is not None:
         layer_types = getattr(hf_cfg, "layer_types", None)
-        if layer_types and len(set(layer_types)) > 1:
-            # per-layer full/sliding alternation (Gemma-2 style) is a
-            # different pattern from the uniform window this import carries
-            raise NotImplementedError(
-                f"heterogeneous layer_types {set(layer_types)}: only "
-                f"uniform sliding-window checkpoints import")
+        if layer_types:
+            kinds = set(layer_types)
+            if len(kinds) > 1:
+                # per-layer full/sliding alternation (Gemma-2/Qwen2
+                # max_window_layers style) is a different pattern from the
+                # uniform window this import carries
+                raise NotImplementedError(
+                    f"heterogeneous layer_types {kinds}: only uniform "
+                    f"sliding-window checkpoints import")
+            if kinds == {"full_attention"}:
+                # Qwen2 with max_window_layers >= num_layers: the field is
+                # set but every layer runs FULL attention in HF
+                sw = None
+        else:
+            # older transformers without layer_types: Qwen2 applies the
+            # window only to layers >= max_window_layers
+            mwl = getattr(hf_cfg, "max_window_layers", None)
+            if mwl is not None:
+                if mwl >= hf_cfg.num_hidden_layers:
+                    sw = None  # no layer actually slides
+                elif mwl > 0:
+                    raise NotImplementedError(
+                        f"max_window_layers={mwl} of "
+                        f"{hf_cfg.num_hidden_layers}: partially-windowed "
+                        f"checkpoints (per-layer mix) are not supported")
     act = getattr(hf_cfg, "hidden_act", "silu")
     if act not in ("silu", "swish"):
         # LlamaConfig permits any ACT2FN key; the framework's swiglu gates

@@ -221,6 +221,7 @@ def forward_cached(
     cache: Dict[str, jnp.ndarray],
     offset,
     axis: Optional[str] = None,
+    all_logits: bool = False,
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
     """Run ``tokens`` [B, S_in] (occupying global positions
     ``offset + arange(S_in)``) through the cached stack.  Returns the
@@ -248,6 +249,11 @@ def forward_cached(
     h, (ck, cv) = jax.lax.scan(
         body, h, (params["blocks"], cache["k"], cache["v"])
     )
+    if all_logits:
+        # per-position logits [B, S_in, V_local] — the speculative-decode
+        # verify pass needs the model's argmax at EVERY drafted position
+        return {"k": ck, "v": cv}, gpt_head(
+            params, h, axis, False, eps=cfg.norm_eps)
     logits = gpt_head(params, h[:, -1:, :], axis, False, eps=cfg.norm_eps)  # [B, 1, V_local]
     return {"k": ck, "v": cv}, logits[:, 0, :]
 
@@ -485,3 +491,117 @@ def generate(
         # result as axis-invariant so callers can use out_specs P()
         tokens = jax.lax.pmax(tokens, axis)
     return tokens
+
+
+def speculative_generate(
+    params: Dict[str, PyTree],
+    draft_params: Dict[str, PyTree],
+    prompt: jnp.ndarray,
+    cfg: GPTConfig,
+    max_new_tokens: int,
+    draft_cfg: Optional[GPTConfig] = None,
+    num_draft: int = 4,
+    kv_quant: bool = False,
+) -> jnp.ndarray:
+    """Greedy speculative decoding: a cheap DRAFT model proposes
+    ``num_draft`` tokens per macro-step, the target model verifies them
+    in ONE (K+1)-position cached forward, and the longest agreeing prefix
+    plus the target's own correction token are emitted.
+
+    **Lossless by construction**: every emitted token is the target
+    model's greedy argmax on its certified prefix, whatever the draft
+    proposes — a random draft only makes it slow, never wrong (the test
+    asserts bit-equality with :func:`generate` for good, quantized AND
+    adversarial drafts).  Decode is weight-bandwidth-bound
+    (docs/BENCH_AB.md 6b), and a (K+1)-row verify forward reads the
+    weights ONCE — so accepted drafts amortize the target's HBM traffic
+    over up to K+1 tokens.  The natural self-speculative pairing is
+    ``draft_params = tools.surgery.quantize_decode_params(params)``:
+    the int8 draft is ~1.7x faster per token and near-always agrees.
+
+    Static-shape design: both KV caches are fixed buffers; stale entries
+    past the certified position are never attended (the position mask
+    excludes them) and are overwritten when real tokens reach them, so
+    rejected drafts need NO cache rollback.  The macro loop is a
+    ``lax.while_loop`` on the certified position — data-dependent
+    progress (1..K+1 tokens per macro-step) with zero retraces.
+
+    Single-sequence (B == 1), serial (no TP axis) — the latency regime
+    speculative decoding exists for.  ``draft_cfg`` defaults to ``cfg``
+    (self-speculation); a distinct smaller model needs the same vocab.
+    """
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "speculative_generate supports the dense families")
+    if cfg.attn_impl in ("ring", "ulysses"):
+        raise NotImplementedError(
+            "context-parallel decode is not supported: the KV cache is not "
+            "sequence-sharded. attn_impl is a runtime choice — decode a "
+            "CP-trained checkpoint with dataclasses.replace(cfg, "
+            "attn_impl='flash', context_axis=None)"
+        )
+    B, P = prompt.shape
+    if B != 1:
+        raise ValueError(f"speculative decode is B == 1 (got {B})")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    K = int(num_draft)
+    if K < 1:
+        raise ValueError(f"num_draft must be >= 1, got {K}")
+    dcfg = draft_cfg or cfg
+    if dcfg.vocab_size != cfg.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    total = P + max_new_tokens + K + 1  # slack for overshoot writes
+    if cfg.pos == "learned" and total > cfg.max_seq:
+        raise ValueError(
+            f"P + max_new_tokens + num_draft + 1 = {total} exceeds the "
+            f"learned position table ({cfg.max_seq})")
+    cache_v = init_kv_cache(cfg, 1, total, quantized=kv_quant)
+    cache_d = init_kv_cache(dcfg, 1, total, quantized=kv_quant)
+
+    cache_v, logits = forward_cached(params, prompt, cfg, cache_v, 0)
+    cache_d, _ = forward_cached(draft_params, prompt, dcfg, cache_d, 0)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [1]
+
+    tokens = jnp.zeros((1, total), jnp.int32)
+    tokens = jax.lax.dynamic_update_slice(tokens, prompt.astype(jnp.int32), (0, 0))
+    tokens = jax.lax.dynamic_update_slice(tokens, first[:, None], (0, P))
+    target_last = P + max_new_tokens - 1  # index of the final required token
+
+    def macro(state):
+        tokens, cache_v, cache_d, t = state
+
+        # ---- draft K tokens after certified position t
+        def dstep(carry, i):
+            cache_d, tok = carry
+            cache_d, lg = forward_cached(
+                draft_params, tok, dcfg, cache_d, t + i)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)[:, None]  # [1,1]
+            return (cache_d, nxt), nxt[0, 0]
+
+        tok_t = jax.lax.dynamic_slice(tokens, (0, t), (1, 1))
+        (cache_d, _), drafts = jax.lax.scan(
+            dstep, (cache_d, tok_t), jnp.arange(K))  # drafts [K]
+
+        # ---- verify: one (K+1)-position target forward over
+        # [tokens[t], d_1..d_K] at offsets t..t+K
+        cand = jnp.concatenate([tok_t[0], drafts])[None, :]  # [1, K+1]
+        cache_v, all_lg = forward_cached(
+            params, cand, cfg, cache_v, t, all_logits=True)  # [1, K+1, V]
+        verify = jnp.argmax(all_lg[0], axis=-1).astype(jnp.int32)  # [K+1]
+        # verify[i] = target's token for position t+i+1
+        agree = (drafts == verify[:K]).astype(jnp.int32)
+        n = jnp.sum(jnp.cumprod(agree))  # accepted draft prefix length
+
+        # emit verify[0..n] at positions t+1..t+n+1: write ALL K+1 (the
+        # tail past t+n+1 is uncertified overshoot — overwritten later,
+        # never read: the final slice stops at the certified frontier)
+        tokens = jax.lax.dynamic_update_slice(tokens, verify[None, :], (0, t + 1))
+        return tokens, cache_v, cache_d, t + n + 1
+
+    def cond(state):
+        return state[3] < target_last
+
+    tokens, cache_v, cache_d, t = jax.lax.while_loop(
+        cond, macro, (tokens, cache_v, cache_d, P))
+    return tokens[:, : P + max_new_tokens]
