@@ -379,6 +379,7 @@ def test_int8_kv_cache_decode():
     assert found, "decode scan does not carry int8 KV leaves"
 
 
+@pytest.mark.heavy
 def test_int8_kv_cache_moe_and_tp():
     """kv_quant composes with the MoE cached path (tuple-safe per-layer
     slicing) and with TP decode."""
@@ -412,6 +413,7 @@ def test_int8_kv_cache_moe_and_tp():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(dwant))
 
 
+@pytest.mark.heavy
 def test_speculative_decode_lossless():
     """Speculative decode must be LOSSLESS: bit-equal to plain greedy
     generate for a perfect draft (self), a realistic draft (int8
