@@ -461,3 +461,75 @@ def test_speculative_decode_guards():
     with pytest.raises(ValueError, match="num_draft"):
         speculative_generate(params, params, jnp.zeros((1, 4), jnp.int32),
                              GPT_CFG, max_new_tokens=4, num_draft=0)
+
+
+@pytest.mark.heavy
+def test_beam_matches_hf_and_greedy():
+    """Fixed-length beam search: (a) sequence-equal to transformers'
+    beam search (early stopping disabled — the framework's generation
+    API is fixed-length) on HF-imported weights; (b) num_beams=1 equals
+    greedy decode exactly; (c) return_all yields num_beams sequences,
+    best-first by length-normalized score."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from torchdistpackage_tpu.models import beam_generate, from_hf_llama
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(21)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    prompt = np.random.RandomState(22).randint(0, 128, size=(1, 6))
+    mcfg, params = from_hf_llama(
+        hf.state_dict(), hf_config=hf.config, dtype=jnp.float32)
+
+    with torch.no_grad():
+        want = hf.generate(
+            torch.from_numpy(prompt), max_new_tokens=12, num_beams=4,
+            do_sample=False, early_stopping=False, min_new_tokens=12,
+            eos_token_id=None).numpy()
+    got = np.asarray(jax.jit(
+        lambda p, t: beam_generate(p, t, mcfg, max_new_tokens=12,
+                                   num_beams=4))(params, jnp.asarray(prompt)))
+    np.testing.assert_array_equal(got, want)
+
+    greedy = np.asarray(jax.jit(
+        lambda p, t: generate(p, t, mcfg, max_new_tokens=12))(
+        params, jnp.asarray(prompt)))
+    b1 = np.asarray(jax.jit(
+        lambda p, t: beam_generate(p, t, mcfg, max_new_tokens=12,
+                                   num_beams=1))(params, jnp.asarray(prompt)))
+    np.testing.assert_array_equal(b1, greedy)
+
+    allb = np.asarray(jax.jit(
+        lambda p, t: beam_generate(p, t, mcfg, max_new_tokens=12,
+                                   num_beams=4, return_all=True))(
+        params, jnp.asarray(prompt)))
+    assert allb.shape == (4, 6 + 12)
+    np.testing.assert_array_equal(allb[0], got[0])
+    # beams are distinct sequences
+    assert len({tuple(r) for r in allb}) == 4
+
+    with pytest.raises(ValueError, match="B == 1"):
+        beam_generate(params, jnp.zeros((2, 4), jnp.int32), mcfg,
+                      max_new_tokens=4)
+
+    # MoE family routes through forward_cached_moe — beam1 == greedy there
+    from torchdistpackage_tpu.models import init_gpt_moe_params
+
+    mo = MOE_CFGS["moe"]
+    mp = init_gpt_moe_params(jax.random.PRNGKey(0), mo)
+    pr = jax.random.randint(jax.random.PRNGKey(1), (1, PROMPT), 0, 64)
+    mb = np.asarray(jax.jit(lambda p, t: beam_generate(
+        p, t, mo, max_new_tokens=6, num_beams=1))(mp, pr))
+    # kv_quant composes (int8 (q8, scale) caches survive the beam gather)
+    kb = np.asarray(jax.jit(lambda p, t: beam_generate(
+        p, t, mcfg, max_new_tokens=12, num_beams=4, kv_quant=True))(
+        params, jnp.asarray(prompt)))
+    np.testing.assert_array_equal(kb, got)
+    mg = np.asarray(jax.jit(lambda p, t: generate(
+        p, t, mo, max_new_tokens=6))(mp, pr))
+    np.testing.assert_array_equal(mb, mg)

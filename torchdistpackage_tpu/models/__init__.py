@@ -23,6 +23,7 @@ from .convert import (
 from .generate import (
     forward_cached,
     forward_cached_moe,
+    beam_generate,
     generate,
     speculative_generate,
     init_kv_cache,

@@ -605,3 +605,95 @@ def speculative_generate(
     tokens, cache_v, cache_d, t = jax.lax.while_loop(
         cond, macro, (tokens, cache_v, cache_d, P))
     return tokens[:, : P + max_new_tokens]
+
+
+def beam_generate(
+    params: Dict[str, PyTree],
+    prompt: jnp.ndarray,
+    cfg: GPTConfig,
+    max_new_tokens: int,
+    num_beams: int = 4,
+    return_all: bool = False,
+    kv_quant: bool = False,
+) -> jnp.ndarray:
+    """Fixed-length beam search (deterministic, log-prob scored).
+
+    Standard beam semantics: at every step the ``num_beams * V``
+    continuations of the live beams are scored by accumulated
+    log-probability and the top ``num_beams`` survive (parent beams may
+    be cloned or dropped — the KV caches are re-gathered along the batch
+    dim accordingly, the textbook cost of beam search).  The
+    best-scoring beam is returned (``return_all`` gives every beam,
+    best first).  No ``length_penalty`` knob: every beam has the same
+    length here, so a length normalization cannot change the ranking.
+
+    The framework's generation API is fixed-length (no EOS machinery —
+    the reference has no inference path at all, and stopping criteria
+    are a serving-layer concern), so this is exhaustive-length beam
+    search: parity with ``transformers.generate(num_beams=N,
+    do_sample=False)`` holds when HF's early stopping is disabled
+    (tests/test_generate.py::test_beam_matches_hf_and_greedy).  B == 1,
+    serial.  ``kv_quant`` stores both caches int8 exactly as in
+    :func:`generate` (the beam reorder gathers the (q8, scale) pytree
+    unchanged).
+
+    The whole search is one jit: prefill once, replicate the cache
+    across beams, then ONE ``lax.scan`` of select-and-extend steps
+    (static shapes throughout; beam reordering is a batch-dim gather).
+    """
+    B, P = prompt.shape
+    if B != 1:
+        raise ValueError(f"beam search is B == 1 (got {B})")
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if cfg.attn_impl in ("ring", "ulysses"):
+        raise NotImplementedError(
+            "context-parallel decode is not supported (see generate)")
+    total = P + max_new_tokens
+    if cfg.pos == "learned" and total > cfg.max_seq:
+        raise ValueError(
+            f"P + max_new_tokens = {total} exceeds the learned position "
+            f"table ({cfg.max_seq})")
+    V = cfg.vocab_size
+    nb = int(num_beams)
+    fwd = forward_cached_moe if cfg.moe_experts else forward_cached
+
+    # prefill every beam with the same prompt (identical rows; the first
+    # expansion step de-duplicates by taking the top-nb of ONE row)
+    cache = init_kv_cache(cfg, nb, total, quantized=kv_quant)
+    tiled = jnp.broadcast_to(prompt.astype(jnp.int32), (nb, P))
+    cache, logits = fwd(params, tiled, cfg, cache, 0)  # [nb, V]
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    # beams start distinct: the nb best FIRST tokens of beam 0
+    first_lp, first_tok = jax.lax.top_k(lp[0], nb)  # [nb]
+    scores = first_lp
+    tokens = jnp.zeros((nb, total), jnp.int32)
+    tokens = jax.lax.dynamic_update_slice(tokens, tiled, (0, 0))
+    tokens = jax.lax.dynamic_update_slice(
+        tokens, first_tok.astype(jnp.int32)[:, None], (0, P))
+
+    def step(carry, i):
+        tokens, cache, scores = carry
+        pos = P + i
+        tok = jax.lax.dynamic_slice(tokens, (0, pos), (nb, 1))
+        cache, logits = fwd(params, tok, cfg, cache, pos)  # [nb, V]
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        cand = scores[:, None] + lp  # [nb, V]
+        top, flat_idx = jax.lax.top_k(cand.reshape(-1), nb)
+        parent = flat_idx // V
+        nxt = (flat_idx % V).astype(jnp.int32)
+        tokens = tokens[parent]
+        cache = jax.tree.map(lambda c: c[:, parent], cache)  # [L, nb, ...]
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, nxt[:, None], (0, pos + 1))
+        return (tokens, cache, top), None
+
+    if max_new_tokens > 1:
+        (tokens, cache, scores), _ = jax.lax.scan(
+            step, (tokens, cache, scores), jnp.arange(max_new_tokens - 1))
+
+    order = jnp.argsort(-scores)
+    out = tokens[order][:, :total]
+    return out if return_all else out[:1]
