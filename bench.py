@@ -19,7 +19,13 @@ slower config reports < 1.0 instead of silently re-basing.
 MFU: model FLOPs/token = 6·N_params + 12·L·S·D (PaLM-style accounting:
 6N for the dense matmuls fwd+bwd, 12·L·S·D for the attention score/value
 matmuls; remat recompute is hardware overhead and deliberately NOT counted —
-MFU is model FLOPs over peak). Peak bf16 FLOP/s looked up by device_kind.
+MFU is model FLOPs over peak). Peak bf16 FLOP/s looked up by device_kind
+(table shared with the obs subsystem: ``obs.peak_flops_for``).  The line
+ALSO carries the obs-derived cross-check from XLA ``cost_analysis`` of the
+compiled step (``mfu_xla``, ``flops_per_token_xla``,
+``mfu_xla_vs_formula_rel``): compiler-counted FLOPs include non-matmul ops
+and remat recompute, so xla >= formula and a small positive rel diff is
+expected; a LARGE one is printed to stderr, never hidden.
 
 A/B mode: ``python bench.py --ab`` runs the candidate
 (batch, remat, xent_chunk) configs ONE CHILD PROCESS EACH (fresh backend per
@@ -129,24 +135,13 @@ MOE_CANDIDATES = [
 # ce256 variants cost ~2% at 125M and stay retired from the sweep (the
 # streamed CE is a memory lever, not a throughput one).
 
-# Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
-_PEAK_BF16 = [
-    ("v6", 918e12),  # Trillium
-    ("v5p", 459e12),
-    ("v5e", 197e12),  # aka v5 lite
-    ("v5 lite", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
-]
-
-
 def _peak_flops(device_kind: str):
-    dk = device_kind.lower()
-    for sub, peak in _PEAK_BF16:
-        if sub in dk:
-            return peak
-    return None
+    # the lookup table lives in obs.telemetry (one source for the repo);
+    # only measurement children call this, so the import stays out of the
+    # jax-free parent process
+    from torchdistpackage_tpu.obs import peak_flops_for
+
+    return peak_flops_for(device_kind)
 
 
 def _only_index(argv):
@@ -261,7 +256,15 @@ def _last_good_accel_line(baselines: dict, reason: str = "unreachable"):
 
 def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None):
     """One timed measurement; returns (tokens_per_sec_chip, global_batch,
-    flops_per_token)."""
+    flops_per_token, xla_flops_per_token).
+
+    ``xla_flops_per_token`` comes from XLA ``cost_analysis`` of the
+    *compiled* step (obs.compiled_cost — compiler ground truth, per
+    device), vs the 6N+12LSD hand formula of ``flops_per_token``.  The two
+    bracket the truth from opposite sides: XLA counts EVERYTHING it runs
+    (non-matmul ops, optimizer, remat recompute), the hand formula counts
+    model matmul FLOPs only — so XLA >= formula, with the gap widening
+    under remat.  None when the backend reports no cost analysis."""
     import optax
 
     from torchdistpackage_tpu.models import gpt_loss, init_gpt_params
@@ -337,22 +340,42 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
     }
     batch = jax.device_put(batch, batch_sharded)
 
+    # AOT-compile so XLA's own cost analysis of the EXACT program being
+    # timed is captured (no second trace/compile: the compiled executable
+    # is what the loop runs).  Per-device FLOPs -> per-token via the
+    # per-chip token count.
+    from torchdistpackage_tpu.obs import compiled_cost
+
+    xla_flops_per_token = None
+    run_step = step
+    try:
+        compiled = step.lower(params, state, batch).compile()
+        cost = compiled_cost(compiled)
+        if cost.get("flops"):
+            xla_flops_per_token = cost["flops"] / (
+                global_batch * cfg.max_seq / n_chips)
+        run_step = compiled
+    except Exception as e:
+        print(f"bench: AOT compile/cost-analysis unavailable ({e!r}); "
+              f"falling back to the jit cache", file=sys.stderr)
+
     # NB: sync via host transfer (float(loss)), NOT block_until_ready — over
     # the axon TPU tunnel block_until_ready can return before execution
     # completes, which makes timings fictitious.  The steps form a data
     # dependency chain (params feed the next step), so fetching the final
     # loss bounds the whole run.
     for _ in range(warmup):
-        params, state, loss = step(params, state, batch)
+        params, state, loss = run_step(params, state, batch)
     float(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, state, loss = step(params, state, batch)
+        params, state, loss = run_step(params, state, batch)
     float(loss)
     dt = time.perf_counter() - t0
 
-    return global_batch * cfg.max_seq * steps / dt / n_chips, global_batch, flops_per_token
+    return (global_batch * cfg.max_seq * steps / dt / n_chips, global_batch,
+            flops_per_token, xla_flops_per_token)
 
 
 def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
@@ -439,7 +462,7 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
         run_cfg = (
             dataclasses.replace(cfg, moe_dispatch=dispatch) if dispatch else cfg
         )
-        tps, global_batch, fpt = _run_config(
+        tps, global_batch, fpt, fpt_xla = _run_config(
             jax, jnp, run_cfg, batch_size, steps, warmup, remat,
             xent_chunk=xent_chunk)
         # remat: False | True | 'flash' | 'flash_offload' (save the flash
@@ -469,6 +492,22 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
         if peak:
             line["peak_flops_est"] = peak
             line["mfu"] = round(tps * fpt / peak, 4)
+            if fpt_xla:
+                line["mfu_xla"] = round(tps * fpt_xla / peak, 4)
+        if fpt_xla:
+            # the peak cancels in the ratio, so the cross-check works on
+            # CPU too; |rel| > 15% is printed loudly, never hidden (remat
+            # recompute and non-matmul ops are IN the XLA count only)
+            line["flops_per_token_formula"] = round(fpt)
+            line["flops_per_token_xla"] = round(fpt_xla)
+            rel = (fpt_xla - fpt) / fpt
+            line["mfu_xla_vs_formula_rel"] = round(rel, 4)
+            if abs(rel) > 0.15:
+                print(
+                    f"bench: XLA cost-analysis FLOPs/token ({fpt_xla:.3e}) "
+                    f"vs 6N+12LSD formula ({fpt:.3e}) disagree by "
+                    f"{rel:+.1%} (remat={remat}) — see line field "
+                    f"mfu_xla_vs_formula_rel", file=sys.stderr)
         results.append(line)
         if ab or only is not None:
             print(json.dumps(line))

@@ -35,6 +35,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from torchdistpackage_tpu import setup_distributed, tpc
+from torchdistpackage_tpu.obs import Telemetry, pipeline_bubble_fraction
 from torchdistpackage_tpu.models import (
     GPTConfig,
     gpt_interleaved_param_specs,
@@ -86,6 +87,20 @@ def main():
         batch_spec={"tokens": P(None, "data"), "targets": P(None, "data")},
     )
 
+    tel = Telemetry(
+        run="train_interleaved_pipeline",
+        tokens_per_step=M * mbs * dp_size * cfg.max_seq,
+    )
+    # interleaved-1F1B bubble: (PV+P-2)/(VM+PV+P-2) — vs the classic
+    # schedule's value at V=1, the comparison this example exists to show
+    tel.record_counters(pipeline={
+        "pipe_size": pp,
+        "num_microbatches": M,
+        "num_chunks": vc,
+        "bubble_fraction": pipeline_bubble_fraction(M, pp, num_chunks=vc),
+        "bubble_fraction_classic": pipeline_bubble_fraction(M, pp),
+    })
+    step = tel.wrap_step(step)
     key = jax.random.PRNGKey(1)
     t0 = time.time()
     for i in range(8):
@@ -98,8 +113,10 @@ def main():
             {"tokens": tokens, "targets": targets},
         )
         sharded, state, loss = step(sharded, state, batch)
+        rec = tel.end_step(step=i, loss=loss)
         if i in (0, 3, 7):
-            print(f"iter {i}: loss={float(loss):.5f}")
+            print(f"iter {i}: loss={rec['loss']:.5f}")
+    tel.finalize()
     print(f"8 iters in {time.time()-t0:.2f}s — OK")
     return 0
 

@@ -12,7 +12,6 @@ param tree (tensor_parallel/layers.py).
 """
 
 import os
-import time
 
 if os.environ.get("TDP_CPU_SIM"):
     n = os.environ["TDP_CPU_SIM"]
@@ -36,6 +35,7 @@ from torchdistpackage_tpu.models import (
     init_gpt_params,
     llama_config,
 )
+from torchdistpackage_tpu.obs import Telemetry
 from torchdistpackage_tpu.parallel.data_parallel import DataParallel
 
 
@@ -77,6 +77,10 @@ def main():
 
     B = 4 * max(1, ndev // tp)
     mesh = tpc.get_view()
+    # obs session: per-step spans + recompile watch + RUNREPORT.json (when
+    # TDP_RUNREPORT is set, as under the CI example runner)
+    tel = Telemetry(run="train_llama", tokens_per_step=B * cfg.max_seq)
+    step = tel.wrap_step(step)
     for it in range(5):
         k1, k2 = jax.random.split(jax.random.PRNGKey(100 + it))
         batch = {
@@ -86,11 +90,11 @@ def main():
         batch = jax.tree.map(
             lambda a: jax.device_put(a, NamedSharding(mesh, P("data"))), batch
         )
-        t0 = time.perf_counter()
         params, opt_state, loss = step(params, opt_state, batch)
-        loss = float(loss)
-        print(f"iter {it}: loss {loss:.4f}  ({time.perf_counter() - t0:.2f}s)")
-    assert jnp.isfinite(loss)
+        rec = tel.end_step(step=it, loss=loss)
+        print(f"iter {it}: loss {rec['loss']:.4f}  ({rec['step_time_s']:.2f}s)")
+    assert jnp.isfinite(rec["loss"])
+    tel.finalize()
     print("ok")
 
 

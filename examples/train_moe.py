@@ -36,6 +36,8 @@ from torchdistpackage_tpu.models import (
     gpt_moe_param_specs,
     init_gpt_moe_params,
 )
+from torchdistpackage_tpu.models.gpt_moe import gpt_moe_forward
+from torchdistpackage_tpu.obs import Telemetry, moe_load_stats
 from torchdistpackage_tpu.parallel import DataParallel
 from torchdistpackage_tpu.parallel.moe import moe_grad_reduce_overrides
 
@@ -89,6 +91,8 @@ def main():
         },
     )
 
+    tel = Telemetry(run="train_moe", tokens_per_step=B * cfg.max_seq)
+    step = tel.wrap_step(step)
     bsh = NamedSharding(mesh, P(("moe_dp", "moe_ep")))
     losses = []
     for i in range(steps):
@@ -99,11 +103,31 @@ def main():
         targets = jnp.concatenate([tokens[:, :1], tokens[:, :-1]], axis=1)
         batch = jax.device_put({"tokens": tokens, "targets": targets}, bsh)
         sharded, state, loss = step(sharded, state, batch)
-        losses.append(float(loss))
+        rec = tel.end_step(step=i, loss=loss)
+        losses.append(rec["loss"])
         print(f"step {i}: loss={losses[-1]:.4f}  (experts={cfg.moe_experts}, ep={ep})")
 
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], "training must reduce the loss"
+
+    # observability pass on the trained router: serial forward (global
+    # arrays, ep_axis=None) collecting per-expert token counts -> the
+    # expert-load imbalance counter in RUNREPORT.json
+    _, _, router = jax.jit(
+        lambda p, t: gpt_moe_forward(p, t, cfg, collect_metrics=True)
+    )(sharded, tokens)
+    stats = moe_load_stats(
+        np.asarray(router["expert_tokens"]),
+        dropped_rate=float(router["dropped_token_rate"]),
+    )
+    stats["router_entropy"] = float(router["router_entropy"])
+    tel.record_counters(moe=stats)
+    tel.finalize()
+    print(
+        f"expert load: imbalance={stats['imbalance']:.3f} "
+        f"entropy={stats['load_entropy']:.3f} "
+        f"dropped={stats['dropped_token_rate']:.3f}"
+    )
     # each device holds only num_experts/ep experts' weights
     w1 = sharded["blocks"][1]["moe"]["experts"]["w1"]
     local_experts = w1.addressable_shards[0].data.shape[0]

@@ -26,6 +26,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from torchdistpackage_tpu import setup_distributed, tpc
+from torchdistpackage_tpu.obs import Telemetry, pipeline_bubble_fraction
 from torchdistpackage_tpu.parallel import ZeroOptimizer, clip_by_global_norm_parallel
 from torchdistpackage_tpu.parallel.pipeline_parallel import (
     pipeline_loss,
@@ -86,6 +87,16 @@ def main():
         loss_fn, batch_spec={"x": P(None, "data"), "y": P(None, "data")}
     )
 
+    tel = Telemetry(run="train_pipeline", tokens_per_step=M * mbs * dp * S)
+    # the schedule's own bubble accounting (forward scan: (P-1)/(M+P-1))
+    # lands in the report's counters — the number a deeper pipeline's M is
+    # tuned against
+    tel.record_counters(pipeline={
+        "pipe_size": pp,
+        "num_microbatches": M,
+        "bubble_fraction": pipeline_bubble_fraction(M, pp, schedule="forward"),
+    })
+    step = tel.wrap_step(step)
     key = jax.random.PRNGKey(1)
     t0 = time.time()
     for i in range(10):
@@ -98,8 +109,10 @@ def main():
             lambda a: jax.device_put(a, NamedSharding(mesh, P(None, "data"))), batch
         )
         params, state, loss = step(params, state, batch)
+        rec = tel.end_step(step=i, loss=loss)
         if i in (0, 4, 9):
-            print(f"iter {i}: loss={float(loss):.5f}")
+            print(f"iter {i}: loss={rec['loss']:.5f}")
+    tel.finalize()
     print(f"10 iters in {time.time()-t0:.2f}s — OK")
     return 0
 

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import optax
 
 from torchdistpackage_tpu import setup_distributed, tpc
+from torchdistpackage_tpu.obs import Telemetry
 from torchdistpackage_tpu.parallel.data_parallel import DataParallel
 from torchdistpackage_tpu.parallel.tensor_parallel import (
     TransformerConfig,
@@ -73,12 +74,16 @@ def main():
     from torchdistpackage_tpu.utils import prefetch_to_sharding
 
     t0 = time.time()
+    tel = Telemetry(run="train_tp_dp", tokens_per_step=B * S)
+    step = tel.wrap_step(step)
     # double-buffered host->HBM transfers overlap the previous step's compute
     batches = prefetch_to_sharding(host_batches(10), dp.mesh, P("data"))
     for i, batch in enumerate(batches):
         params, opt_state, loss = step(params, opt_state, batch)
+        rec = tel.end_step(step=i, loss=loss)
         if i in (0, 4, 9):
-            print(f"iter {i}: loss={float(loss):.5f}")
+            print(f"iter {i}: loss={rec['loss']:.5f}")
+    tel.finalize()
     print(f"10 iters in {time.time()-t0:.2f}s — OK")
     return 0
 

@@ -82,3 +82,20 @@ batch = global_batch_from_local(
 for _ in range(2):
     sharded, state, loss = step(sharded, state, batch)
 print(f"rank {rank}: LOSS={float(loss):.8f}", flush=True)
+
+# --- obs cross-host aggregation: each process contributes rank-distinct
+# step times; the allgathered view must see both hosts and flag rank 1
+# (5x rank 0, ratio 5/3 over the median of the two) as the straggler on
+# EVERY process
+from torchdistpackage_tpu.obs import cross_host_step_stats
+
+stats = cross_host_step_stats([0.010 * (1 + 4 * rank)] * 4)
+assert stats["n_hosts"] == 2, stats
+means = [round(h["mean"], 4) for h in stats["per_host"]]
+assert means == [0.01, 0.05], stats
+assert stats["straggler"] == 1, stats
+print(
+    f"rank {rank}: OBS_AGG n_hosts={stats['n_hosts']} "
+    f"straggler={stats['straggler']} ratio={stats['straggler_ratio']:.2f}",
+    flush=True,
+)

@@ -2,8 +2,13 @@
 CPU sim in a subprocess (examples configure their own platform via
 TDP_CPU_SIM, so they must NOT inherit this test process's JAX).  The analogue
 of the reference treating its examples/ as the de-facto test suite
-(SURVEY.md §4) — but actually wired into CI."""
+(SURVEY.md §4) — but actually wired into CI.
 
+obs-integrated examples additionally get TDP_RUNREPORT pointed at a temp
+file and must leave a schema-valid ``RUNREPORT.json`` behind — the driver
+artifacts are self-reporting, not just exit-code-0."""
+
+import json
 import os
 import pathlib
 import subprocess
@@ -16,15 +21,32 @@ pytestmark = pytest.mark.slow
 REPO = pathlib.Path(__file__).resolve().parent.parent
 EXAMPLES = sorted(p.name for p in (REPO / "examples").glob("train_*.py"))
 
+# Examples wired through obs.Telemetry: each must produce a valid
+# RUNREPORT.json under the CI runner.  Per-example extra assertions probe
+# the counters the example exists to report.
+OBS_EXAMPLES = {
+    "train_llama.py": {},
+    "train_tp_dp.py": {},
+    "train_pipeline.py": {"counter": "pipeline", "field": "bubble_fraction"},
+    "train_interleaved_pipeline.py": {
+        "counter": "pipeline", "field": "bubble_fraction"},
+    "train_moe.py": {"counter": "moe", "field": "imbalance"},
+}
+
 
 @pytest.mark.parametrize("script", EXAMPLES)
-def test_example_runs_on_cpu_sim(script):
+def test_example_runs_on_cpu_sim(script, tmp_path):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env.pop("JAX_PLATFORMS", None)
+    env.pop("TDP_RUNREPORT", None)
     env["TDP_CPU_SIM"] = "8"
     env["TDP_SMOKE"] = "1"  # examples that support it shrink their step count
     env["PYTHONPATH"] = f"{REPO}{os.pathsep}" + env.get("PYTHONPATH", "")
+    report_path = None
+    if script in OBS_EXAMPLES:
+        report_path = tmp_path / "RUNREPORT.json"
+        env["TDP_RUNREPORT"] = str(report_path)
     res = subprocess.run(
         [sys.executable, str(REPO / "examples" / script)],
         env=env,
@@ -36,6 +58,33 @@ def test_example_runs_on_cpu_sim(script):
         f"{script} failed (rc={res.returncode})\n"
         f"--- stdout ---\n{res.stdout[-2000:]}\n--- stderr ---\n{res.stderr[-2000:]}"
     )
+    if report_path is None:
+        return
+
+    # the run must leave a schema-valid, self-consistent report behind
+    from torchdistpackage_tpu.obs import validate_runreport
+
+    assert report_path.exists(), (
+        f"{script} exited 0 but wrote no RUNREPORT.json\n{res.stdout[-1000:]}")
+    report = json.loads(report_path.read_text())
+    errs = validate_runreport(report)
+    assert errs == [], f"{script} RUNREPORT invalid: {errs}"
+    assert report["steps"] > 0
+    assert report["step_time_s"]["n"] > 0
+    assert report["compile"]["count"] >= 1
+    # markdown sibling rides along
+    assert report_path.with_suffix(".md").exists()
+
+    probe = OBS_EXAMPLES[script]
+    if probe:
+        counters = report["counters"]
+        assert probe["counter"] in counters, (script, counters)
+        val = counters[probe["counter"]][probe["field"]]
+        assert isinstance(val, (int, float)) and val >= 0.0, (script, val)
+        if probe["field"] == "bubble_fraction":
+            assert val < 1.0
+        if probe["counter"] == "moe":
+            assert sum(counters["moe"]["expert_tokens"]) > 0
 
 
 def test_examples_discovered():

@@ -73,6 +73,12 @@ def test_two_process_mesh_comm_and_dp_parity(devices8):
     for r, out in enumerate(outs):
         assert f"rank {r}: test_comm ok" in out, out
 
+    # obs cross-host aggregation ran its allgather across the two
+    # processes and flagged the slow rank on BOTH (tests/_mp_worker.py
+    # asserts the per-host means; this asserts the verdict surfaced)
+    for r, out in enumerate(outs):
+        assert f"rank {r}: OBS_AGG n_hosts=2 straggler=1" in out, out
+
     # cross-rank loss parity (same global step seen by both processes)
     losses = []
     for r, out in enumerate(outs):

@@ -1,0 +1,345 @@
+"""Tests for the obs telemetry subsystem: spans, recompile detection,
+XLA cost capture, RUNREPORT schema, sinks, aggregation counters, and the
+MoE router metrics (skewed router must report imbalance)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchdistpackage_tpu.obs import (
+    EventLog,
+    JsonlSink,
+    MultiSink,
+    PrometheusTextfileSink,
+    Telemetry,
+    cross_host_step_stats,
+    moe_load_stats,
+    percentiles,
+    pipeline_bubble_fraction,
+    step_time_stats,
+    validate_runreport,
+)
+from torchdistpackage_tpu.obs.events import (
+    default_event_log,
+    emit_event,
+    set_default_event_log,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_default_log():
+    # Telemetry installs itself as the process default; isolate tests
+    set_default_event_log(None)
+    yield
+    set_default_event_log(None)
+
+
+# ---------------------------------------------------------------- events
+
+
+def test_event_log_structure_and_jsonl(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path=path)
+    log.emit("compile", flops=123.0)
+    log.emit("preemption", signum=15)
+    assert [e["kind"] for e in log.as_list()] == ["compile", "preemption"]
+    # monotonic timestamps and process stamping
+    evs = log.as_list()
+    assert evs[0]["t_mono"] <= evs[1]["t_mono"]
+    assert all(e["process"] == 0 for e in evs)
+    with open(path) as f:
+        lines = [json.loads(l) for l in f]
+    assert [l["kind"] for l in lines] == ["compile", "preemption"]
+    assert log.of_kind("preemption")[0]["signum"] == 15
+
+
+def test_default_event_log_plumbing():
+    log = default_event_log()
+    emit_event("nan_watchdog", fn="loss")
+    assert log.of_kind("nan_watchdog")[0]["fn"] == "loss"
+    # GracefulShutdown's handler emits here without any wiring
+    import signal as _signal
+
+    from torchdistpackage_tpu.utils import GracefulShutdown
+
+    with GracefulShutdown() as stop:
+        _signal.raise_signal(_signal.SIGTERM)
+        assert stop.requested
+    trips = log.of_kind("preemption")
+    assert trips and trips[0]["signal"] == "SIGTERM"
+
+
+# -------------------------------------------------------------- telemetry
+
+
+def test_telemetry_spans_recompile_and_report(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    report_path = str(tmp_path / "RUNREPORT.json")
+    tel = Telemetry(
+        run="t", sinks=[JsonlSink(path)], tokens_per_step=8,
+        report_path=report_path,
+    )
+    f = jax.jit(lambda x: x * 2.0)
+    wrapped = tel.wrap_step(f)
+    for i in range(4):
+        out = wrapped(jnp.ones((4,)))
+        rec = tel.end_step(step=i, loss=out.sum())
+    assert rec["loss"] == 8.0
+    for span in ("data", "dispatch", "device", "fetch"):
+        assert rec[f"span_{span}_s"] >= 0.0
+    assert rec["step_time_s"] > 0 and rec["tok_per_sec"] > 0
+    assert tel.n_compiles == 1
+    # XLA ground truth captured from the compiled step
+    assert tel.xla_cost.get("flops", 0) > 0
+
+    # a NEW input shape is a recompile: event + record mark
+    out = wrapped(jnp.ones((8,)))
+    rec = tel.end_step(step=4, loss=out.sum())
+    assert rec.get("recompiled") is True
+    assert tel.n_compiles == 2
+    assert len(tel.events.of_kind("recompile")) == 1
+
+    report = tel.finalize(print_summary=False)
+    assert validate_runreport(report) == []
+    assert report["steps"] == 5
+    assert report["compile"]["recompiles"] == 1
+    # written artifacts: json + markdown sibling
+    assert os.path.exists(report_path)
+    assert os.path.exists(str(tmp_path / "RUNREPORT.md"))
+    on_disk = json.load(open(report_path))
+    assert validate_runreport(on_disk) == []
+    # JSONL sink saw every step record plus the summary
+    with open(path) as fh:
+        lines = [json.loads(l) for l in fh]
+    assert sum(1 for l in lines if l["type"] == "step") == 5
+    assert sum(1 for l in lines if l["type"] == "summary") == 1
+
+
+def test_telemetry_mfu_cross_check(tmp_path):
+    # known FLOPs: [64, 32] @ [32, 16] matmul = 2*64*32*16; give the hand
+    # formula the same number so xla_vs_formula_rel is ~0
+    w = jax.random.normal(jax.random.PRNGKey(0), (32, 16))
+    f = jax.jit(lambda x: x @ w)
+    flops = 2 * 64 * 32 * 16
+    tel = Telemetry(
+        run="mfu", tokens_per_step=64, flops_per_token=flops / 64,
+        peak_flops=1e12, report_path=None,
+    )
+    wrapped = tel.wrap_step(f)
+    for i in range(3):
+        out = wrapped(jnp.ones((64, 32)))
+        tel.end_step(step=i)
+    report = tel.finalize(print_summary=False)
+    mfu = report["mfu"]
+    assert mfu["xla_flops_per_step"] > 0
+    assert mfu["formula_flops_per_step"] == flops
+    assert mfu["xla"] >= 0 and mfu["formula"] >= 0
+    # the compiled matmul's XLA count equals the textbook count
+    assert abs(mfu["xla_vs_formula_rel"]) < 0.15
+
+
+def test_telemetry_wrap_plain_function_and_fallback():
+    # non-jitted callables get jitted; telemetry must not change results
+    tel = Telemetry(run="p", report_path=None)
+    wrapped = tel.wrap_step(lambda x: x + 1)
+    out = wrapped(jnp.zeros((3,)))
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+    tel.end_step(step=0)
+    assert tel.history[0]["step"] == 0
+
+
+# ------------------------------------------------------------- aggregation
+
+
+def test_step_time_stats_and_percentiles():
+    assert percentiles([]) == {}
+    times = [0.01 * (i + 1) for i in range(100)]
+    st = step_time_stats(times)
+    assert st["n"] == 100
+    assert st["min"] == pytest.approx(0.01)
+    assert st["max"] == pytest.approx(1.0)
+    assert st["p50"] == pytest.approx(np.percentile(times, 50))
+    assert st["p99"] >= st["p95"] >= st["p50"]
+    assert step_time_stats([]) == {"n": 0}
+
+
+def test_cross_host_single_process_path():
+    st = cross_host_step_stats([0.1, 0.2, 0.3])
+    assert st["n_hosts"] == 1
+    assert st["straggler"] is None
+    assert st["per_host"][0]["mean"] == pytest.approx(0.2)
+    # single host never emits a straggler event
+    assert default_event_log().of_kind("straggler") == []
+
+
+def test_pipeline_bubble_fraction_formulas():
+    # forward scan: (P-1)/(M+P-1)
+    assert pipeline_bubble_fraction(4, 2, schedule="forward") == pytest.approx(0.2)
+    # classic 1F1B: 2(P-1)/(M+2P-2)
+    assert pipeline_bubble_fraction(4, 2) == pytest.approx(2 / 6)
+    # interleaved: (PV+P-2)/(VM+PV+P-2); at P=2,V=2,M=4: 4/12
+    assert pipeline_bubble_fraction(4, 2, num_chunks=2) == pytest.approx(4 / 12)
+    # more microbatches shrink the bubble; deeper pipes grow it
+    assert pipeline_bubble_fraction(64, 4) < pipeline_bubble_fraction(8, 4)
+    assert pipeline_bubble_fraction(8, 8) > pipeline_bubble_fraction(8, 4)
+    # P=1 is bubble-free in every schedule
+    assert pipeline_bubble_fraction(4, 1) == 0.0
+    with pytest.raises(ValueError):
+        pipeline_bubble_fraction(4, 2, schedule="nope")
+
+
+def test_moe_load_stats_shapes():
+    balanced = moe_load_stats([10, 10, 10, 10])
+    assert balanced["imbalance"] == pytest.approx(0.0)
+    assert balanced["load_entropy"] == pytest.approx(1.0)
+    skewed = moe_load_stats([40, 0, 0, 0], dropped_rate=0.25)
+    assert skewed["imbalance"] == pytest.approx(3.0)
+    assert skewed["load_entropy"] == pytest.approx(0.0)
+    assert skewed["dropped_token_rate"] == 0.25
+    assert moe_load_stats([])["num_experts"] == 0
+
+
+# ---------------------------------------------------- moe router counters
+
+
+def test_skewed_router_reports_imbalance():
+    """A deliberately skewed router must show up in the counters: hot
+    experts, dropped tokens, low routing entropy — while a fresh random
+    router stays comparatively balanced.  (Satellite acceptance: imbalance
+    > 0 under skew.)"""
+    from torchdistpackage_tpu.parallel.moe import (
+        MoEConfig,
+        init_moe_params,
+        moe_forward,
+    )
+
+    cfg = MoEConfig(dim=8, ffn_dim=16, num_experts=4, top_k=1,
+                    capacity_factor=1.0)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    w = np.zeros((8, 4), np.float32)
+    w[:, 0] = 5.0  # every token strongly prefers expert 0
+    params["router"]["w"] = jnp.asarray(w)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 8))
+
+    y, aux, m = moe_forward(params, x, cfg, return_metrics=True)
+    assert y.shape == x.shape
+    stats = moe_load_stats(
+        np.asarray(m["expert_tokens"]),
+        dropped_rate=float(m["dropped_token_rate"]),
+    )
+    assert stats["imbalance"] > 0.5
+    assert stats["dropped_token_rate"] > 0.0
+    assert float(m["router_entropy"]) < 0.9
+
+    # metrics are observational: outputs and grads identical without them
+    y2, _ = moe_forward(params, x, cfg)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y2))
+    g1 = jax.grad(lambda p: moe_forward(p, x, cfg)[0].sum())(params)
+    g2 = jax.grad(
+        lambda p: moe_forward(p, x, cfg, return_metrics=True)[0].sum()
+    )(params)
+    np.testing.assert_allclose(
+        np.asarray(g1["router"]["w"]), np.asarray(g2["router"]["w"]))
+
+    # expert-choice router: full experts by construction, coverage-based
+    # drop metric
+    cfg_ec = MoEConfig(dim=8, ffn_dim=16, num_experts=4, top_k=1,
+                       capacity_factor=1.0, router="expert_choice")
+    p_ec = init_moe_params(jax.random.PRNGKey(2), cfg_ec)
+    _, _, m_ec = moe_forward(p_ec, x, cfg_ec, return_metrics=True)
+    tok = np.asarray(m_ec["expert_tokens"])
+    assert (tok == tok[0]).all()  # perfectly balanced by construction
+
+
+def test_gpt_moe_collect_metrics():
+    """The model-level metrics pass aggregates over the expert blocks and
+    leaves the logits unchanged."""
+    from torchdistpackage_tpu.models import GPTConfig, init_gpt_moe_params
+    from torchdistpackage_tpu.models.gpt_moe import gpt_moe_forward
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, nheads=4, nlayers=4, max_seq=16, ffn_mult=2,
+        moe_experts=4, moe_top_k=2, moe_every=2, dtype=jnp.float32,
+    )
+    params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    logits, aux, m = gpt_moe_forward(params, tokens, cfg, collect_metrics=True)
+    logits2, aux2 = gpt_moe_forward(params, tokens, cfg)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(logits2), rtol=1e-6)
+    assert m["expert_tokens"].shape == (4,)
+    # 2 expert blocks x (2*16 tokens) x top_k=2 choices, minus drops
+    assert 0 < float(np.sum(np.asarray(m["expert_tokens"]))) <= 2 * 2 * 16 * 2
+    assert 0.0 <= float(m["dropped_token_rate"]) <= 1.0
+
+
+# ----------------------------------------------------------------- sinks
+
+
+def test_prometheus_textfile_sink(tmp_path):
+    path = str(tmp_path / "tdp.prom")
+    sink = PrometheusTextfileSink(path, run="r1")
+    sink.write({"step": 3, "loss": 1.5, "note": "skip-me"})
+    body = open(path).read()
+    assert '# TYPE tdp_loss gauge' in body
+    assert 'tdp_loss{run="r1",process="0"} 1.5' in body
+    # atomic rewrite keeps the latest value only
+    sink.write({"step": 4, "loss": 1.25})
+    body = open(path).read()
+    assert body.count("tdp_loss{") == 1 and "1.25" in body
+    sink.write_summary({"throughput": {"tokens_per_sec": 10.0}})
+    assert "summary_throughput_tokens_per_sec" in open(path).read()
+
+
+def test_multisink_isolates_failures(tmp_path):
+    class Boom:
+        def write(self, rec):
+            raise RuntimeError("down")
+
+        def write_summary(self, rep):
+            raise RuntimeError("down")
+
+    path = str(tmp_path / "ok.jsonl")
+    ms = MultiSink([Boom(), JsonlSink(path)])
+    ms.write({"step": 0, "v": 1.0})
+    ms.write_summary({"x": 1})
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) == 2
+
+
+def test_metrics_logger_is_an_obs_shim(tmp_path):
+    """MetricsLogger keeps its public API but writes JSONL through the obs
+    sink (one code path package-wide)."""
+    from torchdistpackage_tpu.utils import MetricsLogger
+
+    path = str(tmp_path / "m.jsonl")
+    ml = MetricsLogger(path=path, tokens_per_step=10, print_every=0)
+    assert isinstance(ml._sink, JsonlSink)
+    for i in range(3):
+        ml.log(i, loss=float(i))
+    with open(path) as f:
+        lines = [json.loads(l) for l in f]
+    assert [l["step"] for l in lines] == [0, 1, 2]
+    assert [r["step"] for r in ml.history] == [0, 1, 2]
+
+
+# --------------------------------------------------------- schema guards
+
+
+def test_validate_runreport_rejects_malformed():
+    assert validate_runreport(None)
+    assert validate_runreport([]) != []
+    errs = validate_runreport({"schema": "tdp-runreport/v1"})
+    assert any("missing key" in e for e in errs)
+    # wrong schema string caught once structure is right
+    tel = Telemetry(run="v", report_path=None)
+    rep = tel.finalize(print_summary=False)
+    assert validate_runreport(rep) == []
+    bad = dict(rep, schema="tdp-runreport/v999")
+    assert any("schema" in e for e in validate_runreport(bad))
+    bad2 = dict(rep, events=[{"nope": 1}])
+    assert any("events[0]" in e for e in validate_runreport(bad2))

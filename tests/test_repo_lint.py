@@ -1,0 +1,56 @@
+"""Repo lint: no bare ``print(`` in the package.
+
+Observability goes through ``utils.logging.master_print`` (rank-gated) or
+an obs sink — a bare print on a 256-host pod is 256 interleaved copies of
+the same line, and structured consumers can't parse stdout noise.  The
+check is AST-based (docstrings and comments that MENTION print don't trip
+it) with an explicit allowlist for the few intentional sites.
+"""
+
+import ast
+import pathlib
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "torchdistpackage_tpu"
+
+# Intentional bare-print sites (repo-relative to the package dir):
+ALLOWLIST = {
+    # login-node babysitter: deliberately jax-free (lazy-subpackage design,
+    # torchdistpackage_tpu/__init__.py), so master_print (which needs
+    # jax.process_index) is unavailable; it is single-process by nature.
+    "tools/slurm_job_monitor.py",
+}
+
+
+def _bare_prints(path: pathlib.Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    hits = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            hits.append(node.lineno)
+    return hits
+
+
+def test_no_bare_print_in_package():
+    offenders = {}
+    for path in sorted(PKG.rglob("*.py")):
+        rel = str(path.relative_to(PKG))
+        if rel in ALLOWLIST:
+            continue
+        lines = _bare_prints(path)
+        if lines:
+            offenders[rel] = lines
+    assert not offenders, (
+        "bare print( calls in torchdistpackage_tpu/ — use "
+        "utils.logging.master_print or an obs sink, or add the file to "
+        f"ALLOWLIST with a reason: {offenders}"
+    )
+
+
+def test_allowlist_entries_exist():
+    # a stale allowlist silently widens the lint's blind spot
+    for rel in ALLOWLIST:
+        assert (PKG / rel).exists(), f"allowlisted file gone: {rel}"

@@ -129,8 +129,10 @@ def test_collection(
         for nbytes in sizes:
             row = bench_collective(op, axis, nbytes=nbytes, mesh=mesh)
             rows.append(row)
-            if verbose and jax.process_index() == 0:
-                print(
+            if verbose:
+                from ..utils.logging import master_print
+
+                master_print(
                     f"{op:>14} axis={axis}({row['axis_size']}) "
                     f"{row['size_bytes']/2**20:8.1f} MiB  "
                     f"{row['time_s']*1e3:8.3f} ms  "

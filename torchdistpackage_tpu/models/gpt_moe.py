@@ -101,13 +101,16 @@ def moe_block_forward(
     ep_axis: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
     rope: "tuple | None" = None,
+    return_metrics: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Pre-LN block whose FFN is the MoE layer.  Attention half is identical
     to ``block_forward``; the MoE half runs on the gathered (full-seq) tokens
     — expert params are replicated over ``tensor`` and EP-sharded over
     ``ep_axis``, so every TP rank computes the identical expert output
     (sliced back to the SP layout with a split, NOT a psum: there are no
-    partial sums to reduce).  Returns (y, aux_loss)."""
+    partial sums to reduce).  Returns (y, aux_loss), plus the router's
+    observability counters (``parallel.moe._router_metrics``) as a third
+    element under ``return_metrics=True``."""
     bcfg = cfg.block
     mcfg = moe_layer_config(cfg)
     k_attn = k_mlp = None
@@ -126,11 +129,16 @@ def moe_block_forward(
     # cfg.block.causal=True) reject the non-causal expert_choice router at
     # trace time and get token-major capacity priority; encoder configs
     # (ViT-MoE, causal=False) may use EC — the Zhou et al. setting
-    z, aux = moe_forward(
-        p["moe"], full, mcfg, ep_axis=ep_axis, causal=cfg.block.causal)
+    out = moe_forward(
+        p["moe"], full, mcfg, ep_axis=ep_axis, causal=cfg.block.causal,
+        return_metrics=return_metrics)
+    z, aux = out[0], out[1]
     if axis and sp:
         z = split_to_sp(z, axis)
-    return x + dropout(z, bcfg.dropout_rate, k_mlp), aux
+    y_out = x + dropout(z, bcfg.dropout_rate, k_mlp)
+    if return_metrics:
+        return y_out, aux, out[2]
+    return y_out, aux
 
 
 def gpt_moe_forward(
@@ -142,6 +150,7 @@ def gpt_moe_forward(
     ep_axis: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
     remat: RematMode = False,
+    collect_metrics: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """tokens [B, S] -> (logits [B, S, V_local], mean aux loss over MoE
     blocks).  ``params['blocks']`` is the heterogeneous per-block list from
@@ -149,15 +158,22 @@ def gpt_moe_forward(
     (False | True | 'flash' | 'flash_offload' — scan_blocks docstring);
     before this the non-pipeline MoE path had NO activation checkpointing,
     so big-MoE-on-few-chips configs couldn't trade recompute for HBM the
-    way the dense family (gpt_loss) and the MoE pipeline already could."""
+    way the dense family (gpt_loss) and the MoE pipeline already could.
+
+    ``collect_metrics=True`` appends the aggregated router counters (see
+    :func:`moe_block_stack`) — the observability pass behind the MoE
+    examples' expert-load-imbalance reporting."""
     h = gpt_embed(params, tokens, axis, context_axis=cfg.context_axis, cp_layout=cfg.cp_layout)
     if axis is not None and sp:
         h = split_to_sp(h, axis)
-    h, aux_mean = moe_block_stack(
+    out = moe_block_stack(
         params["blocks"], h, cfg, axis=axis, sp=sp, ep_axis=ep_axis,
-        dropout_key=dropout_key, remat=remat,
+        dropout_key=dropout_key, remat=remat, collect_metrics=collect_metrics,
     )
-    return gpt_head(params, h, axis, sp, eps=cfg.norm_eps), aux_mean
+    logits = gpt_head(params, out[0], axis, sp, eps=cfg.norm_eps)
+    if collect_metrics:
+        return logits, out[1], out[2]
+    return logits, out[1]
 
 
 def _moe_bodies(cfg, axis, sp, ep_axis, remat):
@@ -192,16 +208,24 @@ def moe_block_stack(
     ep_axis: Optional[str] = None,
     dropout_key: Optional[jax.Array] = None,
     remat: RematMode = False,
+    collect_metrics: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The heterogeneous dense/expert block loop shared by the MoE model
     families (GPT-MoE, ViT-MoE): per-block dropout-key folding,
     :func:`is_moe_block` dispatch, and the mean-over-MoE-blocks aux
     normalization live HERE once.  ``cfg`` is duck-typed (needs ``.block``,
-    ``.nlayers`` and the ``moe_*`` fields)."""
+    ``.nlayers`` and the ``moe_*`` fields).
+
+    ``collect_metrics=True`` (an observability/eval pass — runs the MoE
+    blocks un-checkpointed) appends a third return: the router counters
+    aggregated over the expert blocks — ``expert_tokens`` [E] summed,
+    ``router_entropy`` / ``dropped_token_rate`` averaged — ready for
+    ``obs.aggregate.moe_load_stats``."""
     moe_body, dense_body = _moe_bodies(cfg, axis, sp, ep_axis, remat)
     rope = block_rope_cache(cfg.block, h.shape[1], axis, sp)
     aux_total = jnp.zeros((), jnp.float32)
     n_moe = 0
+    metrics_sum: Optional[Dict[str, jnp.ndarray]] = None
     for i, bp in enumerate(blocks):
         k = (
             jax.random.fold_in(dropout_key, i)
@@ -209,12 +233,32 @@ def moe_block_stack(
             else None
         )
         if is_moe_block(cfg, i):
-            h, aux = moe_body(bp, h, k, rope)
+            if collect_metrics:
+                h, aux, m = moe_block_forward(
+                    bp, h, cfg, axis=axis, sp=sp, ep_axis=ep_axis,
+                    dropout_key=k, rope=rope, return_metrics=True,
+                )
+                metrics_sum = (
+                    m if metrics_sum is None
+                    else {kk: metrics_sum[kk] + m[kk] for kk in m}
+                )
+            else:
+                h, aux = moe_body(bp, h, k, rope)
             aux_total = aux_total + aux
             n_moe += 1
         else:
             h = dense_body(bp, h, k, rope)
-    return h, aux_total / max(n_moe, 1)
+    aux_mean = aux_total / max(n_moe, 1)
+    if not collect_metrics:
+        return h, aux_mean
+    if metrics_sum is not None and n_moe > 0:
+        # counts sum over blocks; rates/entropies average
+        metrics_sum = {
+            "expert_tokens": metrics_sum["expert_tokens"],
+            "router_entropy": metrics_sum["router_entropy"] / n_moe,
+            "dropped_token_rate": metrics_sum["dropped_token_rate"] / n_moe,
+        }
+    return h, aux_mean, metrics_sum
 
 
 def moe_blocks_param_specs(

@@ -1,0 +1,80 @@
+"""obs — unified run telemetry for training, serving, and benchmarks.
+
+The framework could train and serve but not *report on itself*: throughput,
+MFU, memory peaks, pipeline bubble fraction, and MoE load balance were
+computed ad hoc (or not at all) in ``bench.py``, ``utils/metrics.py`` and
+``tools/decode_bench.py`` with no shared schema, no cross-host view, and no
+event timeline (VERDICT round 5).  This subpackage is the one shared
+telemetry layer every train loop, example, and bench emits through:
+
+- :mod:`.telemetry` — :class:`Telemetry`, a run-session object that wraps a
+  jitted train/decode step, records per-step spans (data / dispatch /
+  device / fetch), detects recompiles, polls ``device.memory_stats()``, and
+  computes MFU + bytes-moved from XLA ``cost_analysis`` of the *compiled*
+  step (compiler ground truth — cross-checked against the 6N+12LSD hand
+  formula in ``bench.py``).
+- :mod:`.events` — append-only structured event log (compile, checkpoint
+  save/restore, preemption, NaN-watchdog trip, loss-scale change,
+  straggler alert) with monotonic timestamps and process index.
+- :mod:`.aggregate` — cross-host reduction of host-side step times
+  (min/mean/max per host → straggler detection) plus the per-parallelism
+  counters: pipeline bubble fraction, MoE expert-load imbalance.
+- :mod:`.report` + :mod:`.exporters` — pluggable sinks (JSONL always;
+  TensorBoard scalars and Prometheus textfile behind optional-import
+  guards) and the end-of-run ``RUNREPORT.json`` + markdown summary.
+
+Design constraints: ``obs`` is a LEAF subsystem — it imports nothing from
+the rest of the package at module scope (``utils.metrics`` shims over
+``obs.exporters``, so a module-level import the other way would cycle), and
+every device/backend touch is guarded so the CPU sim, a half-initialized
+backend, or an old jax still produce a report instead of a crash.
+"""
+
+from .events import EventLog, default_event_log, emit_event, set_default_event_log
+from .exporters import (
+    JsonlSink,
+    MultiSink,
+    PrometheusTextfileSink,
+    TensorBoardSink,
+    tensorboard_available,
+)
+from .telemetry import Telemetry, compiled_cost, peak_flops_for
+from .aggregate import (
+    cross_host_step_stats,
+    moe_load_stats,
+    percentiles,
+    pipeline_bubble_fraction,
+    step_time_stats,
+)
+from .report import (
+    RUNREPORT_SCHEMA,
+    default_report_path,
+    render_markdown,
+    validate_runreport,
+    write_runreport,
+)
+
+__all__ = [
+    "EventLog",
+    "default_event_log",
+    "emit_event",
+    "set_default_event_log",
+    "JsonlSink",
+    "MultiSink",
+    "PrometheusTextfileSink",
+    "TensorBoardSink",
+    "tensorboard_available",
+    "Telemetry",
+    "compiled_cost",
+    "peak_flops_for",
+    "cross_host_step_stats",
+    "moe_load_stats",
+    "percentiles",
+    "pipeline_bubble_fraction",
+    "step_time_stats",
+    "RUNREPORT_SCHEMA",
+    "default_report_path",
+    "render_markdown",
+    "validate_runreport",
+    "write_runreport",
+]

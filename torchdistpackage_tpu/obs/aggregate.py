@@ -1,0 +1,200 @@
+"""Cross-host aggregation + per-parallelism counters.
+
+Step timing is a HOST-side quantity (the jitted step is globally
+synchronous, but each host's Python loop has its own data/dispatch
+overhead), so a rank-0-only report describes one host of a pod.
+:func:`cross_host_step_stats` reduces every host's local step-time stats
+to one pod-wide view — min/mean/max per host — and flags stragglers, the
+"one slow host gates the collective" failure mode that per-host prints
+never surface.
+
+The per-parallelism counters live here too, computed from the schedules'
+own arithmetic rather than re-derived ad hoc per example:
+
+- :func:`pipeline_bubble_fraction` — from ``pipeline_sched.py``'s tick
+  counts (fwd scan: ``M+P-1`` ticks; 1F1B: ``M+2(P-1)``; interleaved:
+  ``VM + PV + P - 2``).
+- :func:`moe_load_stats` — expert-load imbalance / router entropy /
+  dropped-token rate from the counters ``parallel.moe.moe_forward``
+  returns with ``return_metrics=True``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def percentiles(samples: Sequence[float], ps=(50, 95, 99)) -> Dict[str, float]:
+    """``{"p50": ..., "p95": ..., "p99": ...}`` (empty input -> {})."""
+    arr = np.asarray(list(samples), dtype=np.float64)
+    if arr.size == 0:
+        return {}
+    return {f"p{p}": float(np.percentile(arr, p)) for p in ps}
+
+
+def step_time_stats(times: Sequence[float]) -> Dict[str, float]:
+    """Host-local summary of one run's step times."""
+    arr = np.asarray(list(times), dtype=np.float64)
+    if arr.size == 0:
+        return {"n": 0}
+    out = {
+        "n": int(arr.size),
+        "mean": float(arr.mean()),
+        "min": float(arr.min()),
+        "max": float(arr.max()),
+    }
+    out.update(percentiles(arr))
+    return out
+
+
+def cross_host_step_stats(
+    local_times: Sequence[float],
+    straggler_factor: float = 1.5,
+    event_log=None,
+) -> Dict[str, Any]:
+    """Pod-wide step-time view: per-host (min, mean, max) via one
+    ``process_allgather``, plus straggler detection.
+
+    A host is flagged a straggler when its mean step time exceeds
+    ``straggler_factor`` x the median of host means — the pod runs at the
+    pace of its slowest host, so this is the number to alert on.  When a
+    straggler is found a ``"straggler"`` event is emitted (on ``event_log``
+    or the process default).
+
+    Single-process runs take a collective-free path, so this is safe to
+    call unconditionally from ``Telemetry.finalize``.  Must be called by
+    EVERY process of a multi-host run (it is a collective).
+    """
+    local = step_time_stats(local_times)
+    mean = local.get("mean", 0.0)
+    lo = local.get("min", 0.0)
+    hi = local.get("max", 0.0)
+
+    try:
+        import jax
+
+        n_proc = jax.process_count()
+    except Exception:
+        n_proc = 1
+
+    if n_proc <= 1:
+        per_host = [{"process": 0, "mean": mean, "min": lo, "max": hi}]
+    else:
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(
+            multihost_utils.process_allgather(
+                jnp.asarray([mean, lo, hi], dtype=jnp.float32)
+            )
+        ).reshape(n_proc, 3)
+        per_host = [
+            {
+                "process": i,
+                "mean": float(gathered[i, 0]),
+                "min": float(gathered[i, 1]),
+                "max": float(gathered[i, 2]),
+            }
+            for i in range(n_proc)
+        ]
+
+    means = np.asarray([h["mean"] for h in per_host])
+    med = float(np.median(means)) if means.size else 0.0
+    straggler: Optional[int] = None
+    ratio = 1.0
+    if med > 0 and means.size > 1:
+        worst = int(np.argmax(means))
+        ratio = float(means[worst] / med)
+        if ratio > straggler_factor:
+            straggler = worst
+    out = {
+        "n_hosts": len(per_host),
+        "per_host": per_host,
+        "mean": float(means.mean()) if means.size else 0.0,
+        "min": float(min((h["min"] for h in per_host), default=0.0)),
+        "max": float(max((h["max"] for h in per_host), default=0.0)),
+        "straggler": straggler,
+        "straggler_ratio": round(ratio, 4),
+    }
+    if straggler is not None:
+        from .events import default_event_log
+
+        (event_log or default_event_log()).emit(
+            "straggler",
+            host=straggler,
+            ratio=round(ratio, 4),
+            mean_s=per_host[straggler]["mean"],
+            median_s=med,
+        )
+    return out
+
+
+def pipeline_bubble_fraction(
+    num_microbatches: int,
+    pipe_size: int,
+    num_chunks: int = 1,
+    schedule: str = "1f1b",
+) -> float:
+    """Fraction of schedule ticks a stage spends idle (fill + drain).
+
+    Derived from the package's own schedules (``pipeline_sched.py``):
+
+    - ``'forward'`` (``pipeline_forward``/``pipeline_loss`` scan):
+      ``M + P - 1`` ticks for M units of work -> ``(P-1)/(M+P-1)``.
+    - ``'1f1b'`` (``pipeline_1f1b``): ``VM + PV + P - 2`` ticks, each
+      carrying one fwd and one bwd unit, VM of each per stage ->
+      ``(PV + P - 2)/(VM + PV + P - 2)`` (classic ``2(P-1)/(M+2P-2)``
+      at V=1 — equivalently the Megatron ``(P-1)/(M+P-1)`` accounting
+      with bwd counted at fwd cost).
+    """
+    M, P_, V = int(num_microbatches), int(pipe_size), int(num_chunks)
+    if M < 1 or P_ < 1 or V < 1:
+        raise ValueError(f"bad schedule shape M={M} P={P_} V={V}")
+    if schedule == "forward":
+        return (P_ - 1) / (M + P_ - 1)
+    if schedule == "1f1b":
+        ticks = V * M + P_ * V + P_ - 2
+        return (P_ * V + P_ - 2) / ticks
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def moe_load_stats(
+    expert_tokens: Sequence[float],
+    dropped_rate: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Expert-load summary from per-expert kept-token counts.
+
+    - ``imbalance``: ``max/mean - 1`` (0 = perfectly balanced; 1 = the
+      hottest expert sees 2x its fair share — the EP all_to_all and the
+      hot expert's FFN run that much longer than the mean).
+    - ``load_entropy``: entropy of the load distribution normalized by
+      ``log(E)`` (1 = uniform, 0 = everything on one expert).
+    - ``dropped_token_rate``: passed through from the router counters
+      (fraction of (token, choice) assignments that overflowed capacity).
+    """
+    tok = np.asarray(list(expert_tokens), dtype=np.float64)
+    E = int(tok.size)
+    total = float(tok.sum())
+    if E == 0 or total <= 0:
+        out: Dict[str, Any] = {
+            "num_experts": E,
+            "expert_tokens": [float(t) for t in tok],
+            "imbalance": 0.0,
+            "load_entropy": 0.0,
+        }
+    else:
+        p = tok / total
+        with np.errstate(divide="ignore", invalid="ignore"):
+            h = abs(float(-np.sum(np.where(p > 0, p * np.log(p), 0.0))))
+        out = {
+            "num_experts": E,
+            "expert_tokens": [float(t) for t in tok],
+            "imbalance": float(tok.max() / tok.mean() - 1.0),
+            "load_entropy": h / math.log(E) if E > 1 else 1.0,
+        }
+    if dropped_rate is not None:
+        out["dropped_token_rate"] = float(dropped_rate)
+    return out

@@ -1,0 +1,119 @@
+"""Append-only structured event log — the run's timeline.
+
+Subsumes the print-based side channels (``utils/preemption.py`` signal
+prints, ``tools/debug_nan.py`` NaN reports): instead of a line on stderr
+that evaporates, a structured record lands in memory (always) and in a
+JSONL file (when a path/sink is attached), with both wall-clock and
+monotonic timestamps plus the emitting process index — enough to interleave
+events from several hosts after the fact.
+
+Well-known kinds (free-form kinds are fine too; these are what the report
+timeline and tests key on):
+
+==================  =====================================================
+``run_start/end``   session boundaries (Telemetry emits these)
+``compile``         first compilation of a wrapped step
+``recompile``       a wrapped step saw a NEW input signature — the silent
+                    throughput killer Telemetry exists to catch
+``checkpoint_save`` / ``checkpoint_restore``
+``preemption``      a termination signal arrived (GracefulShutdown)
+``nan_watchdog``    a ``nan_guard``-ed function produced non-finite output
+``loss_scale``      dynamic loss-scale change
+``straggler``       a host's step time is an outlier (obs.aggregate)
+==================  =====================================================
+
+A module-level default log lets deep call sites (signal handlers, debug
+callbacks) emit without plumbing a handle through every layer:
+``emit_event("preemption", signum=15)``.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, Optional
+
+
+def _process_index() -> int:
+    """Best-effort process index: 0 before/without distributed init."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+class EventLog:
+    """In-memory (bounded deque) + optional JSONL-file event log.
+
+    - ``path``: append-mode JSONL file.  Written on the master process only
+      unless ``all_processes=True`` (per-host event files on a pod should
+      use distinct paths — e.g. suffix ``jax.process_index()``).
+    - ``sink``: any object with a ``write(record: dict)`` method (an
+      :class:`~.exporters.JsonlSink` or friends) — used instead of ``path``.
+    """
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        sink=None,
+        history_max: int = 4096,
+        all_processes: bool = False,
+    ) -> None:
+        if path is not None and sink is None:
+            from .exporters import JsonlSink
+
+            sink = JsonlSink(path)
+        self._sink = sink
+        self._all_processes = all_processes
+        self.events: collections.deque = collections.deque(maxlen=history_max)
+
+    def emit(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        """Record one event; returns the record (all processes)."""
+        rec: Dict[str, Any] = {
+            "type": "event",
+            "kind": str(kind),
+            "t_wall": time.time(),
+            "t_mono": time.monotonic(),
+            "process": _process_index(),
+        }
+        rec.update(fields)
+        self.events.append(rec)
+        if self._sink is not None and (self._all_processes or rec["process"] == 0):
+            try:
+                self._sink.write(rec)
+            except OSError:
+                pass  # read-only checkout / full disk: keep the in-memory log
+        return rec
+
+    def of_kind(self, kind: str):
+        return [e for e in self.events if e["kind"] == kind]
+
+    def as_list(self):
+        return list(self.events)
+
+
+_default_log: Optional[EventLog] = None
+
+
+def default_event_log() -> EventLog:
+    """The process-wide event log (created in-memory on first use)."""
+    global _default_log
+    if _default_log is None:
+        _default_log = EventLog()
+    return _default_log
+
+
+def set_default_event_log(log: Optional[EventLog]) -> None:
+    """Install (or with None: reset) the process-wide default log.
+    ``Telemetry`` installs its own log here so signal handlers and debug
+    callbacks land on the same timeline as the step records."""
+    global _default_log
+    _default_log = log
+
+
+def emit_event(kind: str, **fields: Any) -> Dict[str, Any]:
+    """Emit on the process-wide default log — the zero-plumbing entry point
+    for deep call sites (signal handlers, ``jax.debug.callback``)."""
+    return default_event_log().emit(kind, **fields)

@@ -1,0 +1,215 @@
+"""End-of-run report: schema, validation, JSON + markdown rendering.
+
+``RUNREPORT.json`` is the machine-readable artifact a run leaves behind
+(the driver's CI asserts every integrated example produces a valid one);
+the sibling ``RUNREPORT.md`` is the human summary.  The schema is
+versioned and validated structurally — :func:`validate_runreport` returns
+a list of problems (empty = valid) rather than raising, so callers can
+decide whether a malformed report is fatal.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+RUNREPORT_SCHEMA = "tdp-runreport/v1"
+
+# top-level key -> required python type (None = any); everything Telemetry
+# emits, and everything validate checks.
+_REQUIRED: Dict[str, type] = {
+    "schema": str,
+    "run": str,
+    "backend": str,
+    "n_devices": int,
+    "n_processes": int,
+    "steps": int,
+    "step_time_s": dict,
+    "spans_mean_s": dict,
+    "throughput": dict,
+    "mfu": dict,
+    "memory": dict,
+    "compile": dict,
+    "hosts": dict,
+    "counters": dict,
+    "events": list,
+}
+
+
+def default_report_path() -> Optional[str]:
+    """The ``TDP_RUNREPORT`` env var — how the CI example runner points
+    each subprocess at its own report file.  Empty/unset -> None."""
+    return os.environ.get("TDP_RUNREPORT") or None
+
+
+def validate_runreport(report: Any) -> List[str]:
+    """Structural validation; returns problem strings (empty list = valid)."""
+    errs: List[str] = []
+    if not isinstance(report, dict):
+        return [f"report is {type(report).__name__}, expected dict"]
+    for key, typ in _REQUIRED.items():
+        if key not in report:
+            errs.append(f"missing key {key!r}")
+        elif not isinstance(report[key], typ):
+            errs.append(
+                f"{key!r} is {type(report[key]).__name__}, expected {typ.__name__}")
+    if errs:
+        return errs
+    if report["schema"] != RUNREPORT_SCHEMA:
+        errs.append(
+            f"schema {report['schema']!r} != {RUNREPORT_SCHEMA!r}")
+    if report["steps"] < 0:
+        errs.append(f"steps {report['steps']} < 0")
+    st = report["step_time_s"]
+    if st.get("n", 0) > 0:
+        for k in ("mean", "min", "max", "p50"):
+            if not isinstance(st.get(k), (int, float)):
+                errs.append(f"step_time_s.{k} missing/non-numeric")
+    for i, ev in enumerate(report["events"]):
+        if not isinstance(ev, dict) or "kind" not in ev or "t_mono" not in ev:
+            errs.append(f"events[{i}] lacks kind/t_mono")
+            break
+    hosts = report["hosts"]
+    if "n_hosts" not in hosts or "per_host" not in hosts:
+        errs.append("hosts lacks n_hosts/per_host")
+    return errs
+
+
+def render_summary_line(report: Dict[str, Any]) -> str:
+    """One line for stdout at end of run."""
+    parts = [f"[obs] run={report['run']} steps={report['steps']}"]
+    st = report.get("step_time_s", {})
+    if st.get("n"):
+        parts.append(f"step={st['mean'] * 1e3:.1f}ms(p99 {st['p99'] * 1e3:.1f})")
+    tp = report.get("throughput", {})
+    if "tokens_per_sec" in tp:
+        parts.append(f"tok/s={tp['tokens_per_sec']:.0f}")
+    mfu = report.get("mfu", {})
+    if "xla" in mfu:
+        parts.append(f"mfu_xla={mfu['xla']:.3f}")
+    mem = report.get("memory", {})
+    if mem.get("reported"):
+        parts.append(f"peak_hbm={mem['peak_bytes_in_use'] / 1e9:.2f}GB")
+    hosts = report.get("hosts", {})
+    if hosts.get("straggler") is not None:
+        parts.append(f"STRAGGLER=host{hosts['straggler']}")
+    return "  ".join(parts)
+
+
+def render_markdown(report: Dict[str, Any]) -> str:
+    """Human summary: headline table, MFU cross-check, counters, memory,
+    and the event timeline."""
+    L: List[str] = [f"# Run report — {report['run']}", ""]
+    L.append(
+        f"`{report['backend']}` · chip `{report.get('chip', '?')}` · "
+        f"{report['n_devices']} device(s) / {report['n_processes']} process(es) · "
+        f"{report['steps']} steps · {report.get('wall_time_s', 0):.1f}s wall")
+    L.append("")
+
+    st = report.get("step_time_s", {})
+    if st.get("n"):
+        L.append("## Step time (steady-state)")
+        L.append("")
+        L.append("| mean | min | p50 | p95 | p99 | max |")
+        L.append("|---|---|---|---|---|---|")
+        L.append(
+            "| " + " | ".join(
+                f"{st[k] * 1e3:.2f} ms"
+                for k in ("mean", "min", "p50", "p95", "p99", "max")) + " |")
+        L.append("")
+        spans = report.get("spans_mean_s", {})
+        if spans:
+            L.append(
+                "Span means: " + ", ".join(
+                    f"{k} {v * 1e3:.2f} ms" for k, v in spans.items()))
+            L.append("")
+
+    tp = report.get("throughput", {})
+    if "tokens_per_sec" in tp:
+        L.append("## Throughput")
+        L.append("")
+        L.append(f"- mean **{tp['tokens_per_sec']:.1f} tok/s**, "
+                 f"final {tp['tokens_per_sec_final']:.1f} tok/s")
+        traj = tp.get("trajectory")
+        if traj:
+            L.append(f"- trajectory ({len(traj)} pts): "
+                     + " ".join(f"{t:.0f}" for t in traj))
+        L.append("")
+
+    mfu = report.get("mfu", {})
+    if mfu:
+        L.append("## MFU / FLOPs")
+        L.append("")
+        if "xla" in mfu:
+            L.append(f"- XLA cost-analysis MFU: **{mfu['xla']:.3f}**")
+        if "formula" in mfu:
+            L.append(f"- hand-formula MFU: {mfu['formula']:.3f}")
+        if "xla_vs_formula_rel" in mfu:
+            L.append(f"- XLA vs formula FLOPs: {mfu['xla_vs_formula_rel']:+.1%}")
+        if "xla_flops_per_step" in mfu:
+            L.append(f"- FLOPs/step (XLA): {mfu['xla_flops_per_step']:.3e}")
+        if "xla_bytes_per_step" in mfu:
+            L.append(f"- bytes moved/step (XLA): {mfu['xla_bytes_per_step']:.3e}")
+        L.append("")
+
+    mem = report.get("memory", {})
+    if mem.get("reported"):
+        L.append(f"Peak HBM in use: **{mem['peak_bytes_in_use'] / 1e9:.3f} GB**")
+        L.append("")
+
+    comp = report.get("compile", {})
+    L.append(f"Compiles: {comp.get('count', 0)} "
+             f"({comp.get('recompiles', 0)} recompiles), "
+             f"{comp.get('time_s', 0):.1f}s total")
+    L.append("")
+
+    counters = report.get("counters", {})
+    if counters:
+        L.append("## Counters")
+        L.append("")
+        for name, val in counters.items():
+            L.append(f"- **{name}**: `{json.dumps(val)}`")
+        L.append("")
+
+    hosts = report.get("hosts", {})
+    if hosts.get("n_hosts", 1) > 1:
+        L.append("## Hosts")
+        L.append("")
+        L.append("| host | mean | min | max |")
+        L.append("|---|---|---|---|")
+        for h in hosts["per_host"]:
+            mark = " ⚠" if h["process"] == hosts.get("straggler") else ""
+            L.append(f"| {h['process']}{mark} | {h['mean'] * 1e3:.2f} ms "
+                     f"| {h['min'] * 1e3:.2f} | {h['max'] * 1e3:.2f} |")
+        L.append("")
+
+    events = report.get("events", [])
+    if events:
+        L.append("## Event timeline")
+        L.append("")
+        t0 = events[0]["t_mono"]
+        for ev in events:
+            extras = {k: v for k, v in ev.items()
+                      if k not in ("type", "kind", "t_wall", "t_mono", "process")
+                      and v is not None}
+            tail = f" {json.dumps(extras)}" if extras else ""
+            L.append(f"- `+{ev['t_mono'] - t0:8.3f}s` p{ev['process']} "
+                     f"**{ev['kind']}**{tail}")
+        L.append("")
+    return "\n".join(L)
+
+
+def write_runreport(report: Dict[str, Any], path: str) -> None:
+    """Write ``path`` (JSON) and a sibling ``.md``; best-effort on OSError
+    (a read-only checkout must not crash the run at its last step)."""
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1, default=str)
+        md = os.path.splitext(path)[0] + ".md"
+        with open(md, "w") as f:
+            f.write(render_markdown(report))
+    except OSError:
+        pass

@@ -1,0 +1,466 @@
+"""Telemetry — the per-run session object every loop reports through.
+
+Wrap the jitted train/decode step once and every call is accounted for:
+
+    tel = Telemetry(run="train_llama", tokens_per_step=B * S,
+                    sinks=[JsonlSink("metrics.jsonl")])
+    step = tel.wrap_step(step)
+    for it in range(n):
+        batch = next(batches)                      # -> 'data' span
+        params, state, loss = step(params, state, batch)   # -> 'dispatch'
+        rec = tel.end_step(step=it, loss=loss)     # -> 'device' + 'fetch'
+    report = tel.finalize()                        # RUNREPORT.json (+ .md)
+
+Per-step spans (host clock, seconds):
+
+- ``data``     — end of last step's fetch to this step's dispatch (host
+  input pipeline: batch building, device_put).
+- ``dispatch`` — the wrapped call itself.  XLA is async, so this is trace/
+  cache-lookup + enqueue time; a big number here means host-bound.
+- ``device``   — ``block_until_ready`` on the step outputs: actual
+  accelerator execution (plus any queue ahead of it).
+- ``fetch``    — ``float()`` of the scalars handed to :meth:`end_step`
+  (device->host transfer of the loss etc.).
+
+Recompile detection: the wrapper keys on the abstract signature (shape /
+dtype / tree structure) of the call's arguments.  A NEW signature after
+the first is a recompile — the silent throughput killer (a leaked varying
+dimension, a dtype flip) — and emits a ``recompile`` event plus a
+``recompiled: true`` mark on the step record.
+
+MFU ground truth: the first compilation of each signature goes through
+AOT ``lower().compile()``, so XLA's own ``cost_analysis`` of the compiled
+step (FLOPs, bytes accessed) is captured as a side effect — no second
+compile, no hand-counting.  ``bench.py`` cross-checks this number against
+its 6N+12LSD hand formula; disagreement is printed, not hidden (remat
+recompute and non-matmul ops are IN the XLA count and NOT in the model-
+FLOPs count, so the two bracket the truth from opposite sides).
+
+Memory: ``device.memory_stats()`` is polled each step (guarded — the CPU
+sim reports nothing) and the run peak lands in the report.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import aggregate as _agg
+from . import report as _report
+from .events import EventLog, set_default_event_log
+
+# Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
+# The one lookup table for the whole repo — bench.py imports it from here.
+PEAK_BF16_FLOPS = [
+    ("v6", 918e12),  # Trillium
+    ("v5p", 459e12),
+    ("v5e", 197e12),  # aka v5 lite
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+
+def peak_flops_for(device_kind: str) -> Optional[float]:
+    dk = device_kind.lower()
+    for sub, peak in PEAK_BF16_FLOPS:
+        if sub in dk:
+            return peak
+    return None
+
+
+def compiled_cost(compiled) -> Dict[str, float]:
+    """``{"flops", "bytes_accessed"}`` from XLA's cost analysis of a
+    compiled executable (zeros-omitted; {} when the backend reports
+    nothing).  Same extraction as ``tools/profiler.py`` — compiler ground
+    truth, per participating device of the SPMD program."""
+    out: Dict[str, float] = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca.get("flops"):
+            out["flops"] = float(ca["flops"])
+        if ca.get("bytes accessed"):
+            out["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:
+        pass
+    return out
+
+
+def _abstract_signature(args: Tuple[Any, ...]) -> Tuple:
+    """Hashable (treedef, per-leaf shape/dtype) key — what jit's cache keys
+    on, minus shardings (a sharding-only change recompiles without showing
+    here; the AOT fallback path still catches it as a failed call)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = []
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sig.append((tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            sig.append((type(leaf).__name__,))
+    return (str(treedef), tuple(sig))
+
+
+def _local_memory_stats() -> Optional[Tuple[int, int]]:
+    """(peak_bytes, live_bytes) summed over local devices; None when no
+    device reports (CPU sim)."""
+    import jax
+
+    peak = live = 0
+    seen = False
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return None
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        seen = True
+        peak += int(ms.get("peak_bytes_in_use", 0))
+        live += int(ms.get("bytes_in_use", 0))
+    return (peak, live) if seen else None
+
+
+class Telemetry:
+    """One instance per run.  See the module docstring for the loop shape.
+
+    Parameters
+    ----------
+    run: name stamped on every record and the report.
+    sinks: list of exporter sinks fed every step record and the summary
+        (JSONL/TensorBoard/Prometheus — :mod:`.exporters`).  Optional: the
+        in-memory history + RUNREPORT always work.
+    tokens_per_step: enables tokens/sec throughput accounting.
+    flops_per_token: the HAND formula (e.g. bench.py's 6N+12LSD) — kept
+        separate from the XLA-measured FLOPs so the report can show both.
+    peak_flops: per-chip peak FLOP/s; default looked up from the device
+        kind (:func:`peak_flops_for`), None on CPU.
+    report_path: where :meth:`finalize` writes ``RUNREPORT.json`` (+ a
+        sibling ``.md``).  Default: the ``TDP_RUNREPORT`` env var; unset ->
+        no file, the report dict is still returned.
+    event_log: a shared :class:`EventLog`; by default a fresh one is
+        created AND installed as the process default so ``GracefulShutdown``
+        / ``nan_guard`` events land on this run's timeline.
+    """
+
+    def __init__(
+        self,
+        run: str = "run",
+        sinks: Optional[List[Any]] = None,
+        tokens_per_step: Optional[int] = None,
+        flops_per_token: Optional[float] = None,
+        peak_flops: Optional[float] = None,
+        report_path: Optional[str] = None,
+        event_log: Optional[EventLog] = None,
+        poll_memory: bool = True,
+        history_max: int = 100_000,
+    ) -> None:
+        import jax
+
+        self.run = run
+        self.sinks = list(sinks) if sinks else []
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_token = flops_per_token
+        self.poll_memory = poll_memory
+        self.report_path = (
+            report_path if report_path is not None else _report.default_report_path()
+        )
+        if event_log is None:
+            event_log = EventLog()
+            set_default_event_log(event_log)
+        self.events = event_log
+        self.counters: Dict[str, Any] = {}
+        self.history: List[Dict[str, Any]] = []
+        self._history_max = history_max
+
+        try:
+            self._backend = jax.default_backend()
+            dev = jax.devices()[0]
+            self._chip = dev.device_kind
+            self._n_devices = jax.device_count()
+            self._n_processes = jax.process_count()
+            self._is_master = jax.process_index() == 0
+        except Exception:
+            self._backend, self._chip = "unknown", "unknown"
+            self._n_devices = self._n_processes = 1
+            self._is_master = True
+        self.peak_flops = (
+            peak_flops if peak_flops is not None
+            else (peak_flops_for(self._chip) if self._backend != "cpu" else None)
+        )
+
+        self._compiled: Dict[Tuple, Dict[str, Any]] = {}
+        self._aot_ok = True
+        self._pending_out: Any = None
+        self._pending_spans: Dict[str, float] = {}
+        self._recompiled = False
+        self._last_fetch_end: Optional[float] = None
+        self._step_n = 0
+        self.n_compiles = 0
+        self.compile_time_s = 0.0
+        self.xla_cost: Dict[str, float] = {}
+        self._peak_bytes = 0
+        self._t_start = time.monotonic()
+        self.events.emit(
+            "run_start", run=run, backend=self._backend, chip=self._chip,
+            n_devices=self._n_devices, n_processes=self._n_processes,
+        )
+
+    # ------------------------------------------------------------- wrapping
+
+    def wrap_step(self, fn: Callable, cost_analysis: bool = True) -> Callable:
+        """Instrument a (jitted) step callable.
+
+        The first call per abstract signature is AOT-lowered and compiled,
+        capturing compile time + XLA cost analysis; subsequent calls go to
+        the compiled executable (no double compile).  If the AOT executable
+        rejects a call (sharding/donation edge the signature key can't
+        see), the wrapper permanently falls back to the original callable —
+        telemetry must never change what the loop computes.
+        """
+        import jax
+
+        jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+
+        def wrapped(*args, **kwargs):
+            now = time.perf_counter()
+            if self._last_fetch_end is not None:
+                self._pending_spans["data"] = now - self._last_fetch_end
+            entry = None
+            sig = None
+            if not kwargs:  # kwargs: skip AOT, plain call below
+                sig = _abstract_signature(args)
+                entry = self._compiled.get(sig)
+                if entry is None:
+                    entry = self._compile_entry(jfn, sig, args, cost_analysis)
+            t0 = time.perf_counter()
+            target = entry["compiled"] if (entry and entry["compiled"]) else jfn
+            try:
+                out = target(*args, **kwargs)
+            except Exception:
+                if target is not jfn:
+                    # AOT path rejected the call: fall back for good
+                    self._aot_ok = False
+                    for e in self._compiled.values():
+                        e["compiled"] = None
+                    out = jfn(*args, **kwargs)
+                else:
+                    raise
+            self._pending_spans["dispatch"] = time.perf_counter() - t0
+            self._pending_out = out
+            return out
+
+        return wrapped
+
+    def _compile_entry(self, jfn, sig, args, cost_analysis) -> Dict[str, Any]:
+        first = not self._compiled
+        compiled = None
+        cost: Dict[str, float] = {}
+        t0 = time.perf_counter()
+        if cost_analysis and self._aot_ok:
+            try:
+                compiled = jfn.lower(*args).compile()
+                cost = compiled_cost(compiled)
+            except Exception:
+                self._aot_ok = False
+                compiled = None
+        dt = time.perf_counter() - t0
+        entry = {"compiled": compiled, "cost": cost}
+        self._compiled[sig] = entry
+        self.n_compiles += 1
+        self.compile_time_s += dt
+        if first:
+            self.xla_cost = dict(cost)
+        else:
+            self._recompiled = True
+        self.events.emit(
+            "compile" if first else "recompile",
+            run=self.run,
+            compile_time_s=round(dt, 4),
+            flops=cost.get("flops"),
+            bytes_accessed=cost.get("bytes_accessed"),
+            n_signatures=len(self._compiled),
+        )
+        return entry
+
+    # ------------------------------------------------------------ recording
+
+    def end_step(self, step: Optional[int] = None, **scalars: Any) -> Dict[str, Any]:
+        """Close the step opened by the wrapped call: block on its outputs
+        (device span), fetch the passed scalars (fetch span), build the
+        record, feed the sinks.  Returns the record with host floats — use
+        ``rec["loss"]`` instead of a second ``float(loss)``."""
+        import jax
+
+        t0 = time.perf_counter()
+        if self._pending_out is not None:
+            try:
+                jax.block_until_ready(self._pending_out)
+            except Exception:
+                pass
+            self._pending_out = None
+        t1 = time.perf_counter()
+        rec: Dict[str, Any] = {
+            "type": "step",
+            "run": self.run,
+            "step": int(step) if step is not None else self._step_n,
+        }
+        for k, v in scalars.items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                rec[k] = v
+        t2 = time.perf_counter()
+        spans = dict(self._pending_spans)
+        self._pending_spans = {}
+        spans["device"] = t1 - t0
+        spans["fetch"] = t2 - t1
+        for name, dt in spans.items():
+            rec[f"span_{name}_s"] = dt
+        step_time = sum(spans.values())
+        rec["step_time_s"] = step_time
+        if self._recompiled:
+            rec["recompiled"] = True
+            self._recompiled = False
+        if self.tokens_per_step and step_time > 0:
+            rec["tok_per_sec"] = self.tokens_per_step / step_time
+        if self.poll_memory:
+            mem = _local_memory_stats()
+            if mem is not None:
+                rec["peak_bytes_in_use"], rec["bytes_in_use"] = mem
+                self._peak_bytes = max(self._peak_bytes, mem[0])
+        self._last_fetch_end = t2
+        self._step_n += 1
+        if len(self.history) < self._history_max:
+            self.history.append(rec)
+        if self._is_master:
+            for s in self.sinks:
+                try:
+                    s.write(rec)
+                except Exception:
+                    pass
+        return rec
+
+    def record_counters(self, **named: Any) -> None:
+        """Attach per-parallelism counters to the report, e.g.
+        ``tel.record_counters(pipeline={"bubble_fraction": f},
+        moe=moe_load_stats(...))``."""
+        self.counters.update(named)
+
+    # ------------------------------------------------------------- finalize
+
+    def _steady_steps(self) -> List[Dict[str, Any]]:
+        """Records excluding compile-tainted steps (the first record and any
+        recompiled one): those intervals time XLA, not the steady state."""
+        if not self.history:
+            return []
+        first = self.history[0]["step"]
+        return [
+            r for r in self.history
+            if not r.get("recompiled") and r["step"] != first
+        ]
+
+    def finalize(
+        self,
+        extra: Optional[Dict[str, Any]] = None,
+        write: bool = True,
+        print_summary: bool = True,
+    ) -> Dict[str, Any]:
+        """Build the end-of-run report; on the master process write
+        ``RUNREPORT.json`` + markdown (when a report path is configured)
+        and hand the summary to every sink.  Collective when
+        ``process_count > 1`` (cross-host step-time aggregation) — call it
+        on every process, as with any collective."""
+        steady = self._steady_steps()
+        times = [r["step_time_s"] for r in steady]
+        stats = _agg.step_time_stats(times)
+        hosts = _agg.cross_host_step_stats(times, event_log=self.events)
+
+        span_means: Dict[str, float] = {}
+        for name in ("data", "dispatch", "device", "fetch"):
+            vals = [r[f"span_{name}_s"] for r in steady if f"span_{name}_s" in r]
+            if vals:
+                span_means[name] = float(np.mean(vals))
+
+        throughput: Dict[str, Any] = {}
+        tps = [r["tok_per_sec"] for r in steady if "tok_per_sec" in r]
+        if tps:
+            throughput["tokens_per_sec"] = float(np.mean(tps))
+            throughput["tokens_per_sec_final"] = float(tps[-1])
+            # trajectory downsampled to <= 64 points so the artifact stays
+            # readable for long runs
+            stride = max(1, len(tps) // 64)
+            throughput["trajectory"] = [round(t, 2) for t in tps[::stride]]
+
+        mfu: Dict[str, Any] = {}
+        mean_t = stats.get("mean", 0.0)
+        if mean_t > 0:
+            if self.xla_cost.get("flops"):
+                mfu["xla_flops_per_step"] = self.xla_cost["flops"]
+                mfu["xla_flops_per_sec"] = self.xla_cost["flops"] / mean_t
+                if self.peak_flops:
+                    mfu["xla"] = round(
+                        self.xla_cost["flops"] / mean_t / self.peak_flops, 4)
+            if self.xla_cost.get("bytes_accessed"):
+                mfu["xla_bytes_per_step"] = self.xla_cost["bytes_accessed"]
+            if self.flops_per_token and self.tokens_per_step:
+                formula = self.flops_per_token * self.tokens_per_step
+                mfu["formula_flops_per_step"] = formula
+                if self.peak_flops:
+                    mfu["formula"] = round(formula / mean_t / self.peak_flops, 4)
+                if self.xla_cost.get("flops"):
+                    mfu["xla_vs_formula_rel"] = round(
+                        (self.xla_cost["flops"] - formula) / formula, 4)
+
+        self.events.emit("run_end", run=self.run, steps=self._step_n)
+        report = {
+            "schema": _report.RUNREPORT_SCHEMA,
+            "run": self.run,
+            "backend": self._backend,
+            "chip": self._chip,
+            "n_devices": self._n_devices,
+            "n_processes": self._n_processes,
+            "steps": self._step_n,
+            "wall_time_s": round(time.monotonic() - self._t_start, 3),
+            "step_time_s": stats,
+            "spans_mean_s": span_means,
+            "throughput": throughput,
+            "mfu": mfu,
+            "memory": {
+                "peak_bytes_in_use": self._peak_bytes,
+                "reported": self._peak_bytes > 0,
+            },
+            "compile": {
+                "count": self.n_compiles,
+                "time_s": round(self.compile_time_s, 3),
+                "recompiles": max(0, self.n_compiles - 1),
+            },
+            "hosts": hosts,
+            "counters": self.counters,
+            "events": self.events.as_list(),
+        }
+        if extra:
+            report.update(extra)
+        if self._is_master:
+            for s in self.sinks:
+                try:
+                    s.write_summary(report)
+                except Exception:
+                    pass
+            if write and self.report_path:
+                _report.write_runreport(report, self.report_path)
+            if print_summary:
+                from ..utils.logging import master_print
+
+                master_print(_report.render_summary_line(report))
+        return report

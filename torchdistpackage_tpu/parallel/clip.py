@@ -84,10 +84,14 @@ class DynamicLossScale:
     clip_grad_parallel.py:100-134).  Not needed for bf16 TPU training; useful
     when experimenting with fp16 grads."""
 
-    def __init__(self, init_scale: float = 2.0**15, growth_interval: int = 2000, factor: float = 2.0):
+    def __init__(self, init_scale: float = 2.0**15, growth_interval: int = 2000,
+                 factor: float = 2.0, emit_events: bool = True):
         self.init_scale = init_scale
         self.growth_interval = growth_interval
         self.factor = factor
+        # scale changes land on the obs event timeline (an async
+        # jax.debug.callback — same in-jit pattern as tools.nan_guard)
+        self.emit_events = emit_events
 
     def init(self) -> LossScaleState:
         return LossScaleState(
@@ -123,4 +127,15 @@ class DynamicLossScale:
             finite, (state.good_steps + 1) % self.growth_interval, 0
         )
         grads = jax.tree.map(lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+        if self.emit_events:
+            def _emit(old, new):
+                try:
+                    if float(old) != float(new):
+                        from ..obs.events import emit_event
+
+                        emit_event("loss_scale", old=float(old), new=float(new))
+                except Exception:
+                    pass  # telemetry must never fail the step
+
+            jax.debug.callback(_emit, state.scale, new_scale)
         return grads, LossScaleState(new_scale, new_good), finite

@@ -213,7 +213,10 @@ def memory_report(label: str = "") -> dict:
                 "peak_bytes_in_use": s.get("peak_bytes_in_use", 0),
             }
     if label and stats:
+        from ..utils.logging import master_print
+
         used = max(v["bytes_in_use"] for v in stats.values())
         peak = max(v["peak_bytes_in_use"] for v in stats.values())
-        print(f"[mem {label}] in_use={used/1e9:.3f} GB peak={peak/1e9:.3f} GB")
+        master_print(
+            f"[mem {label}] in_use={used/1e9:.3f} GB peak={peak/1e9:.3f} GB")
     return stats

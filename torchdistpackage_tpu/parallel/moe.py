@@ -223,17 +223,57 @@ def _expert_ffn(p: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum("egf,efd->egd", h, p["w2"]) + p["b2"][:, None, :]
 
 
+def _router_metrics(
+    probs: jnp.ndarray, keep: Optional[jnp.ndarray], top_k: int,
+    ec_tok_idx: Optional[jnp.ndarray] = None, capacity: int = 0,
+) -> Dict[str, jnp.ndarray]:
+    """Observability counters (stop_gradient — they must not perturb
+    training).  Token-choice: ``keep`` [T, k, E] from :func:`_top_k_route`
+    gives per-expert kept counts and the overflow-drop rate.  Expert-choice:
+    ``ec_tok_idx`` [E, C] gives coverage (every expert is exactly full, so
+    the "dropped" quantity is tokens picked by NO expert).
+
+    Per-device locals under EP/shard_map — aggregate across shards (psum or
+    host-side sum) before reporting pod-wide balance.  Consumed by
+    ``obs.aggregate.moe_load_stats`` / ``Telemetry.record_counters``."""
+    probs = jax.lax.stop_gradient(probs)
+    T, E = probs.shape
+    # mean per-token router entropy, normalized to [0, 1] by log E
+    plogp = jnp.where(probs > 0, probs * jnp.log(probs), 0.0)
+    entropy = -jnp.sum(plogp, axis=-1).mean() / math.log(max(E, 2))
+    if keep is not None:
+        keep = jax.lax.stop_gradient(keep)
+        expert_tokens = jnp.sum(keep, axis=(0, 1))  # [E] kept choices
+        dropped = 1.0 - jnp.sum(keep) / (T * top_k)
+    else:
+        ec_tok_idx = jax.lax.stop_gradient(ec_tok_idx)
+        expert_tokens = jnp.full((E,), float(capacity), probs.dtype)
+        covered = (
+            jnp.zeros((T,), jnp.int32).at[ec_tok_idx.reshape(-1)].add(1) > 0
+        )
+        dropped = 1.0 - jnp.mean(covered.astype(probs.dtype))
+    return {
+        "router_entropy": entropy.astype(jnp.float32),
+        "expert_tokens": expert_tokens.astype(jnp.float32),
+        "dropped_token_rate": dropped.astype(jnp.float32),
+    }
+
+
 def moe_forward(
     params: Dict[str, PyTree],
     x: jnp.ndarray,
     cfg: MoEConfig,
     ep_axis: Optional[str] = None,
     causal: bool = False,
+    return_metrics: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """MoE FFN layer.  x: [B, S, D] (the device-local tokens under EP).
 
     Returns ``(y, aux_loss)``; add ``cfg.aux_loss_weight * aux_loss`` to the
-    training loss.  With ``ep_axis`` set (inside shard_map) the stacked expert
+    training loss.  ``return_metrics=True`` appends a third element — the
+    :func:`_router_metrics` observability counters (router entropy,
+    per-expert kept-token counts, dropped-token rate; all
+    ``stop_gradient``-ed), for ``obs.Telemetry`` wiring.  With ``ep_axis`` set (inside shard_map) the stacked expert
     params hold only the local shard of experts and tokens are exchanged with
     two ``all_to_all`` collectives over the EP axis; dropped tokens contribute
     zero so callers should use the output additively (residual).
@@ -272,6 +312,14 @@ def moe_forward(
         capacity = min(capacity, T)  # an expert cannot pick more than T tokens
         # every expert exactly full: balanced by construction, no aux needed
         aux = jnp.zeros((), jnp.float32)
+        metrics = (
+            _router_metrics(
+                probs, None, cfg.top_k,
+                ec_tok_idx=jax.lax.top_k(probs.T, capacity)[1],
+                capacity=capacity,
+            )
+            if return_metrics else None
+        )
         if _use_sorted(cfg, T, capacity):
             # index path: the EC pick IS a gather spec — tok_idx[e, c] names
             # the token in slot c of expert e; no [T, E, C] tensors exist
@@ -305,6 +353,9 @@ def moe_forward(
             priority="token" if causal else "choice",
         )
         aux = _load_balance_loss(probs, jnp.sum(keep, axis=1))
+        metrics = (
+            _router_metrics(probs, keep, cfg.top_k) if return_metrics else None
+        )
         if _use_sorted(cfg, T, capacity):
             kept = jnp.sum(keep, axis=-1)  # [T, k] 1 iff the choice fit
             # flat destination slot e*C + c; dropped choices go to a
@@ -362,7 +413,8 @@ def moe_forward(
         ).reshape(E, capacity, D)
 
     y = combine_out(expert_out)
-    return y.reshape(B, S, D), aux.astype(jnp.float32)
+    out = (y.reshape(B, S, D), aux.astype(jnp.float32))
+    return out + (metrics,) if return_metrics else out
 
 
 def moe_serve_forward(

@@ -85,6 +85,14 @@ def nan_guard(fn: Callable = None, *, name: Optional[str] = None) -> Callable:
             if flags:
                 def report(*host_flags):
                     if any(bool(h) for h in host_flags):
+                        try:
+                            # land the trip on the run timeline before the
+                            # raise unwinds the step (obs event, not print)
+                            from ..obs.events import emit_event
+
+                            emit_event("nan_watchdog", fn=label)
+                        except Exception:
+                            pass
                         raise FloatingPointError(
                             f"nan_guard: non-finite output of {label}"
                         )

@@ -9,8 +9,18 @@ decode reads every weight once per token, so weight-only int8 has up to
     python -m torchdistpackage_tpu.tools.decode_bench            # on-chip
     TDP_CPU_SIM=1 python -m torchdistpackage_tpu.tools.decode_bench  # smoke
 
-Prints one JSON line per (batch, context) cell with both rates and the
-speedup.  Results are recorded in docs/BENCH_AB.md.
+Emits through the obs schema: one ``decode-latency`` JSON line per
+(batch, context, variant) cell with **p50/p95/p99 latency percentiles per
+phase** — ``prefill`` (time to first token) and ``decode_step`` (per-token
+incremental latency) — plus the legacy per-cell throughput/speedup lines,
+and an end-of-run ``RUNREPORT.json`` when ``TDP_RUNREPORT`` is set (the
+same env contract as the train examples).  Mean-only reporting hid tail
+behavior; serving SLOs are percentile SLOs.
+
+Phase separation without a profiler: a generation of n tokens costs
+``prefill + n * decode_step``; timing a short and a long generation per
+rep gives one sample of each phase per rep by differencing.  Results are
+recorded in docs/BENCH_AB.md.
 """
 
 from __future__ import annotations
@@ -21,11 +31,18 @@ import sys
 import time
 
 
-def bench_decode(jax, jnp, cfg, params, B, ctx, steps=64, reps=3,
+def bench_decode(jax, jnp, cfg, params, B, ctx, steps=64, reps=5,
                  kv_quant=False):
-    """Decode tokens/sec through the REAL serving path — ``generate()``'s
-    single-jit scan (static cache, no host round trips).  Prefill cost is
-    cancelled by differencing two generation lengths; best of ``reps``."""
+    """Decode phase latencies through the REAL serving path — ``generate()``'s
+    single-jit scan (static cache, no host round trips).
+
+    Returns ``(tok_s_best, prefill_s_samples, decode_step_s_samples)``:
+    best-of-reps decode throughput (tokens/sec, 0.0 when every rep fell
+    inside timing noise) plus per-rep latency samples for the two phases —
+    ``decode_step`` from differencing two generation lengths (prefill
+    cancels), ``prefill`` by subtracting the short run's decode share from
+    its total.  Negative/degenerate samples are dropped rather than
+    reported (tiny smoke shapes time below clock noise)."""
     from ..models import generate
 
     prompt = jnp.ones((B, ctx), jnp.int32)
@@ -45,16 +62,46 @@ def bench_decode(jax, jnp, cfg, params, B, ctx, steps=64, reps=3,
         fns[n] = f
 
     best = 0.0
+    prefill_samples, decode_samples = [], []
     for _ in range(reps):
         t0 = time.perf_counter()
         sync(fns[short](params, prompt))
         t1 = time.perf_counter()
         sync(fns[long_](params, prompt))
         t2 = time.perf_counter()
-        dt = (t2 - t1) - (t1 - t0)  # decode-only: prefill cancels
+        t_short, t_long = t1 - t0, t2 - t1
+        dt = t_long - t_short  # decode-only: prefill cancels
         if dt > 0:
             best = max(best, B * (long_ - short) / dt)
-    return best
+            per_tok = dt / (long_ - short)
+            decode_samples.append(per_tok)
+            pre = t_short - short * per_tok
+            if pre > 0:
+                prefill_samples.append(pre)
+    return best, prefill_samples, decode_samples
+
+
+def _phase_lines(B, ctx, variant, prefill_s, decode_s):
+    """obs-schema ``decode-latency`` records (ms percentiles per phase)."""
+    from ..obs import percentiles
+
+    out = []
+    for phase, samples in (("prefill", prefill_s), ("decode_step", decode_s)):
+        if not samples:
+            continue
+        pct = {k: round(v * 1e3, 4)
+               for k, v in percentiles(samples).items()}
+        out.append({
+            "metric": "decode-latency",
+            "phase": phase,
+            "unit": "ms",
+            "B": B,
+            "ctx": ctx,
+            "variant": variant,
+            "n_samples": len(samples),
+            **{f"{k}_ms": v for k, v in pct.items()},
+        })
+    return out
 
 
 def main():
@@ -67,6 +114,8 @@ def main():
     import jax.numpy as jnp
 
     from ..models import GPTConfig, init_gpt_params
+    from ..obs import Telemetry
+    from ..utils.logging import master_print
     from .surgery import quantize_decode_params
 
     smoke = bool(os.environ.get("TDP_CPU_SIM")) or "--smoke" in sys.argv
@@ -75,50 +124,72 @@ def main():
         cfg = GPTConfig(vocab_size=256, dim=128, nheads=4, nlayers=2,
                         max_seq=512, ffn_mult=4, dtype=dt)
         cells = [(1, 32)]
-        steps = 4
+        steps, reps = 4, 3
     else:
         # the bench.py --big config (d2048/L16 ≈ 0.94B params)
         cfg = GPTConfig(vocab_size=32000, dim=2048, nheads=16, nlayers=16,
                         max_seq=4096, ffn_mult=4, dtype=dt)
         cells = [(1, 128), (1, 1024), (8, 128), (8, 1024)]
-        steps = 64
+        steps, reps = 64, 5
+
+    # the bench is its own telemetry session: latency cells land in the
+    # counters of an end-of-run RUNREPORT (TDP_RUNREPORT env) like any
+    # integrated example
+    tel = Telemetry(run="decode_bench", poll_memory=False)
 
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
     params = jax.device_put(jax.tree.map(lambda x: x.astype(dt), params))
     qp = jax.device_put(quantize_decode_params(params))
     nb = sum(x.nbytes for x in jax.tree.leaves(params))
     nq = sum(x.nbytes for x in jax.tree.leaves(qp))
-    print(f"param bytes: bf16={nb / 1e9:.2f} GB, int8 tree={nq / 1e9:.2f} GB",
-          file=sys.stderr)
+    master_print(
+        f"param bytes: bf16={nb / 1e9:.2f} GB, int8 tree={nq / 1e9:.2f} GB",
+        file=sys.stderr)
 
+    latency_cells = []
     for B, ctx in cells:
-        r_bf = bench_decode(jax, jnp, cfg, params, B, ctx, steps)
-        r_q = bench_decode(jax, jnp, cfg, qp, B, ctx, steps)
-        r_qkv = bench_decode(jax, jnp, cfg, qp, B, ctx, steps, kv_quant=True)
+        r_bf, pre_bf, dec_bf = bench_decode(jax, jnp, cfg, params, B, ctx,
+                                            steps, reps)
+        r_q, pre_q, dec_q = bench_decode(jax, jnp, cfg, qp, B, ctx,
+                                         steps, reps)
+        r_qkv, pre_qkv, dec_qkv = bench_decode(jax, jnp, cfg, qp, B, ctx,
+                                               steps, reps, kv_quant=True)
+        for variant, pre, dec in (
+            ("bf16", pre_bf, dec_bf),
+            ("int8w", pre_q, dec_q),
+            ("int8w+int8kv", pre_qkv, dec_qkv),
+        ):
+            for line in _phase_lines(B, ctx, variant, pre, dec):
+                latency_cells.append(line)
+                master_print(json.dumps(line), flush=True)
         if r_bf > 0 and r_qkv > 0:
-            print(json.dumps({
+            master_print(json.dumps({
                 "B": B, "ctx": ctx, "int8w+int8kv_tok_s": round(r_qkv, 1),
                 "speedup_vs_bf16": round(r_qkv / r_bf, 3),
             }), flush=True)
         else:
-            print(json.dumps({"B": B, "ctx": ctx, "kv_quant": True,
-                              "degenerate": True,
-                              "int8w+int8kv_tok_s": round(r_qkv, 1)}),
-                  flush=True)
+            master_print(json.dumps({"B": B, "ctx": ctx, "kv_quant": True,
+                                     "degenerate": True,
+                                     "int8w+int8kv_tok_s": round(r_qkv, 1)}),
+                         flush=True)
         if r_bf <= 0 or r_q <= 0:
             # every rep's length-difference fell inside timing noise (tiny
             # smoke shapes): report the degenerate cell instead of a
             # fictitious rate / ZeroDivisionError
-            print(json.dumps({"B": B, "ctx": ctx, "degenerate": True,
-                              "bf16_tok_s": round(r_bf, 1),
-                              "int8_tok_s": round(r_q, 1)}), flush=True)
+            master_print(json.dumps({"B": B, "ctx": ctx, "degenerate": True,
+                                     "bf16_tok_s": round(r_bf, 1),
+                                     "int8_tok_s": round(r_q, 1)}),
+                         flush=True)
             continue
-        print(json.dumps({
+        master_print(json.dumps({
             "B": B, "ctx": ctx,
             "bf16_tok_s": round(r_bf, 1),
             "int8_tok_s": round(r_q, 1),
             "speedup": round(r_q / r_bf, 3),
         }), flush=True)
+
+    tel.record_counters(decode_latency=latency_cells)
+    tel.finalize(print_summary=False)
 
 
 if __name__ == "__main__":

@@ -141,7 +141,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         batch=args.batch, heads=args.heads, seq=args.seq,
         head_dim=args.head_dim, causal=not args.no_causal, steps=args.steps,
     )
-    print(json.dumps({
+    from ..utils.logging import master_print
+
+    master_print(json.dumps({
         "backend": jax.default_backend(),
         "chip": jax.devices()[0].device_kind,
         "shape": [args.batch, args.heads, args.seq, args.head_dim],

@@ -211,6 +211,8 @@ def get_model_profile(
     tree report (:func:`report_tree`), flat names the single table."""
     profiles, _ = profile_blocks(blocks, x, warmup=warmup, iters=iters)
     if print_report:
+        from ..utils.logging import master_print
+
         tree = any("/" in p.name for p in profiles)
-        print(report_tree(profiles) if tree else report_prof(profiles))
+        master_print(report_tree(profiles) if tree else report_prof(profiles))
     return profiles

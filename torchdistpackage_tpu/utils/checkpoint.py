@@ -177,6 +177,11 @@ class CheckpointManager:
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
         if wait:
             self._mgr.wait_until_finished()
+        if saved:
+            from ..obs.events import emit_event
+
+            emit_event("checkpoint_save", step=int(step), wait=bool(wait),
+                       directory=str(self.directory))
         return saved
 
     def restore(
@@ -213,7 +218,13 @@ class CheckpointManager:
                 )
 
             template = jax.tree.map(abstract, template, specs)
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+        out = self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+        from ..obs.events import emit_event
+
+        emit_event("checkpoint_restore", step=int(step),
+                   directory=str(self.directory),
+                   resharded=mesh is not None)
+        return out
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

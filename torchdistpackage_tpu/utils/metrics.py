@@ -21,16 +21,22 @@ Design notes (TPU-specific):
 - Writing/printing happens on the master process only
   (``jax.process_index() == 0``) — shard-identical metrics need no
   cross-host reduction.
+
+Since the ``obs`` subsystem landed, this class is a thin back-compat shim:
+the JSONL writing goes through ``obs.exporters.JsonlSink`` (ONE code path
+for JSONL in the package) and new code should prefer ``obs.Telemetry``,
+which additionally records per-step spans, recompiles, XLA-ground-truth
+MFU, memory peaks, and the end-of-run ``RUNREPORT.json``.  The public API
+and record shape here are unchanged.
 """
 
 from __future__ import annotations
 
 import collections
-import json
 import time
 from typing import Any, Dict, Optional
 
-from .logging import is_master
+from .logging import is_master, master_print
 
 
 class MetricsLogger:
@@ -66,6 +72,12 @@ class MetricsLogger:
         self._n_intervals = 0
         self._tok_s_sum = 0.0
         self._is_master = is_master()
+        self._sink = None
+        if path is not None and self._is_master:
+            # the obs layer owns JSONL writing (one code path package-wide)
+            from ..obs.exporters import JsonlSink
+
+            self._sink = JsonlSink(path)
 
     def log(self, step: int, **scalars: Any) -> Dict[str, Any]:
         """Record one step.  Returns the full record (all processes); side
@@ -94,14 +106,13 @@ class MetricsLogger:
         self.history.append(rec)
         self._n_logged += 1
         if self._is_master:
-            if self.path is not None:
-                with open(self.path, "a") as f:
-                    f.write(json.dumps(rec) + "\n")
+            if self._sink is not None:
+                self._sink.write(rec)
             if self.print_every and self._n_logged % self.print_every == 0:
                 parts = [f"step {rec['step']}"]
                 for k, v in rec.items():
                     if k == "step" or k.endswith("_ema"):
                         continue
                     parts.append(f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}")
-                print("  ".join(parts))
+                master_print("  ".join(parts))
         return rec

@@ -51,6 +51,15 @@ class GracefulShutdown:
             signal.signal(signum, signal.SIG_DFL)
             signal.raise_signal(signum)
         self.requested = True
+        try:
+            # structured timeline entry instead of a print that evaporates:
+            # the final RUNREPORT shows when the grace window opened
+            from ..obs.events import emit_event
+
+            emit_event("preemption", signum=int(signum),
+                       signal=signal.Signals(signum).name)
+        except Exception:
+            pass  # a telemetry failure must never break the grace window
 
     def __enter__(self) -> "GracefulShutdown":
         for s in self._signals:

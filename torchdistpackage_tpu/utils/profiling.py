@@ -89,4 +89,6 @@ class TimedScope:
         self._annot.__exit__(*exc)
         self._scope.__exit__(*exc)
         if self.verbose:
-            print(f"[{self.name}] {self.elapsed * 1e3:.3f} ms")
+            from .logging import master_print
+
+            master_print(f"[{self.name}] {self.elapsed * 1e3:.3f} ms")
