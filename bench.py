@@ -153,6 +153,14 @@ def _only_index(argv):
     return None
 
 
+def _flag_value(argv, flag):
+    """Value of ``--flag path`` style args (None when absent)."""
+    for i, a in enumerate(argv):
+        if a == flag and i + 1 < len(argv):
+            return argv[i + 1]
+    return None
+
+
 def _measure() -> None:
     import jax
 
@@ -164,7 +172,7 @@ def _measure() -> None:
 
     main(jax, jnp, ab="--ab" in sys.argv, only=_only_index(sys.argv),
          big="--big" in sys.argv, long="--long" in sys.argv,
-         moe="--moe" in sys.argv)
+         moe="--moe" in sys.argv, trace=_flag_value(sys.argv, "--trace"))
 
 
 def _load_baselines(path: str) -> dict:
@@ -254,9 +262,16 @@ def _last_good_accel_line(baselines: dict, reason: str = "unreachable"):
     }
 
 
-def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None):
+def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None,
+                trace=None):
     """One timed measurement; returns (tokens_per_sec_chip, global_batch,
-    flops_per_token, xla_flops_per_token).
+    flops_per_token, xla_flops_per_token, comm_ledger).
+
+    ``comm_ledger`` is the HLO collective ledger of the compiled step
+    (``obs.comm_ledger``) — None when AOT compilation was unavailable.
+    ``trace``: path — after the timed loop, re-run a few steps under
+    ``obs.Telemetry`` and export the Perfetto host trace there (costs one
+    extra AOT compile; opt-in).
 
     ``xla_flops_per_token`` comes from XLA ``cost_analysis`` of the
     *compiled* step (obs.compiled_cost — compiler ground truth, per
@@ -344,9 +359,10 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
     # timed is captured (no second trace/compile: the compiled executable
     # is what the loop runs).  Per-device FLOPs -> per-token via the
     # per-chip token count.
-    from torchdistpackage_tpu.obs import compiled_cost
+    from torchdistpackage_tpu.obs import compiled_cost, ledger_from_compiled
 
     xla_flops_per_token = None
+    ledger = None
     run_step = step
     try:
         compiled = step.lower(params, state, batch).compile()
@@ -354,6 +370,9 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
         if cost.get("flops"):
             xla_flops_per_token = cost["flops"] / (
                 global_batch * cfg.max_seq / n_chips)
+        # the same no-second-compile hook feeds the comm ledger: which
+        # collectives the step runs, over which axes, moving which bytes
+        ledger = ledger_from_compiled(compiled, mesh=mesh)
         run_step = compiled
     except Exception as e:
         print(f"bench: AOT compile/cost-analysis unavailable ({e!r}); "
@@ -374,12 +393,32 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
     float(loss)
     dt = time.perf_counter() - t0
 
+    if trace:
+        # opt-in Perfetto host trace of the SAME step: a short
+        # Telemetry-wrapped run after the timed loop (separate so the
+        # wrapper's bookkeeping can't pollute the measurement)
+        try:
+            from torchdistpackage_tpu.obs import Telemetry, export_trace
+
+            tel = Telemetry(run="bench", tokens_per_step=global_batch * cfg.max_seq,
+                            report_path="", trace_path="", mesh=mesh,
+                            poll_memory=False)
+            tstep = tel.wrap_step(step)
+            for i in range(3):
+                params, state, loss = tstep(params, state, batch)
+                tel.end_step(step=i, loss=loss)
+            tel.finalize(write=False, print_summary=False)
+            export_trace(tel, trace)
+            print(f"bench: wrote Perfetto trace to {trace}", file=sys.stderr)
+        except Exception as e:
+            print(f"bench: trace export failed ({e!r})", file=sys.stderr)
+
     return (global_batch * cfg.max_seq * steps / dt / n_chips, global_batch,
-            flops_per_token, xla_flops_per_token)
+            flops_per_token, xla_flops_per_token, ledger)
 
 
 def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
-         long: bool = False, moe: bool = False) -> None:
+         long: bool = False, moe: bool = False, trace=None) -> None:
     from torchdistpackage_tpu.models import GPTConfig
 
     # Backend probe with CPU fallback: an accelerator backend that errors at
@@ -462,9 +501,9 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
         run_cfg = (
             dataclasses.replace(cfg, moe_dispatch=dispatch) if dispatch else cfg
         )
-        tps, global_batch, fpt, fpt_xla = _run_config(
+        tps, global_batch, fpt, fpt_xla, ledger = _run_config(
             jax, jnp, run_cfg, batch_size, steps, warmup, remat,
-            xent_chunk=xent_chunk)
+            xent_chunk=xent_chunk, trace=trace)
         # remat: False | True | 'flash' | 'flash_offload' (save the flash
         # kernel's residuals — in HBM or pinned_host — so the backward skips
         # the Pallas fwd re-run; scan_blocks docstring)
@@ -494,6 +533,16 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
             line["mfu"] = round(tps * fpt / peak, 4)
             if fpt_xla:
                 line["mfu_xla"] = round(tps * fpt_xla / peak, 4)
+        if ledger is not None:
+            # comm-ledger summary next to MFU: the per-dimension collective
+            # bytes of the exact compiled step the numbers above timed
+            # (stderr — stdout stays one JSON line per config)
+            from torchdistpackage_tpu.obs.comm_ledger import render_table
+
+            print(render_table(ledger), file=sys.stderr)
+            if ledger.get("per_dim"):
+                line["comm_bytes_per_dim"] = {
+                    d: v["bytes"] for d, v in ledger["per_dim"].items()}
         if fpt_xla:
             # the peak cancels in the ratio, so the cross-check works on
             # CPU too; |rel| > 15% is printed loudly, never hidden (remat
@@ -720,6 +769,10 @@ if __name__ == "__main__":
     # conflicting `--long --moe` to the same sweep.
     long_flag = (("--moe",) if "--moe" in sys.argv
                  else ("--long",) if "--long" in sys.argv else ())
+    _trace_path = _flag_value(sys.argv, "--trace")
+    if _trace_path:
+        # forward the Perfetto-trace request to the measurement children
+        long_flag = (*long_flag, "--trace", _trace_path)
     if on_cpu:
         ok = _run_child({}, cpu_timeout, long_flag)
     else:

@@ -30,6 +30,8 @@ if os.environ.get("TDP_CPU_SIM"):
 
 import jax
 
+from torchdistpackage_tpu.compat import axis_size
+
 if os.environ.get("TDP_CPU_SIM"):
     jax.config.update("jax_platforms", "cpu")
 
@@ -96,7 +98,7 @@ def first_fn(params, mb):
 def stage_fn(params, h):
     """First half of the stages advances the vision channel, second half the
     text channel — per-stage heterogeneity via a stage_index branch."""
-    pp = jax.lax.axis_size("pipe")
+    pp = axis_size("pipe")
 
     def run(channel, h):
         x = h[:, channel]

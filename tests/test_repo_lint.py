@@ -1,10 +1,18 @@
-"""Repo lint: no bare ``print(`` in the package.
+"""Repo lint: no bare ``print(`` and no ``time.time()`` in the package.
 
 Observability goes through ``utils.logging.master_print`` (rank-gated) or
 an obs sink — a bare print on a 256-host pod is 256 interleaved copies of
 the same line, and structured consumers can't parse stdout noise.  The
 check is AST-based (docstrings and comments that MENTION print don't trip
 it) with an explicit allowlist for the few intentional sites.
+
+``time.time()`` is banned in favor of ``time.perf_counter()``: every
+duration in the repo (spans, comm timings, benches) must come from the
+monotonic high-resolution clock — wall time is subject to NTP steps, so an
+interval measured with ``time.time()`` can silently be wrong by
+milliseconds (or negative).  Code that genuinely needs a wall-clock stamp
+(event records) uses ``datetime.now().timestamp()``, which reads as intent
+instead of a timing bug waiting to happen.
 """
 
 import ast
@@ -54,3 +62,31 @@ def test_allowlist_entries_exist():
     # a stale allowlist silently widens the lint's blind spot
     for rel in ALLOWLIST:
         assert (PKG / rel).exists(), f"allowlisted file gone: {rel}"
+
+
+def _time_time_calls(path: pathlib.Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    hits = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time"
+        ):
+            hits.append(node.lineno)
+    return hits
+
+
+def test_no_time_time_in_package():
+    offenders = {}
+    for path in sorted(PKG.rglob("*.py")):
+        lines = _time_time_calls(path)
+        if lines:
+            offenders[str(path.relative_to(PKG))] = lines
+    assert not offenders, (
+        "time.time() calls in torchdistpackage_tpu/ — intervals must use "
+        "time.perf_counter() (NTP-step-proof); wall-clock stamps use "
+        f"datetime.now().timestamp(): {offenders}"
+    )

@@ -1,0 +1,96 @@
+"""JAX version compatibility — one import site for APIs that moved.
+
+The package targets modern JAX (``jax.shard_map``, varying-manual-axes
+``jax.lax.pvary`` / ``jax.typeof``), but must still *collect and run* on
+jax 0.4.x where those names live elsewhere or don't exist (CHANGES.md:
+the 0.4.37 container could not even import ``dist.comm_bench``).  Every
+module in the package — and the test suite — imports these symbols from
+here instead of probing ``jax`` directly:
+
+- :func:`shard_map` — ``jax.shard_map`` when present, else
+  ``jax.experimental.shard_map.shard_map``.  On the legacy path
+  ``check_rep`` defaults to **False**: the package's code is written for
+  the varying-manual-axes world where params are explicitly ``pvary``-ed
+  and gradients explicitly reduced — under legacy ``check_rep=True`` the
+  transpose rule would insert a SECOND psum for replicated inputs and
+  silently scale gradients by the axis size.
+- :func:`pvary` — ``jax.lax.pvary`` when present, identity otherwise
+  (legacy shard_map has no varying-ness tracking to update, so the
+  marker is a no-op there — the explicit-reduction calling convention
+  stays correct either way).
+- :func:`typeof` — ``jax.typeof`` when present, else the abstract value
+  via ``jax.core.get_aval`` (which simply lacks a ``vma`` attribute, so
+  varying-set queries degrade to "varying over nothing").
+
+Keep this module dependency-free (stdlib + jax only): it is imported by
+``dist``, ``parallel``, ``obs`` and the tests, and must never cycle.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "pvary", "typeof", "axis_size", "HAS_VMA"]
+
+# ---------------------------------------------------------------- shard_map
+
+if hasattr(jax, "shard_map"):  # jax >= 0.6-era public API
+    shard_map = jax.shard_map
+    HAS_VMA = True
+else:  # jax 0.4.x / 0.5.x
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    HAS_VMA = False
+
+    def shard_map(f=None, /, *, mesh, in_specs, out_specs, **kwargs):
+        """Legacy-jax adapter for ``jax.shard_map``.
+
+        Accepts (and drops) ``check_vma``; defaults ``check_rep`` to False
+        — see the module docstring for why True would corrupt gradients
+        under this package's explicit-reduction convention.
+        """
+        kwargs.pop("check_vma", None)
+        kwargs.setdefault("check_rep", False)
+        if f is None:  # partial-application form: shard_map(mesh=..., ...)(f)
+            return lambda g: _legacy_shard_map(
+                g, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+            )
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+
+
+# ------------------------------------------------------------ pvary / typeof
+
+if hasattr(jax.lax, "pvary"):
+    pvary = jax.lax.pvary
+else:
+
+    def pvary(x, axis_name):
+        """No-op on legacy jax: without varying-manual-axes tracking there
+        is nothing to mark; explicit psum/pmean reductions still apply."""
+        return x
+
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+
+    def axis_size(axis_name):
+        """Static size of a named mesh axis inside shard_map.  On legacy
+        jax ``psum`` of a Python literal folds to the static group size —
+        the historical idiom ``jax.lax.axis_size`` replaced.  Works for
+        tuples of names too (product), matching the modern API."""
+        return jax.lax.psum(1, axis_name)
+
+
+if hasattr(jax, "typeof"):
+    typeof = jax.typeof
+else:
+
+    def typeof(x):
+        """Abstract value of ``x`` — close enough to ``jax.typeof`` for the
+        package's uses (shape/dtype/``vma`` probing via getattr)."""
+        from jax import core
+
+        return core.get_aval(x)

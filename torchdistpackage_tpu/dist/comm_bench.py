@@ -16,19 +16,27 @@ same convention:
 
 algbw = bytes / time, where bytes is the *full* (global) payload size, as in
 nccl-tests.
+
+Results are **obs-schema comm records** (``obs.comm_ledger.comm_record``:
+op / axis / bytes / time_s / algbw_GBps / busbw_GBps) — the same shape the
+HLO ledger aggregates and the alpha-beta model calibrates against
+(``obs.comm_model.CommModel.calibrate``), so measurement, calibration, and
+reporting round-trip through one schema.  ``test_collection`` can stream
+them to any obs sink (``JsonlSink`` et al.) instead of ad-hoc dicts.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ..compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..obs.comm_ledger import comm_record
 from .topology import tpc
 
 _BUSBW_FACTOR = {
@@ -64,8 +72,8 @@ def bench_collective(
     """Time one collective over ``axis`` and return timing + bandwidth stats.
 
     ``nbytes`` is the global payload size (like the reference's tensor size,
-    py_comm_test.py:22-30).  Returns ``{size_bytes, time_s, algbw_GBps,
-    busbw_GBps}``.
+    py_comm_test.py:22-30).  Returns an obs-schema comm record
+    (``{op, axis, axis_size, bytes, time_s, algbw_GBps, busbw_GBps}``).
     """
     if mesh is None:
         mesh = tpc.get_view()
@@ -104,15 +112,15 @@ def bench_collective(
     t = _timeit(fn, x, warmup=warmup, iters=iters)
     size = x.size * elem
     algbw = size / t / 1e9
-    return {
-        "op": op,
-        "axis": axis,
-        "axis_size": n,
-        "size_bytes": size,
-        "time_s": t,
-        "algbw_GBps": algbw,
-        "busbw_GBps": algbw * _BUSBW_FACTOR[op](n),
-    }
+    return comm_record(
+        op=op,
+        axis=axis,
+        nbytes=size,
+        axis_size=n,
+        time_s=t,
+        algbw_GBps=algbw,
+        busbw_GBps=algbw * _BUSBW_FACTOR[op](n),
+    )
 
 
 def test_collection(
@@ -121,20 +129,40 @@ def test_collection(
     ops: Sequence[str] = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all", "ppermute"),
     mesh: Optional[Mesh] = None,
     verbose: bool = True,
+    sink: Optional[Any] = None,
 ) -> List[Dict[str, float]]:
     """Sweep collectives x sizes over an axis — analogue of
-    ``test_collection`` (py_comm_test.py:20-57)."""
+    ``test_collection`` (py_comm_test.py:20-57).
+
+    ``sink``: an obs sink (anything with ``write(record)``) or a path
+    string — each comm record is streamed there as JSONL on the master
+    process, the package's one structured-output path (no ad-hoc dicts).
+    """
+    if isinstance(sink, str):
+        from ..obs.exporters import JsonlSink
+
+        sink = JsonlSink(sink)
     rows = []
+    is_master = True
+    try:
+        is_master = jax.process_index() == 0
+    except Exception:
+        pass
     for op in ops:
         for nbytes in sizes:
             row = bench_collective(op, axis, nbytes=nbytes, mesh=mesh)
             rows.append(row)
+            if sink is not None and is_master:
+                try:
+                    sink.write(row)
+                except Exception:
+                    pass
             if verbose:
                 from ..utils.logging import master_print
 
                 master_print(
                     f"{op:>14} axis={axis}({row['axis_size']}) "
-                    f"{row['size_bytes']/2**20:8.1f} MiB  "
+                    f"{row['bytes']/2**20:8.1f} MiB  "
                     f"{row['time_s']*1e3:8.3f} ms  "
                     f"alg {row['algbw_GBps']:7.2f} GB/s  "
                     f"bus {row['busbw_GBps']:7.2f} GB/s"

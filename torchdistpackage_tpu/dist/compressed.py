@@ -40,6 +40,8 @@ from __future__ import annotations
 from typing import Tuple
 
 import jax
+
+from ..compat import axis_size
 import jax.numpy as jnp
 
 
@@ -50,11 +52,13 @@ def _mark_varying(x, axis: str):
     """Mark ``x`` varying over ``axis`` if it isn't already (idempotent —
     same contract as parallel.data_parallel._mark_varying, duplicated here
     to keep dist/ import-independent of parallel/)."""
-    if axis in getattr(jax.typeof(x), "vma", frozenset()):
+    from ..compat import pvary, typeof
+
+    if axis in getattr(typeof(x), "vma", frozenset()):
         return x
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, (axis,), to="varying")
-    return jax.lax.pvary(x, (axis,))
+    return pvary(x, (axis,))
 
 
 def _group_size(n: int) -> int:
@@ -107,7 +111,7 @@ def int8_ring_reduce_scatter(
     the pmean ring), so after n-1 accumulate-requantize hops the finished
     chunk at rank r is exactly chunk r — psum_scatter's tiling contract.
     The accumulator stays f32; only the per-hop payload is quantized."""
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     if g.shape[scatter_dim] % n != 0:
         raise ValueError(
             f"scatter dim {scatter_dim} of size {g.shape[scatter_dim]} must "
@@ -155,7 +159,7 @@ def int8_ring_pmean(g: jnp.ndarray, axis: str) -> jnp.ndarray:
     call inside shard_map).  Falls back to exact ``pmean`` when the leading
     dim doesn't divide by the axis size (ragged chunks) or the axis has a
     single member."""
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     if n == 1:
         # still a pmean: the caller is promised an invariance-TYPED result
         # (a bare return would stay varying-marked and fail check_vma at

@@ -59,6 +59,8 @@ import math
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
+
+from ..compat import axis_size
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -453,7 +455,7 @@ class ParallelContext:
         return jax.lax.axis_index(mode) == 0
 
     def is_last_in_group(self, mode: AxisName):
-        return jax.lax.axis_index(mode) == jax.lax.axis_size(mode) - 1
+        return jax.lax.axis_index(mode) == axis_size(mode) - 1
 
     def is_first_in_pipeline_group(self):
         return self.is_first_in_group(PIPE_AXIS)
@@ -535,7 +537,7 @@ def test_comm(mesh: Optional[Mesh] = None) -> Dict[str, bool]:
     touch non-addressable shards; a replicated scalar is always local —
     executed cross-process in ``tests/test_multiprocess.py``).
     """
-    from jax import shard_map
+    from ..compat import shard_map
     import jax.numpy as jnp
 
     if mesh is None:

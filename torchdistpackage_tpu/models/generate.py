@@ -36,6 +36,8 @@ import math
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+
+from ..compat import axis_size
 import jax.numpy as jnp
 
 from ..parallel.tensor_parallel.layers import (
@@ -58,7 +60,7 @@ def init_kv_cache(
 ) -> Dict[str, Any]:
     """Zeroed cache ``{'k','v': [L, B, Hkv_local, max_len, hd]}`` in
     ``cfg.dtype``.  ``axis_size`` divides the KV heads for TP (call inside
-    shard_map with ``jax.lax.axis_size(axis)``, or build the global
+    shard_map with ``axis_size(axis)``, or build the global
     [L, B, Hkv, ...] array outside and shard dim 2 over the tensor axis).
 
     ``quantized=True``: int8 KV storage — each 'k'/'v' entry becomes a
@@ -339,7 +341,7 @@ def _full_logits(logits: jnp.ndarray, cfg: GPTConfig, axis: Optional[str]):
     tiny at one position per sequence).  Identity when serial."""
     if axis is None:
         return logits
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     i = jax.lax.axis_index(axis)
     full = jnp.zeros((logits.shape[0], cfg.vocab_size), logits.dtype)
     full = jax.lax.dynamic_update_slice(full, logits, (0, i * logits.shape[1]))
@@ -454,8 +456,8 @@ def generate(
             f"P + max_new_tokens = {total} exceeds the learned position "
             f"table ({cfg.max_seq})"
         )
-    axis_size = 1 if axis is None else jax.lax.axis_size(axis)
-    cache = init_kv_cache(cfg, B, total, axis_size=axis_size,
+    n_shards = 1 if axis is None else axis_size(axis)
+    cache = init_kv_cache(cfg, B, total, axis_size=n_shards,
                           quantized=kv_quant)
 
     cache, logits = fwd(params, prompt, cfg, cache, 0, axis)

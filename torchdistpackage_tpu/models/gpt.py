@@ -28,6 +28,8 @@ import math
 from typing import Any, Dict, Optional
 
 import jax
+
+from ..compat import axis_size
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -283,7 +285,7 @@ def gpt_embed(
     if cp_layout == "zigzag":
         from ..ops.ring_attention import zigzag_positions
 
-        n = jax.lax.axis_size(context_axis)
+        n = axis_size(context_axis)
         pos, _ = zigzag_positions(jax.lax.axis_index(context_axis), S, n)
         return h + jnp.take(params["pos_emb"], pos, axis=0)
     off = jax.lax.axis_index(context_axis) * S

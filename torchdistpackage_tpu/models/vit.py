@@ -18,6 +18,8 @@ import math
 from typing import Any, Dict, Optional
 
 import jax
+
+from ..compat import axis_size
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -141,7 +143,7 @@ def vit_embed(
         # projection so the [B, S, D] embed activation and its matmul are
         # O(S/cp) per device (patchify itself is a free reshape); the
         # (non-causal) ring/all_to_all inside the blocks sees the rest
-        n_cp = jax.lax.axis_size(cp)
+        n_cp = axis_size(cp)
         if x.shape[1] % n_cp != 0:
             raise ValueError(
                 f"num_patches {x.shape[1]} not divisible by context-parallel "

@@ -22,6 +22,18 @@ telemetry layer every train loop, example, and bench emits through:
 - :mod:`.report` + :mod:`.exporters` — pluggable sinks (JSONL always;
   TensorBoard scalars and Prometheus textfile behind optional-import
   guards) and the end-of-run ``RUNREPORT.json`` + markdown summary.
+- :mod:`.comm_ledger` — per-step collective ledger parsed from the
+  AOT-compiled step's HLO: every all-reduce / all-gather / reduce-scatter
+  / all-to-all / collective-permute with payload bytes, mapped onto mesh
+  axes and classified per parallelism dimension (dp/tp/pp/moe).
+- :mod:`.comm_model` — alpha–beta cost model over the ledger: per-TPU-
+  generation ICI/DCN link tables, ``CommModel.calibrate(mesh)`` fitting
+  measured ``dist.comm_bench`` timings, and the RUNREPORT ``comm``
+  section (modeled vs measured comm time, comm-bound vs compute-bound
+  verdict, overlap headroom).
+- :mod:`.trace` — Perfetto-loadable Chrome-trace export of the run
+  (spans, events, ledger counters) + ``XlaStepTrace``, a programmatic
+  ``jax.profiler`` capture bracketing a chosen step window.
 
 Design constraints: ``obs`` is a LEAF subsystem — it imports nothing from
 the rest of the package at module scope (``utils.metrics`` shims over
@@ -53,6 +65,21 @@ from .report import (
     validate_runreport,
     write_runreport,
 )
+from .comm_ledger import (
+    COMM_RECORD_SCHEMA,
+    LEDGER_SCHEMA,
+    comm_record,
+    ledger_from_compiled,
+    ledger_from_hlo,
+)
+from .comm_model import CommModel, comm_report, fit_alpha_beta
+from .trace import (
+    XlaStepTrace,
+    build_trace,
+    default_trace_path,
+    export_trace,
+    validate_trace,
+)
 
 __all__ = [
     "EventLog",
@@ -77,4 +104,17 @@ __all__ = [
     "render_markdown",
     "validate_runreport",
     "write_runreport",
+    "COMM_RECORD_SCHEMA",
+    "LEDGER_SCHEMA",
+    "comm_record",
+    "ledger_from_compiled",
+    "ledger_from_hlo",
+    "CommModel",
+    "comm_report",
+    "fit_alpha_beta",
+    "XlaStepTrace",
+    "build_trace",
+    "default_trace_path",
+    "export_trace",
+    "validate_trace",
 ]

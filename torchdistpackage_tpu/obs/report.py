@@ -32,6 +32,7 @@ _REQUIRED: Dict[str, type] = {
     "memory": dict,
     "compile": dict,
     "hosts": dict,
+    "comm": dict,
     "counters": dict,
     "events": list,
 }
@@ -73,6 +74,12 @@ def validate_runreport(report: Any) -> List[str]:
     hosts = report["hosts"]
     if "n_hosts" not in hosts or "per_host" not in hosts:
         errs.append("hosts lacks n_hosts/per_host")
+    comm = report["comm"]
+    if comm:  # empty dict = no compiled step was observed; that's valid
+        if "ledger" not in comm or "verdict" not in comm:
+            errs.append("comm section lacks ledger/verdict")
+        elif comm["verdict"] not in ("comm-bound", "compute-bound", "unknown"):
+            errs.append(f"comm verdict {comm['verdict']!r} invalid")
     return errs
 
 
@@ -94,6 +101,12 @@ def render_summary_line(report: Dict[str, Any]) -> str:
     hosts = report.get("hosts", {})
     if hosts.get("straggler") is not None:
         parts.append(f"STRAGGLER=host{hosts['straggler']}")
+    comm = report.get("comm", {})
+    if comm.get("verdict") and comm.get("verdict") != "unknown":
+        frac = comm.get("comm_fraction")
+        parts.append(
+            f"{comm['verdict']}"
+            + (f"(comm {frac:.0%})" if isinstance(frac, (int, float)) else ""))
     return "  ".join(parts)
 
 
@@ -163,6 +176,40 @@ def render_markdown(report: Dict[str, Any]) -> str:
              f"({comp.get('recompiles', 0)} recompiles), "
              f"{comp.get('time_s', 0):.1f}s total")
     L.append("")
+
+    comm = report.get("comm", {})
+    if comm.get("ledger", {}).get("n_collectives"):
+        led = comm["ledger"]
+        model = comm.get("model", {})
+        L.append("## Communication")
+        L.append("")
+        L.append(
+            f"- verdict: **{comm.get('verdict', 'unknown')}** "
+            f"({comm.get('verdict_basis', '')})")
+        if "comm_fraction" in comm:
+            L.append(f"- modeled comm fraction of step: "
+                     f"**{comm['comm_fraction']:.1%}** "
+                     f"({comm['modeled_comm_s'] * 1e3:.3f} ms modeled vs "
+                     f"{comm['measured_step_s'] * 1e3:.2f} ms measured)")
+        if "modeled_compute_s" in comm:
+            L.append(f"- modeled compute: "
+                     f"{comm['modeled_compute_s'] * 1e3:.3f} ms")
+        if "overlap_headroom_s" in comm:
+            L.append(f"- overlap headroom: "
+                     f"{comm['overlap_headroom_s'] * 1e3:.3f} ms")
+        L.append(f"- model source: {model.get('source', '?')} "
+                 f"(chip {model.get('chip', '?')})")
+        L.append("")
+        L.append("| dim | collectives | bytes/step | modeled time |")
+        L.append("|---|---|---|---|")
+        per_dim_s = model.get("per_dim_s", {})
+        for dim, st in sorted(led.get("per_dim", {}).items()):
+            t = per_dim_s.get(dim)
+            L.append(
+                f"| {dim} | {st['ops']} | {st['bytes']:,} | "
+                + (f"{t * 1e3:.3f} ms |" if isinstance(t, (int, float))
+                   else "- |"))
+        L.append("")
 
     counters = report.get("counters", {})
     if counters:

@@ -151,6 +151,17 @@ class Telemetry:
     event_log: a shared :class:`EventLog`; by default a fresh one is
         created AND installed as the process default so ``GracefulShutdown``
         / ``nan_guard`` events land on this run's timeline.
+    trace_path: where :meth:`finalize` writes the Perfetto-loadable Chrome
+        trace of the run (:mod:`.trace`).  Default: the ``TDP_TRACE`` env
+        var; unset -> no trace file.
+    mesh: the mesh the step runs over — used to map the compiled step's
+        collectives onto named axes (:mod:`.comm_ledger`).  Default: the
+        ``dist.topology.tpc`` base mesh when initialized.
+    comm_ledger_enabled: parse the compiled step's HLO into the collective
+        ledger (RUNREPORT ``comm`` section).  On by default; the parse
+        happens once per run, at first compile.
+    xla_trace: a :class:`~.trace.XlaStepTrace` — programmatic
+        ``jax.profiler`` capture bracketing a window of wrapped steps.
     """
 
     def __init__(
@@ -164,6 +175,10 @@ class Telemetry:
         event_log: Optional[EventLog] = None,
         poll_memory: bool = True,
         history_max: int = 100_000,
+        trace_path: Optional[str] = None,
+        mesh: Optional[Any] = None,
+        comm_ledger_enabled: bool = True,
+        xla_trace: Optional[Any] = None,
     ) -> None:
         import jax
 
@@ -175,6 +190,15 @@ class Telemetry:
         self.report_path = (
             report_path if report_path is not None else _report.default_report_path()
         )
+        from . import trace as _trace
+
+        self.trace_path = (
+            trace_path if trace_path is not None else _trace.default_trace_path()
+        )
+        self.mesh = mesh
+        self.comm_ledger_enabled = comm_ledger_enabled
+        self.comm_ledger: Optional[Dict[str, Any]] = None
+        self.xla_trace = xla_trace
         if event_log is None:
             event_log = EventLog()
             set_default_event_log(event_log)
@@ -236,6 +260,8 @@ class Telemetry:
             now = time.perf_counter()
             if self._last_fetch_end is not None:
                 self._pending_spans["data"] = now - self._last_fetch_end
+            if self.xla_trace is not None:
+                self.xla_trace.on_step_start(self._step_n)
             entry = None
             sig = None
             if not kwargs:  # kwargs: skip AOT, plain call below
@@ -281,6 +307,16 @@ class Telemetry:
         self.compile_time_s += dt
         if first:
             self.xla_cost = dict(cost)
+            if compiled is not None and self.comm_ledger_enabled:
+                # same no-second-compile hook that captures cost_analysis:
+                # parse the compiled step's collectives into the comm ledger
+                try:
+                    from . import comm_ledger as _ledger
+
+                    self.comm_ledger = _ledger.ledger_from_compiled(
+                        compiled, mesh=self.mesh)
+                except Exception:
+                    self.comm_ledger = None
         else:
             self._recompiled = True
         self.events.emit(
@@ -329,6 +365,10 @@ class Telemetry:
             rec[f"span_{name}_s"] = dt
         step_time = sum(spans.values())
         rec["step_time_s"] = step_time
+        rec["t_end_s"] = t2  # perf_counter-domain stamp for the trace exporter
+        if self.xla_trace is not None:
+            self.xla_trace.on_step_end(
+                int(step) if step is not None else self._step_n)
         if self._recompiled:
             rec["recompiled"] = True
             self._recompiled = False
@@ -422,6 +462,23 @@ class Telemetry:
                     mfu["xla_vs_formula_rel"] = round(
                         (self.xla_cost["flops"] - formula) / formula, 4)
 
+        comm: Dict[str, Any] = {}
+        if self.comm_ledger is not None:
+            try:
+                from . import comm_model as _comm_model
+
+                comm = _comm_model.comm_report(
+                    self.comm_ledger,
+                    stats.get("mean"),
+                    xla_flops=self.xla_cost.get("flops"),
+                    peak_flops=self.peak_flops,
+                    mesh=self.mesh,
+                ) or {}
+            except Exception:
+                comm = {}
+
+        if self.xla_trace is not None:
+            self.xla_trace.close()
         self.events.emit("run_end", run=self.run, steps=self._step_n)
         report = {
             "schema": _report.RUNREPORT_SCHEMA,
@@ -446,6 +503,7 @@ class Telemetry:
                 "recompiles": max(0, self.n_compiles - 1),
             },
             "hosts": hosts,
+            "comm": comm,
             "counters": self.counters,
             "events": self.events.as_list(),
         }
@@ -459,6 +517,10 @@ class Telemetry:
                     pass
             if write and self.report_path:
                 _report.write_runreport(report, self.report_path)
+            if write and self.trace_path:
+                from . import trace as _trace
+
+                _trace.export_trace(self, self.trace_path)
             if print_summary:
                 from ..utils.logging import master_print
 

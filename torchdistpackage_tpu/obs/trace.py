@@ -1,0 +1,275 @@
+"""Chrome-trace-event export: the run's timeline as a Perfetto-loadable file.
+
+RUNREPORT summarizes a run; this renders it as something a human can
+*scrub*: every step's host spans (data / dispatch / device / fetch) as
+complete events on per-phase tracks, the :mod:`.events` timeline as
+instant events, per-step comm-ledger byte counters, all in the Chrome
+trace-event JSON format that ``chrome://tracing`` and
+https://ui.perfetto.dev load directly.
+
+Two layers of truth:
+
+- :func:`export_trace` — the HOST-side view reconstructed from Telemetry's
+  own records (zero overhead, always available, works on the CPU sim).
+  Spans are laid back-to-back from each step's recorded end timestamp —
+  exactly the quantities ``end_step`` measured.
+- :class:`XlaStepTrace` — the DEVICE-side view: a programmatic
+  ``jax.profiler`` capture scoped to a step window
+  (``trace_steps=(first, last)``), so the same steps the host trace shows
+  can be captured as a real XLA trace (TensorBoard/Perfetto) without
+  bracketing code by hand or profiling the whole run.
+
+Set ``TDP_TRACE=/path/trace.json`` and ``Telemetry.finalize`` writes the
+host trace next to the RUNREPORT — the same env-var contract the report
+itself uses (``TDP_RUNREPORT``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Span name -> Chrome tid.  tid 0 carries the event timeline.
+SPAN_TIDS = {"data": 1, "dispatch": 2, "device": 3, "fetch": 4}
+_SPAN_ORDER = ("data", "dispatch", "device", "fetch")
+
+
+def default_trace_path() -> Optional[str]:
+    """The ``TDP_TRACE`` env var; empty/unset -> None (no trace file)."""
+    return os.environ.get("TDP_TRACE") or None
+
+
+def _metadata_events(process: int, run: str) -> List[Dict[str, Any]]:
+    out = [{
+        "ph": "M", "name": "process_name", "pid": process, "tid": 0,
+        "args": {"name": f"host{process} [{run}]"},
+    }, {
+        "ph": "M", "name": "thread_name", "pid": process, "tid": 0,
+        "args": {"name": "events"},
+    }]
+    for span, tid in SPAN_TIDS.items():
+        out.append({
+            "ph": "M", "name": "thread_name", "pid": process, "tid": tid,
+            "args": {"name": f"step/{span}"},
+        })
+        out.append({
+            "ph": "M", "name": "thread_sort_index", "pid": process,
+            "tid": tid, "args": {"sort_index": tid},
+        })
+    return out
+
+
+def chrome_trace_events(
+    history: Sequence[Dict[str, Any]],
+    events: Iterable[Dict[str, Any]] = (),
+    ledger: Optional[Dict[str, Any]] = None,
+    process: int = 0,
+    run: str = "run",
+) -> List[Dict[str, Any]]:
+    """Step records + event log (+ ledger) -> Chrome trace events.
+
+    ``history`` rows are Telemetry step records; rows without the
+    ``t_end_s`` stamp (written by ``end_step``) are skipped.  Spans are
+    reconstructed back-to-back from the step-end timestamp: fetch ends at
+    ``t_end_s``, device before it, and so on — the inverse of how
+    ``end_step`` accumulated them.  All timestamps land on one
+    perf_counter-domain axis, offset so the trace starts at ts=0.
+    """
+    stamped = [r for r in history if "t_end_s" in r]
+    ev_list = list(events)
+    t0_candidates = [r["t_end_s"] - r.get("step_time_s", 0.0) for r in stamped]
+    t0_candidates += [e["t_mono"] for e in ev_list if "t_mono" in e]
+    if not t0_candidates:
+        return _metadata_events(process, run)
+    t0 = min(t0_candidates)
+
+    def us(t: float) -> float:
+        return round((t - t0) * 1e6, 3)
+
+    out = _metadata_events(process, run)
+    per_dim = (ledger or {}).get("per_dim") or {}
+    for r in stamped:
+        step = r.get("step", -1)
+        end = r["t_end_s"]
+        # walk backwards: fetch | device | dispatch | data
+        cursor = end
+        spans: List[Tuple[str, float, float]] = []
+        for name in reversed(_SPAN_ORDER):
+            dur = float(r.get(f"span_{name}_s", 0.0) or 0.0)
+            spans.append((name, cursor - dur, dur))
+            cursor -= dur
+        for name, start, dur in reversed(spans):
+            if dur <= 0:
+                continue
+            args: Dict[str, Any] = {"step": step}
+            if name == "device":
+                for k in ("loss", "tok_per_sec"):
+                    if k in r and isinstance(r[k], (int, float)):
+                        args[k] = r[k]
+                if r.get("recompiled"):
+                    args["recompiled"] = True
+                if per_dim:
+                    args["comm_bytes"] = {
+                        d: v["bytes"] for d, v in per_dim.items()}
+            out.append({
+                "ph": "X", "name": f"{name}[{step}]" if name == "device" else name,
+                "cat": "step", "pid": process, "tid": SPAN_TIDS[name],
+                "ts": us(start), "dur": round(dur * 1e6, 3), "args": args,
+            })
+        if per_dim:
+            out.append({
+                "ph": "C", "name": "comm_bytes_per_step", "pid": process,
+                "tid": 0, "ts": us(end - r.get("step_time_s", 0.0)),
+                "args": {d: v["bytes"] for d, v in per_dim.items()},
+            })
+    for e in ev_list:
+        if "t_mono" not in e:
+            continue
+        args = {k: v for k, v in e.items()
+                if k not in ("type", "kind", "t_wall", "t_mono", "process")
+                and v is not None}
+        out.append({
+            "ph": "i", "name": e.get("kind", "event"), "cat": "event",
+            "pid": process, "tid": 0, "ts": us(e["t_mono"]), "s": "t",
+            "args": args,
+        })
+    return out
+
+
+def build_trace(
+    history: Sequence[Dict[str, Any]],
+    events: Iterable[Dict[str, Any]] = (),
+    ledger: Optional[Dict[str, Any]] = None,
+    process: int = 0,
+    run: str = "run",
+) -> Dict[str, Any]:
+    """The full Chrome trace object (``{"traceEvents": [...], ...}``)."""
+    return {
+        "traceEvents": chrome_trace_events(
+            history, events=events, ledger=ledger, process=process, run=run),
+        "displayTimeUnit": "ms",
+        "otherData": {"run": run, "exporter": "torchdistpackage_tpu.obs.trace"},
+    }
+
+
+def export_trace(telemetry, path: str) -> Dict[str, Any]:
+    """Write ``telemetry``'s host trace to ``path`` (best-effort on OSError,
+    like the RUNREPORT writer) and return the trace object."""
+    trace = build_trace(
+        telemetry.history,
+        events=telemetry.events.as_list(),
+        ledger=getattr(telemetry, "comm_ledger", None),
+        process=0 if telemetry._is_master else 1,
+        run=telemetry.run,
+    )
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(trace, f)
+    except OSError:
+        pass
+    return trace
+
+
+_VALID_PH = {"X", "B", "E", "i", "I", "C", "M", "b", "e", "n", "s", "t", "f"}
+
+
+def validate_trace(obj: Any) -> List[str]:
+    """Structural validation against the Chrome trace-event JSON format
+    (the subset Perfetto/chrome://tracing require).  Returns problem
+    strings; empty list = loadable."""
+    errs: List[str] = []
+    if isinstance(obj, list):  # the bare-array variant is legal too
+        events = obj
+    elif isinstance(obj, dict):
+        events = obj.get("traceEvents")
+        if not isinstance(events, list):
+            return ["traceEvents missing or not a list"]
+    else:
+        return [f"trace is {type(obj).__name__}, expected dict or list"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errs.append(f"{where} is not an object")
+            break
+        ph = ev.get("ph")
+        if ph not in _VALID_PH:
+            errs.append(f"{where} has bad ph {ph!r}")
+        if "name" not in ev:
+            errs.append(f"{where} lacks name")
+        if ph not in ("M",):
+            if not isinstance(ev.get("ts"), (int, float)):
+                errs.append(f"{where} lacks numeric ts")
+            elif ev["ts"] < 0:
+                errs.append(f"{where} has negative ts")
+        if ph == "X" and not isinstance(ev.get("dur"), (int, float)):
+            errs.append(f"{where} complete event lacks dur")
+        if errs and len(errs) > 8:
+            break
+    return errs
+
+
+class XlaStepTrace:
+    """Programmatic ``jax.profiler`` capture scoped to a step window.
+
+    ``trace_steps=(first, last)`` captures steps ``first..last`` inclusive:
+    ``start_trace`` fires before step ``first`` is dispatched and
+    ``stop_trace`` after step ``last``'s outputs are blocked on — so the
+    XLA trace brackets exactly the steps the host trace shows.  Wire it
+    through ``Telemetry(xla_trace=...)`` or call the hooks from a raw loop:
+
+        xt = XlaStepTrace("/tmp/jax-trace", trace_steps=(3, 5))
+        for i in range(n):
+            xt.on_step_start(i)
+            out = step(...)
+            jax.block_until_ready(out)
+            xt.on_step_end(i)
+
+    Start/stop failures are swallowed after emitting an event — a broken
+    profiler must never kill the run it was observing.
+    """
+
+    def __init__(self, logdir: str, trace_steps: Tuple[int, int] = (2, 4)) -> None:
+        first, last = int(trace_steps[0]), int(trace_steps[1])
+        if last < first:
+            raise ValueError(f"trace_steps last < first: {trace_steps}")
+        self.logdir = logdir
+        self.first, self.last = first, last
+        self.active = False
+        self.done = False
+
+    def on_step_start(self, step: int) -> None:
+        if self.done or self.active or step < self.first or step > self.last:
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.logdir)
+            self.active = True
+            from .events import emit_event
+
+            emit_event("xla_trace_start", step=int(step), logdir=self.logdir)
+        except Exception:
+            self.done = True
+
+    def on_step_end(self, step: int) -> None:
+        if not self.active or step < self.last:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            from .events import emit_event
+
+            emit_event("xla_trace_stop", step=int(step), logdir=self.logdir)
+        except Exception:
+            pass
+        self.active = False
+        self.done = True
+
+    def close(self) -> None:
+        """Stop an in-flight capture (run ended inside the window)."""
+        if self.active:
+            self.on_step_end(self.last)

@@ -136,7 +136,9 @@ def mha_reference(
 def _out_struct(shape, dtype, like):
     """ShapeDtypeStruct carrying the vma of ``like`` — required for
     pallas_call under shard_map (check_vma=True)."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    from ..compat import typeof
+
+    vma = getattr(typeof(like), "vma", None)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

@@ -33,6 +33,8 @@ import math
 from typing import Optional
 
 import jax
+
+from ..compat import axis_size
 import jax.numpy as jnp
 
 from .flash_attention import NEG_INF, flash_attention_with_lse, mha_reference
@@ -183,7 +185,7 @@ def ring_attention(
     if use_flash:
         return _ring_attention_flash(q, k, v, axis, causal, sm_scale, block_q, block_k)
 
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, H, S, D = q.shape
     qpos = idx * S + jnp.arange(S)
@@ -230,7 +232,7 @@ def _ring_attention_flash(q, k, v, axis, causal, sm_scale, block_q, block_k):
     hand; hops combine exactly via logsumexp weights."""
     from ..parallel.data_parallel import _mark_varying, _vma
 
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, H, S, D = q.shape
 
@@ -286,7 +288,7 @@ def _ring_attention_zigzag_einsum(q, k, v, axis, sm_scale):
     balanced past/diagonal mix by construction)."""
     from ..parallel.data_parallel import _mark_varying, _vma
 
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, H, S, D = q.shape
     qpos, _ = zigzag_positions(idx, S, n)
@@ -320,7 +322,7 @@ def _ring_attention_zigzag_flash(q, k, v, axis, sm_scale, block_q, block_k):
     ring (the point of zigzag)."""
     from ..parallel.data_parallel import _mark_varying, _vma
 
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, H, S, D = q.shape
     if S % 2 != 0:
@@ -400,7 +402,7 @@ def ulysses_attention(
     inverse all_to_all restores [B, H, S_local, D]."""
     if axis is None:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     B, H, S, D = q.shape
 
     def scatter_heads(x):

@@ -35,8 +35,10 @@ import functools
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax
+
+from ..compat import axis_size
 import jax.numpy as jnp
-from jax import shard_map
+from ..compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..dist.topology import DATA_AXIS, tpc
@@ -87,7 +89,26 @@ def _key_str(path) -> str:
 
 def _vma(x) -> frozenset:
     """The set of mesh axes a traced value is varying over."""
-    return frozenset(getattr(jax.typeof(x), "vma", frozenset()))
+    from ..compat import typeof
+
+    return frozenset(getattr(typeof(x), "vma", frozenset()))
+
+
+def _vaxes(x, axes) -> Tuple[str, ...]:
+    """The subset of ``axes`` to treat ``x`` as varying over.
+
+    Modern jax: filtered by the value's actual vma.  Legacy jax has no
+    varying-ness tracking (``_vma`` is always empty) and its
+    ``check_rep=False`` AD never inserts implicit reductions — so a grad/
+    loss computed from data-sharded inputs IS varying over every data-like
+    axis, and skipping the reduction (what the empty-vma filter would do)
+    silently trains unsynced replicas.  Assume all requested axes there.
+    """
+    from ..compat import HAS_VMA
+
+    if not HAS_VMA:
+        return tuple(axes)
+    return tuple(a for a in axes if a in _vma(x))
 
 
 def _mark_varying(x, axes: Tuple[str, ...]):
@@ -97,7 +118,9 @@ def _mark_varying(x, axes: Tuple[str, ...]):
         return x
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)
+    from ..compat import pvary
+
+    return pvary(x, axes)
 
 
 def pvary_params(params: PyTree, axes: Tuple[str, ...]) -> PyTree:
@@ -165,8 +188,9 @@ def reduce_gradients(
                 matched = True
                 break
         # only reduce over axes the grad actually varies on (a grad can
-        # already be unvarying over an axis, e.g. after implicit psum)
-        vaxes = tuple(a for a in axes if a in _vma(g))
+        # already be unvarying over an axis, e.g. after implicit psum);
+        # legacy jax can't track that and reduces over all requested axes
+        vaxes = _vaxes(g, axes)
         if not matched:
             mean_axes = tuple(a for a in vaxes if op_of(a) == "mean")
             sum_axes = tuple(a for a in vaxes if op_of(a) == "sum")
@@ -194,7 +218,7 @@ def reduce_gradients(
         denom = 1
         for a in default_axes:
             if op_of(a) == "mean":
-                denom *= jax.lax.axis_size(a)
+                denom *= axis_size(a)
         if denom > 1:
             g = g / denom
         return g
@@ -214,6 +238,34 @@ def _axis_op(reduce_op, a: str) -> str:
     if isinstance(reduce_op, dict):
         return reduce_op.get(a, "mean")
     return reduce_op
+
+
+def _opt_state_specs(opt_state, params, param_specs, spec_of):
+    """PartitionSpec tree for an optimizer state: any subtree whose pytree
+    structure mirrors the params (adam's mu/nu, sgd momentum, ...) gets the
+    param specs; every other leaf (step counters, scalars) falls back to its
+    observed placement.  Matching structurally rather than by placement
+    keeps sharded-TP steps correct even when the moments were materialized
+    replicated (legacy-jax eager ``opt.init``)."""
+    pdef = jax.tree_util.tree_structure(params)
+    multi = pdef.num_leaves > 1  # a 1-leaf params tree would match any leaf
+
+    def build(node):
+        if multi:
+            try:
+                if jax.tree_util.tree_structure(node) == pdef:
+                    return param_specs
+            except Exception:
+                pass
+        if isinstance(node, dict):
+            return {k: build(v) for k, v in node.items()}
+        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
+            return type(node)(*(build(c) for c in node))
+        if isinstance(node, (list, tuple)):
+            return type(node)(build(c) for c in node)
+        return spec_of(node)
+
+    return build(opt_state)
 
 
 def _reduce_loss(loss, axes: Tuple[str, ...], reduce_op):
@@ -413,7 +465,7 @@ class DataParallel:
             )
             if other:
                 loss = jax.lax.pmean(loss, other)
-            dax = tuple(a for a in data_axes if a in _vma(loss))
+            dax = _vaxes(loss, data_axes)
             if dax:
                 loss = _reduce_loss(loss, dax, self.reduce_op)
             updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -439,9 +491,14 @@ class DataParallel:
                     batch_spec if batch_spec is not None else jax.tree.map(lambda _: P(axis), batch)
                 )
                 # optimizer state (e.g. adam moments) mirrors the params'
-                # sharding when created via opt.init(placed_params) — read the
-                # actual placement rather than guessing by structure
-                opt_specs = jax.tree.map(spec_of, opt_state)
+                # sharding when created via opt.init(placed_params); prefer
+                # the structural mapping (moment subtrees that mirror the
+                # param pytree get the PARAM specs) and fall back to actual
+                # placement — on legacy jax an eager opt.init materializes
+                # moments replicated even for sharded params, and a P()
+                # in_spec would then feed full-size moments to sharded grads
+                opt_specs = _opt_state_specs(
+                    opt_state, params, in_param_specs, spec_of)
                 sm = shard_map(
                     step,
                     mesh=mesh,

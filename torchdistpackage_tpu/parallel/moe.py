@@ -35,6 +35,8 @@ import math
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+
+from ..compat import axis_size
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -397,7 +399,7 @@ def moe_forward(
     if ep_axis is None:
         expert_out = _expert_ffn(params["experts"], expert_in)  # [E, C, D]
     else:
-        ep = jax.lax.axis_size(ep_axis)
+        ep = axis_size(ep_axis)
         if E % ep != 0:
             raise ValueError(f"num_experts {E} not divisible by EP size {ep}")
         e_loc = E // ep

@@ -34,6 +34,8 @@ from __future__ import annotations
 from typing import Any, Callable, List, Sequence
 
 import jax
+
+from ...compat import axis_size
 import jax.numpy as jnp
 
 from ...dist.topology import PIPE_AXIS
@@ -133,7 +135,7 @@ def make_heterogeneous_stage(
     branches = [_branch(s) for s in range(P_)]
 
     def stage_fn(params, bus_val, m):
-        n = jax.lax.axis_size(pipe_axis)  # static inside shard_map
+        n = axis_size(pipe_axis)  # static inside shard_map
         if n != P_:
             # without this, lax.switch CLAMPS the stage index: extra
             # stages silently re-run the last branch / missing stages never

@@ -32,6 +32,8 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 import jax
+
+from ...compat import axis_size
 import jax.numpy as jnp
 
 from ...dist.topology import PIPE_AXIS
@@ -79,7 +81,9 @@ def _zeros_like_shapes(shapes):
     from ..data_parallel import _mark_varying
 
     def z(a):
-        aval = a if isinstance(a, jax.ShapeDtypeStruct) else jax.typeof(a)
+        from ...compat import typeof
+
+        aval = a if isinstance(a, jax.ShapeDtypeStruct) else typeof(a)
         x = jnp.zeros(aval.shape, aval.dtype)
         vm = tuple(getattr(aval, "vma", ()))
         return _mark_varying(x, vm) if vm else x
@@ -118,7 +122,7 @@ def is_first_stage(pipe_axis: str = PIPE_AXIS):
 
 
 def is_last_stage(pipe_axis: str = PIPE_AXIS):
-    return jax.lax.axis_index(pipe_axis) == jax.lax.axis_size(pipe_axis) - 1
+    return jax.lax.axis_index(pipe_axis) == axis_size(pipe_axis) - 1
 
 
 def last_stage_value(x, pipe_axis: str = PIPE_AXIS):
@@ -135,7 +139,7 @@ def shift_right(x, pipe_axis: str = PIPE_AXIS, circular: bool = False):
     receives stage P-1's value — the wrap edge of the interleaved (virtual
     chunk) schedule, carrying a finished chunk's activation back to stage 0
     as the next chunk's input."""
-    n = jax.lax.axis_size(pipe_axis)
+    n = axis_size(pipe_axis)
     last_edge = [(n - 1, 0)] if circular else []
     return jax.lax.ppermute(
         x, pipe_axis, [(i, i + 1) for i in range(n - 1)] + last_edge
@@ -148,7 +152,7 @@ def shift_left(x, pipe_axis: str = PIPE_AXIS, circular: bool = False):
     send_backward/recv_backward (comm.py:362-435).  ``circular``: stage P-1
     receives stage 0's value (the wrap cotangent from chunk v+1 back to
     chunk v under the interleaved schedule)."""
-    n = jax.lax.axis_size(pipe_axis)
+    n = axis_size(pipe_axis)
     wrap_edge = [(0, n - 1)] if circular else []
     return jax.lax.ppermute(
         x, pipe_axis, [(i, i - 1) for i in range(1, n)] + wrap_edge
@@ -168,7 +172,7 @@ def _transfer_dim(shape, n: int) -> int:
 def _slice_state(x, tdims, axis: str):
     """Each ``axis`` rank keeps its 1/n slice of every leaf's transfer dim."""
     i = jax.lax.axis_index(axis)
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
 
     def one(a, d):
         if d < 0:
@@ -225,7 +229,7 @@ def _pipeline_scan(
     from ..data_parallel import pvary_params
 
     M = num_microbatches
-    P_ = jax.lax.axis_size(pipe_axis)
+    P_ = axis_size(pipe_axis)
     ticks = M + P_ - 1
     first = is_first_stage(pipe_axis)
     # prevent_cse=False: body_fn executes inside the tick lax.scan below,
@@ -493,7 +497,7 @@ def pipeline_1f1b(
 
     M = num_microbatches
     V = num_chunks
-    P_ = jax.lax.axis_size(pipe_axis)
+    P_ = axis_size(pipe_axis)
     if V < 1:
         raise ValueError(f"num_chunks must be >= 1, got {V}")
     if V > 1 and M % P_ != 0:
@@ -566,7 +570,7 @@ def pipeline_1f1b(
         # the schedule below (carry, ring buffer, ppermutes, cotangents)
         # only ever sees 1/tp-sized state and AD stays exact.
         tax = transfer_shard_axis
-        tsz = jax.lax.axis_size(tax)
+        tsz = axis_size(tax)
         full_state = jax.eval_shape(first_fn, params, mb0_in)
         tdims = jax.tree.map(lambda a: _transfer_dim(a.shape, tsz), full_state)
         _first0, _stage0, _last0 = first_fn, call_stage, last_fn
@@ -697,12 +701,17 @@ def pipeline_1f1b(
             dp = jax.tree.map(lambda a, b: a + b, dp_stage, dp_last)
         return loss_m, dp, dx
 
-    # ---- carry init (zeros with the right vma, via abstract eval)
+    # ---- carry init (zeros with the right vma, via abstract eval; legacy
+    # jax's ShapeDtypeStruct has no vma kwarg and nothing to carry anyway)
+    _zvma = _vma(zero_state)
+
+    def _stacked_struct(a):
+        if _zvma:
+            return jax.ShapeDtypeStruct((R,) + a.shape, a.dtype, vma=_zvma)
+        return jax.ShapeDtypeStruct((R,) + a.shape, a.dtype)
+
     saved0 = _zeros_like_shapes(
-        jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct((R,) + a.shape, a.dtype, vma=_vma(zero_state)),
-            jax.eval_shape(lambda z: z, zero_state),
-        )
+        jax.tree.map(_stacked_struct, jax.eval_shape(lambda z: z, zero_state))
     )
     cot0 = zero_state
     bwd_shapes = jax.eval_shape(

@@ -29,6 +29,8 @@ import math
 from typing import Any, Dict, Optional, Tuple, Union
 
 import jax
+
+from ...compat import axis_size
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -360,7 +362,7 @@ def _rope_positions(cfg: TransformerConfig, S: int) -> jnp.ndarray:
     if cfg.cp_layout == "zigzag":
         from ...ops.ring_attention import zigzag_positions
 
-        pos, _ = zigzag_positions(idx, S, jax.lax.axis_size(cfg.context_axis))
+        pos, _ = zigzag_positions(idx, S, axis_size(cfg.context_axis))
         return pos
     return idx * S + jnp.arange(S)
 
@@ -379,7 +381,7 @@ def block_rope_cache(
         return None
     s_attn = s_local
     if axis is not None and sp:
-        s_attn = s_attn * jax.lax.axis_size(axis)
+        s_attn = s_attn * axis_size(axis)
     return rope_cache(_rope_positions(cfg, s_attn), cfg.head_dim,
                       cfg.rope_theta, scaling=cfg.rope_scaling)
 

@@ -25,6 +25,8 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+
+from ...compat import axis_size
 import jax.numpy as jnp
 
 from ...dist.topology import TENSOR_AXIS
@@ -45,7 +47,7 @@ def get_tp_axis() -> str:
 
 def tp_size() -> int:
     """Axis size — traced-safe inside shard_map."""
-    return jax.lax.axis_size(_TP_AXIS)
+    return axis_size(_TP_AXIS)
 
 
 # --------------------------------------------------------------------- regions
@@ -78,7 +80,7 @@ def split_to_sp(x: jnp.ndarray, axis: Optional[str] = None, seq_dim: int = 1) ->
     all-gathers (`_split_along_first_dim`, tp_utils.py:88-108).  Used at the
     model boundary to enter SP from a replicated activation."""
     ax = axis or _TP_AXIS
-    n = jax.lax.axis_size(ax)
+    n = axis_size(ax)
     idx = jax.lax.axis_index(ax)
     if x.shape[seq_dim] % n != 0:
         raise ValueError(f"seq dim {x.shape[seq_dim]} not divisible by TP size {n}")

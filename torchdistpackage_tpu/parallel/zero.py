@@ -39,12 +39,15 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Tuple, Union
 
 import jax
+
+from ..compat import axis_size
 import jax.numpy as jnp
-from jax import shard_map
+from ..compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..dist.topology import DATA_AXIS, tpc
 from .data_parallel import (
+    _vaxes,
     _vma,
     local_value_and_grad,
     normalize_model_axis_grads,
@@ -255,11 +258,11 @@ class ZeroOptimizer:
         ride :func:`...dist.compressed.int8_ring_pmean`."""
         from .data_parallel import _key_str
 
-        n = jax.lax.axis_size(self.shard_axis)
+        n = axis_size(self.shard_axis)
         total = n
         for a in self.grad_reduce_axes:
             if a != self.shard_axis:
-                total *= jax.lax.axis_size(a)
+                total *= axis_size(a)
 
         def to_owner(path, g, d):
             g = g.astype(self.master_dtype)
@@ -278,7 +281,7 @@ class ZeroOptimizer:
                 and g.size >= self.compress_min_size
             )
             if d < 0:  # replicated leaf
-                vaxes = tuple(a for a in axes if a in _vma(g))
+                vaxes = _vaxes(g, axes)
                 if matched:
                     # override semantics: full-group mean (EP overcount)
                     return (jax.lax.psum(g, vaxes) if vaxes else g) / total
@@ -293,13 +296,13 @@ class ZeroOptimizer:
             else:
                 g = jax.lax.psum_scatter(
                     g, self.shard_axis, scatter_dimension=d, tiled=True)
-            o = tuple(a for a in other if a in _vma(g))
+            o = _vaxes(g, other)
             if o:
                 if compress:
                     for a in o:
                         # the ring pmean's mean * size == the psum, with the
                         # int8 wire (the hybrid DCN leg)
-                        g = int8_ring_pmean(g, a) * jax.lax.axis_size(a)
+                        g = int8_ring_pmean(g, a) * axis_size(a)
                 else:
                     g = jax.lax.psum(g, o)
             return g / total
@@ -386,7 +389,7 @@ class ZeroOptimizer:
 
                     if other:
                         loss = jax.lax.pmean(loss, other)
-                    dax = tuple(a for a in data_axes if a in _vma(loss))
+                    dax = _vaxes(loss, data_axes)
                     if dax:
                         loss = jax.lax.pmean(loss, dax)
                     return master, new_state, loss

@@ -21,6 +21,12 @@ Phase separation without a profiler: a generation of n tokens costs
 ``prefill + n * decode_step``; timing a short and a long generation per
 rep gives one sample of each phase per rep by differencing.  Results are
 recorded in docs/BENCH_AB.md.
+
+``--trace out.json`` additionally prints the comm-ledger summary of the
+compiled decode step (one extra AOT compile) and writes the run's
+Perfetto-loadable Chrome trace — cells appear as instant events on the
+timeline (the cell loops are not Telemetry-wrapped, so there are no
+per-step spans; the event timeline and ledger still render).
 """
 
 from __future__ import annotations
@@ -132,6 +138,12 @@ def main():
         cells = [(1, 128), (1, 1024), (8, 128), (8, 1024)]
         steps, reps = 64, 5
 
+    trace_path = None
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace")
+        if i + 1 < len(sys.argv):
+            trace_path = sys.argv[i + 1]
+
     # the bench is its own telemetry session: latency cells land in the
     # counters of an end-of-run RUNREPORT (TDP_RUNREPORT env) like any
     # integrated example
@@ -145,6 +157,29 @@ def main():
     master_print(
         f"param bytes: bf16={nb / 1e9:.2f} GB, int8 tree={nq / 1e9:.2f} GB",
         file=sys.stderr)
+
+    if trace_path:
+        # comm ledger of the compiled decode step, printed next to the
+        # latency numbers (single-chip runs legitimately show none)
+        try:
+            from ..models import generate
+            from ..obs import ledger_from_compiled
+            from ..obs.comm_ledger import render_table
+
+            B0, ctx0 = cells[0]
+            prompt0 = jnp.ones((B0, ctx0), jnp.int32)
+            dec = jax.jit(lambda p, t: generate(p, t, cfg, max_new_tokens=4))
+            led = ledger_from_compiled(dec.lower(params, prompt0).compile())
+            master_print(render_table(led), file=sys.stderr)
+            if led:
+                tel.record_counters(decode_comm_ledger={
+                    "per_dim": led["per_dim"],
+                    "total_bytes": led["total_bytes"],
+                    "n_collectives": led["n_collectives"],
+                })
+        except Exception as e:
+            master_print(f"decode_bench: ledger unavailable ({e!r})",
+                         file=sys.stderr)
 
     latency_cells = []
     for B, ctx in cells:
@@ -161,6 +196,10 @@ def main():
         ):
             for line in _phase_lines(B, ctx, variant, pre, dec):
                 latency_cells.append(line)
+                # cells land on the trace timeline as instant events
+                tel.events.emit(
+                    "decode_cell", phase=line["phase"], variant=variant,
+                    B=B, ctx=ctx, p50_ms=line.get("p50_ms"))
                 master_print(json.dumps(line), flush=True)
         if r_bf > 0 and r_qkv > 0:
             master_print(json.dumps({
@@ -190,6 +229,12 @@ def main():
 
     tel.record_counters(decode_latency=latency_cells)
     tel.finalize(print_summary=False)
+    if trace_path:
+        from ..obs import export_trace
+
+        export_trace(tel, trace_path)
+        master_print(f"decode_bench: wrote Perfetto trace to {trace_path}",
+                     file=sys.stderr)
 
 
 if __name__ == "__main__":
