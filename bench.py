@@ -34,6 +34,16 @@ no allocator-fragmentation carry-over), printing one JSON line per config
 plus a "winner" line, and recording each config's first measurement in the
 baselines file. Use this to choose the default config honestly.
 
+Overlap A/B: ``python bench.py --overlap on`` applies the latency-hiding
+XLA preset (``dist/overlap.py``, validated against the local jaxlib)
+inside the measurement child before backend init; ``--overlap off`` runs
+the identical config untouched.  Both rows carry the same ``config_hash``
+(the pairing key), an ``overlap`` field naming the arm, and the compiled
+step's HLO async evidence (``overlap_async_ops``,
+``overlap_async_bytes_fraction``, ``overlap_mean_sched_distance`` from the
+comm ledger) so the A/B proves WHERE the win comes from, not just that it
+exists.  See docs/overlap.md.
+
 Hang-proof structure: the accelerator backend behind the axon tunnel can
 HANG at init (not just raise — observed: ``jax.devices()`` blocking >400 s),
 so the parent process never touches JAX.  Before paying for a full
@@ -168,11 +178,25 @@ def _measure() -> None:
     # backend (matches tests/conftest.py and __graft_entry__.py)
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    # --overlap on: apply the latency-hiding XLA preset BEFORE the first
+    # device touch (flags are parsed at backend init; dist/overlap.py
+    # validates them against this jaxlib and drops what it rejects).
+    # --overlap off runs the identical config with no flag changes — the
+    # paired A/B row.
+    ov = _flag_value(sys.argv, "--overlap")
+    if ov not in (None, "on", "off"):
+        raise SystemExit(f"--overlap must be 'on' or 'off', got {ov!r}")
+    if ov == "on":
+        from torchdistpackage_tpu.dist import overlap as _overlap
+
+        _overlap.configure(preset="auto")
     import jax.numpy as jnp
 
     main(jax, jnp, ab="--ab" in sys.argv, only=_only_index(sys.argv),
          big="--big" in sys.argv, long="--long" in sys.argv,
-         moe="--moe" in sys.argv, trace=_flag_value(sys.argv, "--trace"))
+         moe="--moe" in sys.argv, trace=_flag_value(sys.argv, "--trace"),
+         overlap=ov)
 
 
 def _load_baselines(path: str) -> dict:
@@ -418,7 +442,8 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
 
 
 def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
-         long: bool = False, moe: bool = False, trace=None) -> None:
+         long: bool = False, moe: bool = False, trace=None,
+         overlap=None) -> None:
     from torchdistpackage_tpu.models import GPTConfig
 
     # Backend probe with CPU fallback: an accelerator backend that errors at
@@ -509,13 +534,19 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
         # the Pallas fwd re-run; scan_blocks docstring)
         remat_tag = {False: "", True: " remat"}.get(remat, f" remat-{remat}")
         moe_tag = f"-moe{cfg.moe_experts}" if cfg.moe_experts else ""
-        config_str = (
+        base_config_str = (
             f"gpt{moe_tag} d{cfg.dim} L{cfg.nlayers} seq{cfg.max_seq} b{global_batch}"
             f"{remat_tag}"
             f"{f' ce{xent_chunk}' if xent_chunk else ''}"
             f"{f' {dispatch}' if dispatch else ''}"
         )
         metric = f"gpt-{size_tag}-train-throughput"
+        # --overlap A/B pairing: the on and off runs are DIFFERENT configs
+        # for baseline recording (a flag change must not overwrite the
+        # other's first-measurement record) but share config_hash — the
+        # join key that pairs the two JSON rows of one A/B.
+        config_str = (
+            f"{base_config_str} ov-{overlap}" if overlap else base_config_str)
         _record_baseline(baselines, baseline_path, backend, config_str, tps,
                          chip=chip, metric=metric)
         best = _best_recorded(baselines, backend, tps, metric=metric)
@@ -528,6 +559,32 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
             "chip": chip,
             "backend": backend,
         }
+        if overlap:
+            import hashlib
+
+            line["overlap"] = overlap
+            line["config_hash"] = hashlib.sha1(
+                f"{metric}|{base_config_str}".encode()).hexdigest()[:12]
+            try:
+                from torchdistpackage_tpu.dist.overlap import active
+
+                rec = active() or {}
+                line["overlap_preset"] = rec.get("preset")
+                line["overlap_flags_applied"] = len(rec.get("applied", []))
+                line["overlap_flags_dropped"] = len(rec.get("dropped", []))
+            except Exception:
+                pass
+        if ledger is not None and ledger.get("async"):
+            # the HLO-level overlap evidence for THIS compiled step: how
+            # many collectives went async and how far the scheduler
+            # spread their -start/-done pairs (obs.comm_ledger)
+            a = ledger["async"]
+            tot = ledger.get("total_bytes") or 0
+            line["overlap_async_ops"] = a["ops"]
+            line["overlap_async_bytes_fraction"] = (
+                round(a["bytes"] / tot, 4) if tot else 0.0)
+            if a.get("mean_sched_distance") is not None:
+                line["overlap_mean_sched_distance"] = a["mean_sched_distance"]
         if peak:
             line["peak_flops_est"] = peak
             line["mfu"] = round(tps * fpt / peak, 4)
@@ -676,7 +733,7 @@ def _run_child(env_extra: dict, timeout: float, extra_args=(), capture=False,
 
 def _ab_main(timeout: float, allow_cpu: bool = False,
              big: bool = False, long: bool = False,
-             moe: bool = False) -> None:
+             moe: bool = False, overlap=None) -> None:
     """One child per candidate: an OOM/hang in one config cannot abort the
     sweep (observed: b16 no-remat exhausts v5e HBM and killed the round-3
     sweep's remaining configs), and each child gets a fresh backend — no
@@ -695,6 +752,8 @@ def _ab_main(timeout: float, allow_cpu: bool = False,
              else BIG_CANDIDATES if big else TPU_CANDIDATES)
     extra = (("--moe",) if moe else ("--long",) if long
              else ("--big",) if big else ())
+    if overlap:
+        extra = (*extra, "--overlap", overlap)
     best = None
     for i in range(len(cands)):
         out = _run_child(
@@ -758,7 +817,8 @@ if __name__ == "__main__":
             sys.exit(0)
         _ab_main(cpu_timeout if on_cpu else accel_timeout, allow_cpu=on_cpu,
                  big="--big" in sys.argv, long="--long" in sys.argv,
-                 moe="--moe" in sys.argv)
+                 moe="--moe" in sys.argv,
+                 overlap=_flag_value(sys.argv, "--overlap"))
         sys.exit(0)
 
     # `python bench.py --long` / `--moe` measure their own series
@@ -773,6 +833,11 @@ if __name__ == "__main__":
     if _trace_path:
         # forward the Perfetto-trace request to the measurement children
         long_flag = (*long_flag, "--trace", _trace_path)
+    _ov = _flag_value(sys.argv, "--overlap")
+    if _ov:
+        # forward the overlap A/B arm to the measurement children (the
+        # child applies/validates the XLA preset before backend init)
+        long_flag = (*long_flag, "--overlap", _ov)
     if on_cpu:
         ok = _run_child({}, cpu_timeout, long_flag)
     else:

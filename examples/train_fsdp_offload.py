@@ -12,22 +12,23 @@ call and offload is a memory-kind move.
 import os
 
 if os.environ.get("TDP_CPU_SIM"):
-    n = os.environ["TDP_CPU_SIM"]
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={n}"
-    )
+    # XLA_FLAGS handling is centralized in dist/overlap.py (test_repo_lint
+    # bans direct writes); cpu_sim also pins the cpu platform, replacing
+    # the old post-import jax.config.update dance.
+    from torchdistpackage_tpu.dist.overlap import cpu_sim
+
+    cpu_sim(os.environ["TDP_CPU_SIM"])
 
 import jax
-
-if os.environ.get("TDP_CPU_SIM"):
-    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
 from torchdistpackage_tpu import setup_distributed, tpc
+from torchdistpackage_tpu.dist import overlap
 from torchdistpackage_tpu.models import GPTConfig, gpt_loss, init_gpt_params
+from torchdistpackage_tpu.obs import Telemetry
 from torchdistpackage_tpu.parallel import (
     FSDP,
     memory_report,
@@ -37,6 +38,10 @@ from torchdistpackage_tpu.parallel import (
 
 
 def main():
+    # latency-hiding preset BEFORE the first device touch: FSDP lives or
+    # dies by the scheduler hiding the per-weight all-gathers behind
+    # compute (docs/overlap.md)
+    overlap.configure(preset="auto")
     setup_distributed()
     ndev = len(jax.devices())
     tpc.setup_process_groups([("data", ndev)])
@@ -61,18 +66,39 @@ def main():
     }
     batch = jax.tree.map(lambda a: jax.device_put(a, tpc.sharding("data")), batch)
 
+    # obs session: the ledger maps the step's param all-gathers / grad
+    # reduce-scatters onto the data axis (RUNREPORT 'comm' dp row)
+    tel = Telemetry(run="train_fsdp_offload",
+                    tokens_per_step=4 * ndev * cfg.max_seq,
+                    mesh=tpc.get_view())
+    step = tel.wrap_step(step)
     for i in range(4):
         params, state, loss = step(params, state, batch)
-        print(f"step {i}: loss={float(loss):.4f}")
+        rec = tel.end_step(step=i, loss=loss)
+        print(f"step {i}: loss={rec['loss']:.4f}")
     memory_report("after train")
 
-    # offload params+state to host (e.g. while another model runs), reload
-    params, state = offload_to_host((params, state), donate=False)
-    print("offloaded:", jax.tree.leaves(params)[0].sharding.memory_kind)
-    memory_report("offloaded")
-    params, state = reload_to_device((params, state), donate=False)
+    # offload params+state to host (e.g. while another model runs), reload.
+    # Gated on the backend actually exposing pinned_host (legacy-jax CPU
+    # offers only unpinned_host — same probe as tests/test_fsdp.py).
+    try:
+        has_pinned = any(
+            m.kind == "pinned_host"
+            for m in jax.devices()[0].addressable_memories())
+    except Exception:
+        has_pinned = False
+    if has_pinned:
+        params, state = offload_to_host((params, state), donate=False)
+        print("offloaded:", jax.tree.leaves(params)[0].sharding.memory_kind)
+        memory_report("offloaded")
+        params, state = reload_to_device((params, state), donate=False)
+    else:
+        print("backend exposes no pinned_host memory kind; skipping the "
+              "offload/reload demo")
     params, state, loss = step(params, state, batch)
+    tel.end_step(step=4, loss=loss)
     print(f"post-reload step: loss={float(loss):.4f}")
+    tel.finalize()
 
 
 if __name__ == "__main__":

@@ -17,15 +17,14 @@ import functools
 import os
 
 if os.environ.get("TDP_CPU_SIM"):
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_force_host_platform_device_count={os.environ['TDP_CPU_SIM']}"
-    )
+    # XLA_FLAGS handling is centralized in dist/overlap.py (test_repo_lint
+    # bans direct writes); cpu_sim also pins the cpu platform, replacing
+    # the old post-import jax.config.update dance.
+    from torchdistpackage_tpu.dist.overlap import cpu_sim
+
+    cpu_sim(os.environ["TDP_CPU_SIM"])
 
 import jax
-
-if os.environ.get("TDP_CPU_SIM"):
-    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import numpy as np
@@ -89,7 +88,7 @@ def main():
     opt = optax.adam(1e-2)
     state = opt.init(params)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(p, s, x, t):
         loss, grads = vg(p, x, t)
         updates, s = opt.update(grads, s, p)

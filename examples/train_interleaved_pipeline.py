@@ -20,15 +20,14 @@ import sys
 import time
 
 if os.environ.get("TDP_CPU_SIM"):
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_force_host_platform_device_count={os.environ['TDP_CPU_SIM']}"
-    )
+    # XLA_FLAGS handling is centralized in dist/overlap.py (test_repo_lint
+    # bans direct writes); cpu_sim also pins the cpu platform, replacing
+    # the old post-import jax.config.update dance.
+    from torchdistpackage_tpu.dist.overlap import cpu_sim
+
+    cpu_sim(os.environ["TDP_CPU_SIM"])
 
 import jax
-
-if os.environ.get("TDP_CPU_SIM"):
-    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import optax
@@ -90,6 +89,7 @@ def main():
     tel = Telemetry(
         run="train_interleaved_pipeline",
         tokens_per_step=M * mbs * dp_size * cfg.max_seq,
+        mesh=mesh,
     )
     # interleaved-1F1B bubble: (PV+P-2)/(VM+PV+P-2) — vs the classic
     # schedule's value at V=1, the comparison this example exists to show

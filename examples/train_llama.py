@@ -14,15 +14,14 @@ param tree (tensor_parallel/layers.py).
 import os
 
 if os.environ.get("TDP_CPU_SIM"):
-    n = os.environ["TDP_CPU_SIM"]
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={n}"
-    )
+    # XLA_FLAGS handling is centralized in dist/overlap.py (test_repo_lint
+    # bans direct writes); cpu_sim also pins the cpu platform, replacing
+    # the old post-import jax.config.update dance.
+    from torchdistpackage_tpu.dist.overlap import cpu_sim
+
+    cpu_sim(os.environ["TDP_CPU_SIM"])
 
 import jax
-
-if os.environ.get("TDP_CPU_SIM"):
-    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import optax
@@ -79,7 +78,8 @@ def main():
     mesh = tpc.get_view()
     # obs session: per-step spans + recompile watch + RUNREPORT.json (when
     # TDP_RUNREPORT is set, as under the CI example runner)
-    tel = Telemetry(run="train_llama", tokens_per_step=B * cfg.max_seq)
+    tel = Telemetry(run="train_llama", tokens_per_step=B * cfg.max_seq,
+                    mesh=mesh)
     step = tel.wrap_step(step)
     for it in range(5):
         k1, k2 = jax.random.split(jax.random.PRNGKey(100 + it))

@@ -11,15 +11,14 @@ import sys
 import time
 
 if os.environ.get("TDP_CPU_SIM"):
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_force_host_platform_device_count={os.environ['TDP_CPU_SIM']}"
-    )
+    # XLA_FLAGS handling is centralized in dist/overlap.py (test_repo_lint
+    # bans direct writes); cpu_sim also pins the cpu platform, replacing
+    # the old post-import jax.config.update dance.
+    from torchdistpackage_tpu.dist.overlap import cpu_sim
+
+    cpu_sim(os.environ["TDP_CPU_SIM"])
 
 import jax
-
-if os.environ.get("TDP_CPU_SIM"):
-    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import optax
@@ -87,7 +86,8 @@ def main():
         loss_fn, batch_spec={"x": P(None, "data"), "y": P(None, "data")}
     )
 
-    tel = Telemetry(run="train_pipeline", tokens_per_step=M * mbs * dp * S)
+    tel = Telemetry(run="train_pipeline", tokens_per_step=M * mbs * dp * S,
+                    mesh=mesh)
     # the schedule's own bubble accounting (forward scan: (P-1)/(M+P-1))
     # lands in the report's counters — the number a deeper pipeline's M is
     # tuned against

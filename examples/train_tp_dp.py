@@ -12,20 +12,20 @@ import sys
 import time
 
 if os.environ.get("TDP_CPU_SIM"):
-    n = os.environ["TDP_CPU_SIM"]
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={n}"
-    )
+    # XLA_FLAGS handling is centralized in dist/overlap.py (test_repo_lint
+    # bans direct writes); cpu_sim also pins the cpu platform, replacing
+    # the old post-import jax.config.update dance.
+    from torchdistpackage_tpu.dist.overlap import cpu_sim
+
+    cpu_sim(os.environ["TDP_CPU_SIM"])
 
 import jax
-
-if os.environ.get("TDP_CPU_SIM"):
-    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import optax
 
 from torchdistpackage_tpu import setup_distributed, tpc
+from torchdistpackage_tpu.dist import overlap
 from torchdistpackage_tpu.obs import Telemetry
 from torchdistpackage_tpu.parallel.data_parallel import DataParallel
 from torchdistpackage_tpu.parallel.tensor_parallel import (
@@ -37,6 +37,10 @@ from torchdistpackage_tpu.parallel.tensor_parallel import (
 
 
 def main():
+    # latency-hiding XLA preset — must precede the first device touch;
+    # resolves to the chip's generation on TPU, to an empty set on the
+    # CPU sim, and is recorded as an obs event either way
+    overlap.configure(preset="auto")
     setup_distributed()
     ndev = len(jax.devices())
     tp = 2 if ndev % 2 == 0 else 1
@@ -77,7 +81,8 @@ def main():
     # comm ledger + RUNREPORT comm section come for free: the ledger maps
     # the compiled step's collectives onto tpc's ('data', 'tensor') mesh;
     # set TDP_TRACE=/path/trace.json for the Perfetto timeline
-    tel = Telemetry(run="train_tp_dp", tokens_per_step=B * S)
+    tel = Telemetry(run="train_tp_dp", tokens_per_step=B * S,
+                    mesh=tpc.get_view())
     step = tel.wrap_step(step)
     # double-buffered host->HBM transfers overlap the previous step's compute
     batches = prefetch_to_sharding(host_batches(10), dp.mesh, P("data"))

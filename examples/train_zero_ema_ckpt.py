@@ -13,26 +13,31 @@ import os
 import tempfile
 
 if os.environ.get("TDP_CPU_SIM"):
-    n = os.environ["TDP_CPU_SIM"]
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={n}"
-    )
+    # XLA_FLAGS handling is centralized in dist/overlap.py (test_repo_lint
+    # bans direct writes); cpu_sim also pins the cpu platform, replacing
+    # the old post-import jax.config.update dance.
+    from torchdistpackage_tpu.dist.overlap import cpu_sim
+
+    cpu_sim(os.environ["TDP_CPU_SIM"])
 
 import jax
-
-if os.environ.get("TDP_CPU_SIM"):
-    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import optax
 
 from torchdistpackage_tpu import setup_distributed, tpc
+from torchdistpackage_tpu.dist import overlap
 from torchdistpackage_tpu.models import GPTConfig, gpt_loss, init_gpt_params
+from torchdistpackage_tpu.obs import Telemetry
 from torchdistpackage_tpu.parallel import ShardedEMA, ZeroOptimizer
 from torchdistpackage_tpu.utils import CheckpointManager, fix_rand
 
 
 def main():
+    # latency-hiding preset BEFORE the first device touch — the ZeRO
+    # step's grad psum_scatter and bf16 param all-gather are exactly the
+    # collectives the async scheduler hides (docs/overlap.md)
+    overlap.configure(preset="auto")
     setup_distributed()
     ndev = len(jax.devices())
     tpc.setup_process_groups([("data", ndev)])
@@ -45,7 +50,11 @@ def main():
     zero = ZeroOptimizer(optax.adamw(1e-3))
     params = zero.place_params(params)
     state = zero.init(params)
-    step = zero.make_train_step(lambda p, b: gpt_loss(p, b, cfg))
+    # per-microbatch scatter inside the accumulation scan: the overlap
+    # path (grads accumulate as 1/N shards; docs/overlap.md)
+    step = zero.make_train_step(lambda p, b: gpt_loss(p, b, cfg),
+                                grad_accum_iters=2,
+                                accum_reduce="microbatch")
 
     ema = ShardedEMA(decay=0.99)
     ema_state = ema.init(params)
@@ -58,19 +67,27 @@ def main():
     batch = jax.tree.map(lambda a: jax.device_put(a, tpc.sharding("data")), batch)
 
     ckdir = os.path.join(tempfile.mkdtemp(prefix="tdp_ckpt_"), "run")
+    # obs session with the mesh so the RUNREPORT 'comm' section ledgers
+    # the ZeRO scatter/gather collectives onto the data axis
+    tel = Telemetry(run="train_zero_ema_ckpt",
+                    tokens_per_step=4 * ndev * cfg.max_seq,
+                    mesh=tpc.get_view())
+    step = tel.wrap_step(step)
     with CheckpointManager(ckdir, max_to_keep=2) as mgr:
         for i in range(6):
             params, state, loss = step(params, state, batch)
+            rec = tel.end_step(step=i, loss=loss)
             ema_state = ema.update(ema_state, params)
             if i % 2 == 1:
                 mgr.save(i, {"params": params, "ema": ema_state}, wait=True)
-            print(f"step {i}: loss={float(loss):.4f}")
+            print(f"step {i}: loss={rec['loss']:.4f}")
 
         # simulate a restart: restore latest checkpoint into sharded arrays
         latest = mgr.latest_step()
         restored = mgr.restore(latest, template={"params": params, "ema": ema_state})
         print(f"resumed from step {latest}; params leaf sharding:",
               jax.tree.leaves(restored["params"])[0].sharding.spec)
+    tel.finalize()
 
 
 if __name__ == "__main__":

@@ -12,13 +12,14 @@ for cross-rank and vs-single-process parity.
 import os
 import sys
 
-# 4 virtual CPU devices per process -> 8 global
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
-)
+# 4 virtual CPU devices per process -> 8 global (XLA_FLAGS writes are
+# centralized in dist/overlap.py; cpu_sim also pins the cpu platform)
+from torchdistpackage_tpu.dist.overlap import cpu_sim
+
+cpu_sim(4)
+
 import jax
 
-jax.config.update("jax_platforms", "cpu")
 # cross-process CPU collectives ride gloo (the CPU stand-in for ICI/DCN)
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 

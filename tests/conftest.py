@@ -8,18 +8,20 @@ program in CI without hardware.
 
 import os
 
-# Must run before jax is imported anywhere in the test process.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Must run before any backend initializes (XLA_FLAGS is parsed at backend
+# init; importing jax is safe, initializing it is not).  All XLA_FLAGS
+# writes go through dist/overlap.py — this file's own lint
+# (test_repo_lint.test_no_direct_xla_flags_writes) enforces it.
+# cpu_sim(8) merges --xla_force_host_platform_device_count=8, sets
+# JAX_PLATFORMS=cpu AND pins the jax platform config — the axon
+# sitecustomize force-registers the TPU backend via
+# jax.config.update("jax_platforms", "axon,cpu"), which a bare env var
+# does not override.
+from torchdistpackage_tpu.dist.overlap import cpu_sim
+
+cpu_sim(8)
 
 import jax  # noqa: E402
-
-# The axon sitecustomize force-registers the TPU backend via
-# jax.config.update("jax_platforms", "axon,cpu"), which overrides the env var
-# — override it back before any backend is initialized.
-jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -34,6 +34,10 @@ OBS_EXAMPLES = {
     "train_interleaved_pipeline.py": {
         "counter": "pipeline", "field": "bubble_fraction"},
     "train_moe.py": {"counter": "moe", "field": "imbalance", "comm": "moe"},
+    # overlap-audited examples (PR 3): GSPMD FSDP's param all-gathers and
+    # the ZeRO owner-scatter both ledger onto the data axis
+    "train_fsdp_offload.py": {"comm": "dp"},
+    "train_zero_ema_ckpt.py": {"comm": "dp"},
 }
 
 

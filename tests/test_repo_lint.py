@@ -1,4 +1,5 @@
-"""Repo lint: no bare ``print(`` and no ``time.time()`` in the package.
+"""Repo lint: no bare ``print(`` / ``time.time()`` in the package, and no
+``os.environ["XLA_FLAGS"]`` writes outside ``dist/overlap.py``.
 
 Observability goes through ``utils.logging.master_print`` (rank-gated) or
 an obs sink — a bare print on a 256-host pod is 256 interleaved copies of
@@ -13,12 +14,23 @@ interval measured with ``time.time()`` can silently be wrong by
 milliseconds (or negative).  Code that genuinely needs a wall-clock stamp
 (event records) uses ``datetime.now().timestamp()``, which reads as intent
 instead of a timing bug waiting to happen.
+
+``XLA_FLAGS`` writes are banned everywhere but ``dist/overlap.py`` (the
+whole repo: package, examples, tests, bench.py, __graft_entry__.py).  The
+variable is parsed once at backend init and an unknown flag is a FATAL
+abort, so scattered ad-hoc writes are both a too-late trap and a crash
+trap; overlap.py owns the merge/validate/apply logic (presets, user-flag
+precedence, the subprocess flag probe) and ``overlap.cpu_sim`` serves the
+sim-bootstrap case the old inline writes existed for.  Writing into a
+COPIED env dict for a child process is fine — the rule matches
+``os.environ`` mutation only.
 """
 
 import ast
 import pathlib
 
 PKG = pathlib.Path(__file__).resolve().parent.parent / "torchdistpackage_tpu"
+REPO = PKG.parent
 
 # Intentional bare-print sites (repo-relative to the package dir):
 ALLOWLIST = {
@@ -90,3 +102,83 @@ def test_no_time_time_in_package():
         "time.perf_counter() (NTP-step-proof); wall-clock stamps use "
         f"datetime.now().timestamp(): {offenders}"
     )
+
+
+# --------------------------------------------------- XLA_FLAGS ownership
+
+# The one module allowed to mutate os.environ["XLA_FLAGS"] (repo-relative).
+XLA_FLAGS_OWNER = "torchdistpackage_tpu/dist/overlap.py"
+
+
+def _is_os_environ(node) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "environ"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "os"
+    )
+
+
+def _xla_flags_writes(path: pathlib.Path):
+    """Line numbers of os.environ['XLA_FLAGS'] mutations: subscript
+    assignment/augassign/del, and setdefault/update calls naming the key."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    hits = []
+
+    def is_target(node) -> bool:
+        if not (isinstance(node, ast.Subscript) and _is_os_environ(node.value)):
+            return False
+        sl = node.slice
+        return isinstance(sl, ast.Constant) and sl.value == "XLA_FLAGS"
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target] if isinstance(node, ast.AugAssign)
+                else node.targets
+            )
+            if any(is_target(t) for t in targets):
+                hits.append(node.lineno)
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("setdefault", "pop")
+            and _is_os_environ(node.func.value)
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value == "XLA_FLAGS"
+            and node.func.attr == "setdefault"  # pop (removal) is fine
+        ):
+            hits.append(node.lineno)
+    return hits
+
+
+def _repo_python_files():
+    yield from sorted(PKG.rglob("*.py"))
+    yield from sorted((REPO / "examples").glob("*.py"))
+    yield from sorted((REPO / "tests").glob("*.py"))
+    for name in ("bench.py", "__graft_entry__.py"):
+        p = REPO / name
+        if p.exists():
+            yield p
+
+
+def test_no_direct_xla_flags_writes():
+    offenders = {}
+    for path in _repo_python_files():
+        rel = str(path.relative_to(REPO))
+        if rel == XLA_FLAGS_OWNER:
+            continue
+        lines = _xla_flags_writes(path)
+        if lines:
+            offenders[rel] = lines
+    assert not offenders, (
+        "direct os.environ['XLA_FLAGS'] writes outside dist/overlap.py — "
+        "use overlap.configure() / overlap.cpu_sim() (merge + validation "
+        f"live there; an unknown flag is a fatal abort): {offenders}"
+    )
+
+
+def test_xla_flags_owner_exists():
+    assert (REPO / XLA_FLAGS_OWNER).exists()

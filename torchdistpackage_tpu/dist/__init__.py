@@ -12,3 +12,4 @@ from .topology import (
 )
 from .launch import setup_distributed, find_free_port
 from . import comm_bench
+from . import overlap

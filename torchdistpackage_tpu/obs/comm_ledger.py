@@ -125,10 +125,25 @@ _SHAPE_RE = re.compile(r"\b([a-z]\w*)\[([0-9,]*)\]")
 # followed by '(' so references like 'get-tuple-element(... %all-to-all.2)'
 # don't match.
 _INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%[^\s=]+\s+=\s+(?P<res>.+?)\s+"
+    r"^\s*(?:ROOT\s+)?%(?P<name>[^\s=]+)\s+=\s+(?P<res>.+?)\s+"
     r"(?P<op>" + "|".join(COLLECTIVE_OPS) + r")(?P<start>-start)?"
     r"\((?P<rest>.*)$"
 )
+
+# The matching async completion:
+#   %all-gather-done.1 = f32[...] all-gather-done(... %all-gather-start.1)
+# The first %token in the operand list names the -start instruction.
+_DONE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%[^\s=]+\s+=\s+.+?\s+"
+    r"(?:" + "|".join(COLLECTIVE_OPS) + r")-done"
+    r"\((?P<rest>.*)$"
+)
+_OPERAND_NAME_RE = re.compile(r"%([^\s,)]+)")
+
+# Any defining instruction line — the unit the scheduling distance is
+# counted in (instructions between a collective's -start and its -done:
+# how much independent work XLA's scheduler placed under the transfer).
+_ANY_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%[^\s=]+\s+=\s")
 
 _REPLICA_GROUPS_RE = re.compile(
     r"replica_groups=(\{\{[0-9,{} ]*\}\}|\{\}|\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)"
@@ -205,14 +220,36 @@ def parse_hlo_collectives(hlo_text: str) -> List[Dict[str, Any]]:
     """Enumerate collective instructions from HLO text (mesh-independent).
 
     Returns one record per instruction: ``{op, bytes, groups, group_size,
-    n_groups, pairs, channel_id, op_name, async}`` — ``groups`` is the
-    decoded replica-group list (device ids), ``pairs`` the
-    source-target pairs for collective-permute.
+    n_groups, pairs, channel_id, op_name, async, sched_distance}`` —
+    ``groups`` is the decoded replica-group list (device ids), ``pairs``
+    the source-target pairs for collective-permute.
+
+    ``sched_distance`` (async ops only, else None): the number of
+    instructions the scheduler placed between the ``-start`` and its
+    matching ``-done`` — the direct HLO-level measure of how much
+    independent compute the transfer can hide behind.  0 means the
+    ``-done`` immediately follows the ``-start`` (async in name only);
+    the latency-hiding presets of ``dist/overlap.py`` exist to push this
+    number up.
     """
     out: List[Dict[str, Any]] = []
+    starts: Dict[str, Dict[str, Any]] = {}
+    instr_idx = 0
     for line in hlo_text.splitlines():
+        is_instr = _ANY_INSTR_RE.match(line) is not None
+        if is_instr:
+            instr_idx += 1
         m = _INSTR_RE.match(line)
         if m is None:
+            if not is_instr:
+                continue
+            dm = _DONE_RE.match(line)
+            if dm is None:
+                continue
+            onm = _OPERAND_NAME_RE.search(dm.group("rest"))
+            rec = starts.get(onm.group(1)) if onm else None
+            if rec is not None:
+                rec["sched_distance"] = max(0, instr_idx - rec["_idx"] - 1)
             continue
         op = m.group("op")
         rest = m.group("rest")
@@ -232,7 +269,7 @@ def parse_hlo_collectives(hlo_text: str) -> List[Dict[str, Any]]:
             nbytes = operand_bytes * group_size  # operand is the local shard
         cm = _CHANNEL_RE.search(line)
         nm = _OPNAME_RE.search(line)
-        out.append({
+        rec = {
             "op": op,
             "bytes": int(nbytes),
             "groups": groups,
@@ -242,7 +279,14 @@ def parse_hlo_collectives(hlo_text: str) -> List[Dict[str, Any]]:
             "channel_id": int(cm.group(1)) if cm else None,
             "op_name": nm.group(1) if nm else None,
             "async": bool(m.group("start")),
-        })
+            "sched_distance": None,
+            "_idx": instr_idx,
+        }
+        if rec["async"]:
+            starts[m.group("name")] = rec
+        out.append(rec)
+    for rec in out:
+        rec.pop("_idx", None)
     return out
 
 
@@ -333,18 +377,38 @@ def ledger_from_hlo(hlo_text: str, mesh=None) -> Dict[str, Any]:
             "channel_id": rec["channel_id"],
             "op_name": rec["op_name"],
             "async": rec["async"],
+            "sched_distance": rec["sched_distance"],
         }
         collectives.append(entry)
         d = per_dim.setdefault(dim, {"bytes": 0, "ops": 0})
         d["bytes"] += entry["bytes"]
         d["ops"] += 1
         total += entry["bytes"]
+    async_recs = [c for c in collectives if c["async"]]
+    distances = [
+        c["sched_distance"] for c in async_recs
+        if c["sched_distance"] is not None
+    ]
     return {
         "schema": LEDGER_SCHEMA,
         "collectives": collectives,
         "per_dim": per_dim,
         "total_bytes": int(total),
         "n_collectives": len(collectives),
+        # async scheduling summary: how many collectives the compiler
+        # emitted in split -start/-done form, the bytes they carry, and
+        # the mean instruction distance the scheduler achieved between
+        # start and done (the latency-hiding evidence comm_model's
+        # ``overlap`` report section is computed from)
+        "async": {
+            "ops": len(async_recs),
+            "bytes": int(sum(c["bytes"] for c in async_recs)),
+            "sync_ops": len(collectives) - len(async_recs),
+            "sync_bytes": int(total - sum(c["bytes"] for c in async_recs)),
+            "mean_sched_distance": (
+                round(sum(distances) / len(distances), 2) if distances else None
+            ),
+        },
         "mesh_axes": (
             {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
             if mesh is not None else None

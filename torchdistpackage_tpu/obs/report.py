@@ -194,9 +194,23 @@ def render_markdown(report: Dict[str, Any]) -> str:
         if "modeled_compute_s" in comm:
             L.append(f"- modeled compute: "
                      f"{comm['modeled_compute_s'] * 1e3:.3f} ms")
+        ov = comm.get("overlap")
+        if ov:
+            dist = ov.get("mean_sched_distance")
+            L.append(
+                f"- achieved overlap: **{ov['achieved_fraction']:.1%}** of "
+                f"modeled comm hidden ({ov['hidden_ops']}/{ov['async_ops']} "
+                f"async + {ov['sync_ops']} sync collectives"
+                + (f", mean sched distance {dist:.0f} instr" if dist is not None
+                   else "") + ")")
+            if "comm_fraction_effective" in comm:
+                L.append(f"- effective (exposed) comm fraction: "
+                         f"{comm['comm_fraction_effective']:.1%}")
         if "overlap_headroom_s" in comm:
             L.append(f"- overlap headroom: "
-                     f"{comm['overlap_headroom_s'] * 1e3:.3f} ms")
+                     f"{comm['overlap_headroom_s'] * 1e3:.3f} ms"
+                     + (" (vs zero-overlap floor; see achieved overlap above)"
+                        if ov else ""))
         L.append(f"- model source: {model.get('source', '?')} "
                  f"(chip {model.get('chip', '?')})")
         L.append("")

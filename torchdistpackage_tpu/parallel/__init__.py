@@ -9,7 +9,15 @@ from .moe import (
 )
 from .zero import ZeroOptimizer, zero_partition_spec
 from .ema import ShardedEMA
-from .fsdp import FSDP, memory_report, offload_to_host, reload_to_device
+from .fsdp import (
+    FSDP,
+    gather_params,
+    memory_report,
+    offload_to_host,
+    prefetched_layer_scan,
+    reload_to_device,
+    stacked_fsdp_specs,
+)
 from .clip import (
     DynamicLossScale,
     clip_by_global_norm_parallel,

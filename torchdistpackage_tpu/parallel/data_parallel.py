@@ -283,15 +283,28 @@ def local_value_and_grad(
     params: PyTree,
     batch: PyTree,
     grad_accum_iters: int = 1,
+    reduce_fn: Optional[Callable[[PyTree], PyTree]] = None,
 ):
     """(loss, grads) of the local mean loss; with accumulation, scans
     microbatches (split from the leading batch dim) summing grads locally —
     the reference's reduce-only-on-last-microbatch semantics
     (naive_ddp.py:108-110).  Traced; call inside shard_map.  The scan carry's
     varying axes are derived from an abstract eval so this works under any
-    TP/SP/PP composition inside ``loss_fn``."""
+    TP/SP/PP composition inside ``loss_fn``.
+
+    ``reduce_fn`` (the overlap path): applied to each microbatch's grads
+    INSIDE the scan — the cross-shard reduction (pmean / psum_scatter)
+    rides along with the backward instead of landing as one post-hoc sync,
+    so it overlaps the next microbatch's compute, and (for a scattering
+    reduce) the accumulator holds only the 1/N shard.  Any LINEAR
+    reduction composes exactly: mean-of-per-microbatch-reductions equals
+    the reduction of the accumulated mean.  The returned grads are then
+    already reduced — callers must not reduce again."""
     if grad_accum_iters == 1:
-        return jax.value_and_grad(loss_fn)(params, batch)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        if reduce_fn is not None:
+            grads = reduce_fn(grads)
+        return loss, grads
 
     def split(x):
         b = x.shape[0]
@@ -301,11 +314,15 @@ def local_value_and_grad(
             )
         return x.reshape(grad_accum_iters, b // grad_accum_iters, *x.shape[1:])
 
+    def vag(p, mb):
+        l, g = jax.value_and_grad(loss_fn)(p, mb)
+        if reduce_fn is not None:
+            g = reduce_fn(g)
+        return l, g
+
     micro = jax.tree.map(split, batch)
     first = jax.tree.map(lambda m: m[0], micro)
-    loss_aval, grads_aval = jax.eval_shape(
-        lambda p, mb: jax.value_and_grad(loss_fn)(p, mb), params, first
-    )
+    loss_aval, grads_aval = jax.eval_shape(vag, params, first)
 
     def zeros_like_aval(a):
         z = jnp.zeros(a.shape, a.dtype)
@@ -314,7 +331,7 @@ def local_value_and_grad(
 
     def body(carry, mb):
         ls, gs = carry
-        l, g = jax.value_and_grad(loss_fn)(params, mb)
+        l, g = vag(params, mb)
         return (ls + l, jax.tree.map(jnp.add, gs, g)), None
 
     (loss, grads), _ = jax.lax.scan(
@@ -414,6 +431,7 @@ class DataParallel:
         batch_spec: Optional[PyTree] = None,
         donate: bool = True,
         value_and_grad_fn: Optional[Callable] = None,
+        accum_reduce: str = "final",
     ):
         """Build a jitted SPMD train step.
 
@@ -432,6 +450,13 @@ class DataParallel:
           backward cannot be expressed as outer AD, e.g. the 1F1B pipeline
           (``pipeline_parallel.pipeline_1f1b`` / ``gpt_pipeline_1f1b``), whose
           backward interleaves with its forward inside one scan.
+        - ``accum_reduce='microbatch'`` (overlap path; loss_fn +
+          grad_accum only): reduce each microbatch's grads INSIDE the
+          accumulation scan so the reduction overlaps the next
+          microbatch's compute, instead of one post-hoc sync after the
+          scan.  Exact for the mean/sum reductions (linear); trades
+          ``iters``× the reduction traffic for the overlap and composes
+          with ``overlap.configure()``'s async-collective presets.
         """
         if (loss_fn is None) == (value_and_grad_fn is None):
             raise ValueError("pass exactly one of loss_fn / value_and_grad_fn")
@@ -443,9 +468,21 @@ class DataParallel:
                 "value_and_grad_fn (e.g. pipeline_1f1b) owns its own "
                 "microbatching"
             )
+        if accum_reduce not in ("final", "microbatch"):
+            raise ValueError(
+                f"accum_reduce must be 'final' or 'microbatch', got {accum_reduce!r}")
         mesh = self.mesh
         axis = self.axis
         data_axes = (axis,) if isinstance(axis, str) else tuple(axis)
+
+        def reduce_fn(grads):
+            return reduce_gradients(
+                grads, axis, self.reduce_op, self.grad_reduce_overrides,
+                compress=self.grad_compress,
+                compress_min_size=self.compress_min_size,
+            )
+
+        in_scan = accum_reduce == "microbatch" and value_and_grad_fn is None
 
         def step(params, opt_state, batch):
             # Keep grads local over the data axes (one explicit reduce below).
@@ -453,16 +490,17 @@ class DataParallel:
             if value_and_grad_fn is not None:
                 loss, grads = value_and_grad_fn(p_local, batch)
             else:
-                loss, grads = local_value_and_grad(loss_fn, p_local, batch, grad_accum_iters)
+                loss, grads = local_value_and_grad(
+                    loss_fn, p_local, batch, grad_accum_iters,
+                    reduce_fn=reduce_fn if in_scan else None,
+                )
             grads, other = normalize_model_axis_grads(loss, grads, mesh, data_axes)
             # grad_compress='int8' swaps the large-leaf pmean for the
             # quantized ring — vma-legal (see dist/compressed.py), so the
-            # SAME step body serves pure-DP and TP/PP-composed meshes
-            grads = reduce_gradients(
-                grads, axis, self.reduce_op, self.grad_reduce_overrides,
-                compress=self.grad_compress,
-                compress_min_size=self.compress_min_size,
-            )
+            # SAME step body serves pure-DP and TP/PP-composed meshes.
+            # (normalize after an in-scan reduce is exact: it only scales.)
+            if not in_scan:
+                grads = reduce_fn(grads)
             if other:
                 loss = jax.lax.pmean(loss, other)
             dax = _vaxes(loss, data_axes)

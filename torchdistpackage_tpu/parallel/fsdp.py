@@ -25,10 +25,140 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import axis_size
 from ..dist.topology import DATA_AXIS, tpc
-from .zero import zero_partition_spec
+from .zero import _norm_spec, zero_partition_spec
 
 PyTree = Any
+
+
+# ------------------------------------------------------- explicit gathers
+# The GSPMD formulation below leaves WHERE the per-weight all-gather runs
+# entirely to the compiler.  The overlap path makes the comm explicit so
+# the latency-hiding scheduler (dist/overlap.py presets) has distinct,
+# movable -start/-done pairs to hide: each leaf is gathered by an
+# explicit ``all_gather`` exactly where the forward consumes it, and —
+# because the transpose of all_gather is psum_scatter — AD issues each
+# leaf's gradient reduce-scatter INSIDE the backward at the point that
+# leaf's grad is produced, instead of one post-hoc full-tree sync.
+
+
+def gather_params(params: PyTree, shard_dims: PyTree, axis: str) -> PyTree:
+    """All-gather every sharded leaf of a shard_map-local param tree back
+    to full size (``shard_dims``: per-leaf gather dim, -1 = replicated —
+    the layout :func:`zero_partition_spec` produces).  Traced; call
+    inside shard_map over ``axis``."""
+    return jax.tree.map(
+        lambda p, d: (
+            jax.lax.all_gather(p, axis, axis=d, tiled=True) if d >= 0 else p
+        ),
+        params,
+        shard_dims,
+    )
+
+
+def stacked_fsdp_specs(
+    stacked: PyTree,
+    axis: str,
+    n: int,
+    base_specs: Optional[PyTree] = None,
+) -> Tuple[PyTree, PyTree]:
+    """(specs, shard_dims) for a LAYER-STACKED param tree (leading dim =
+    layer index): the FSDP axis is inserted on the first free divisible
+    dim **past the stack dim**, so :func:`prefetched_layer_scan` can
+    gather one layer at a time.  (Plain :meth:`FSDP.fsdp_specs` would
+    happily shard the stack dim itself when the layer count divides the
+    axis — correct for GSPMD, useless for per-layer prefetch.)"""
+    flat_p, treedef = jax.tree_util.tree_flatten(stacked)
+    if base_specs is None:
+        flat_s = [None] * len(flat_p)
+    else:
+        flat_s = treedef.flatten_up_to(base_specs)
+    specs, dims = [], []
+    for p, s in zip(flat_p, flat_s):
+        shape = np.shape(p)
+        entries = _norm_spec(s, len(shape))
+        tail_spec, d = zero_partition_spec(
+            shape[1:], P(*entries[1:]), axis, n)
+        tail = _norm_spec(tail_spec, len(shape) - 1)
+        full = (entries[0],) + tuple(tail)
+        while full and full[-1] is None:
+            full = full[:-1]
+        specs.append(P(*full))
+        dims.append(d + 1 if d >= 0 else -1)
+    return (
+        jax.tree_util.tree_unflatten(treedef, specs),
+        jax.tree_util.tree_unflatten(treedef, dims),
+    )
+
+
+def prefetched_layer_scan(
+    stacked: PyTree,
+    x: Any,
+    apply_fn: Callable[[PyTree, Any, Any], Any],
+    axis: str,
+    shard_dims: PyTree,
+    prefetch: bool = True,
+):
+    """Scan a layer stack whose params are FSDP-sharded, gathering ONE
+    layer's weights at a time — with the NEXT layer's all-gather issued
+    before the current layer's compute, so the transfer hides behind the
+    matmuls (a software double-buffer in the scan carry).
+
+    ``stacked``: [L, ...]-stacked param tree, leaves sharded over ``axis``
+    on ``shard_dims`` (per-STACKED-leaf dims from
+    :func:`stacked_fsdp_specs`; never 0 — the stack dim must stay whole).
+    ``apply_fn(layer_params_full, carry, i) -> carry`` is one layer's
+    forward.  Backward: AD transposes each per-layer gather into a
+    per-layer reduce-scatter inside the backward scan — grad comm is
+    bucketed by layer, not deferred to a post-hoc sync.
+
+    ``prefetch=False`` gathers in-loop with no lookahead (the A/B
+    baseline — same numerics, one less carry buffer, no hiding).
+    """
+    for d in jax.tree.leaves(shard_dims):
+        if d == 0:
+            raise ValueError(
+                "prefetched_layer_scan: a leaf is sharded on the stack "
+                "dim (shard_dim 0); derive specs with stacked_fsdp_specs")
+    leaves = jax.tree.leaves(stacked)
+    L = leaves[0].shape[0]
+
+    def gather_layer(i):
+        lp = jax.tree.map(
+            lambda v: jax.lax.dynamic_index_in_dim(v, i, 0, keepdims=False),
+            stacked,
+        )
+        # the per-STACKED dim shifts down by one after the layer index
+        dims = jax.tree.map(lambda d: d - 1 if d >= 1 else -1, shard_dims)
+        return gather_params(lp, dims, axis)
+
+    from .data_parallel import _mark_varying, _vma
+
+    want = _vma(x)
+    for leaf in leaves:
+        want = want | _vma(leaf)
+    x = _mark_varying(x, tuple(want))
+
+    if not prefetch:
+        def body(carry, i):
+            return apply_fn(gather_layer(i), carry, i), None
+
+        out, _ = jax.lax.scan(body, x, jnp.arange(L))
+        return out
+
+    def body(carry, i):
+        h, cur = carry
+        # issue the NEXT layer's gathers before this layer's compute: the
+        # two are data-independent, so the scheduler overlaps them.  The
+        # last iteration re-gathers layer L-1 into a dead buffer (one
+        # wasted gather per scan — the price of a fixed carry structure).
+        nxt = gather_layer(jnp.minimum(i + 1, L - 1))
+        h = apply_fn(cur, h, i)
+        return (h, nxt), None
+
+    (out, _), _ = jax.lax.scan(body, (x, gather_layer(0)), jnp.arange(L))
+    return out
 
 
 class FSDP:
@@ -70,6 +200,22 @@ class FSDP:
         flat_s = treedef.flatten_up_to(base)
         out = [
             zero_partition_spec(np.shape(p), s, self.shard_axis, n)[0]
+            for p, s in zip(flat_p, flat_s)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def fsdp_shard_dims(self, params: PyTree, param_specs: Optional[PyTree] = None) -> PyTree:
+        """Per-leaf dim the FSDP axis was inserted on by :meth:`fsdp_specs`
+        (-1 = replicated) — what the explicit-gather overlap step needs to
+        all-gather each leaf back."""
+        n = self.mesh.shape[self.shard_axis]
+        base = param_specs if param_specs is not None else self.param_specs
+        if base is None:
+            base = jax.tree.map(lambda _: P(), params)
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_s = treedef.flatten_up_to(base)
+        out = [
+            zero_partition_spec(np.shape(p), s, self.shard_axis, n)[1]
             for p, s in zip(flat_p, flat_s)
         ]
         return jax.tree_util.tree_unflatten(treedef, out)
@@ -165,6 +311,112 @@ class FSDP:
                     out_shardings=(p_sh, None, None),
                     donate_argnums=(0, 1),
                 )
+            return compiled[key](params, opt_state, batch)
+
+        return jitted
+
+    def make_overlap_train_step(
+        self,
+        loss_fn: Callable[[PyTree, PyTree], jax.Array],
+        optimizer,
+        batch_spec: Any = P(DATA_AXIS),
+        param_specs: Optional[PyTree] = None,
+        donate: bool = True,
+        gather: str = "leaf",
+    ) -> Callable:
+        """Explicit-comm FSDP step (the overlap path, drop-in replacement
+        for :meth:`make_train_step` on the same placements).
+
+        Differences from the GSPMD step:
+
+        - the step is a ``shard_map`` over the whole mesh: params enter as
+          LOCAL shards and each leaf is regathered by an explicit
+          ``all_gather`` where the forward consumes it — distinct
+          ``-start``/``-done`` pairs the latency-hiding scheduler
+          (``dist/overlap.py``) moves behind compute;
+        - AD transposes each gather into a per-leaf **reduce-scatter
+          issued inside the backward** at the point that leaf's grad is
+          produced — no post-hoc full-tree sync, and the full-size grad
+          never persists;
+        - the optimizer update runs on the local shard (elementwise optax
+          transforms are shard-exact), so params/opt state stay sharded
+          end to end — true ZeRO-3.
+
+        Conventions: ``loss_fn`` sees the LOCAL batch shard (the
+        :class:`~.data_parallel.DataParallel` convention — it already
+        receives the FULL param tree, regathered).  ``gather='none'``
+        hands loss_fn the raw SHARDED leaves instead, for callers that
+        gather at finer granularity themselves (e.g.
+        :func:`prefetched_layer_scan` inside a scanned stack — pair it
+        with :func:`stacked_fsdp_specs` placements).  Composes with a
+        single data axis; for TP composition use the shard_map-aware
+        :class:`~.zero.ZeroOptimizer` family instead.
+        """
+        if gather not in ("leaf", "none"):
+            raise ValueError(f"gather must be 'leaf' or 'none', got {gather!r}")
+        mesh = self.mesh
+        ax = self.shard_axis
+        from ..compat import shard_map
+        from .data_parallel import _vaxes, pvary_params, step_cache_key
+
+        compiled: dict = {}
+
+        def jitted(params, opt_state, batch):
+            key = step_cache_key(params, opt_state, batch)
+            if key not in compiled:
+                specs = self.fsdp_specs(params, param_specs)
+                dims = self.fsdp_shard_dims(params, param_specs)
+                from .data_parallel import _opt_state_specs
+
+                opt_specs = _opt_state_specs(
+                    opt_state, params, specs,
+                    lambda x: getattr(getattr(x, "sharding", None), "spec", None) or P(),
+                )
+                b_spec = (
+                    batch_spec if not isinstance(batch_spec, P)
+                    else jax.tree.map(lambda _: batch_spec, batch)
+                )
+
+                def core(p_shard, opt_state, batch):
+                    p_shard = pvary_params(p_shard, (ax,))
+
+                    def gathered_loss(ps, b):
+                        if gather == "leaf":
+                            ps = gather_params(ps, dims, ax)
+                        return loss_fn(ps, b)
+
+                    loss, grads = jax.value_and_grad(gathered_loss)(
+                        p_shard, batch)
+                    n = axis_size(ax)
+                    # gathered leaves: the transpose already reduce-
+                    # scattered (SUM over the axis) -> /n for the mean;
+                    # replicated leaves carry raw local grads -> pmean
+                    grads = jax.tree.map(
+                        lambda g, d: (
+                            g / n if d >= 0 else (
+                                jax.lax.pmean(g, _vaxes(g, (ax,)))
+                                if _vaxes(g, (ax,)) else g
+                            )
+                        ),
+                        grads, dims,
+                    )
+                    updates, opt_state = optimizer.update(
+                        grads, opt_state, p_shard)
+                    p_shard = jax.tree.map(
+                        lambda p, u: p + u.astype(p.dtype), p_shard, updates)
+                    lax_ = _vaxes(loss, (ax,))
+                    if lax_:
+                        loss = jax.lax.pmean(loss, lax_)
+                    return p_shard, opt_state, loss
+
+                sm = shard_map(
+                    core,
+                    mesh=mesh,
+                    in_specs=(specs, opt_specs, b_spec),
+                    out_specs=(specs, opt_specs, P()),
+                )
+                compiled[key] = jax.jit(
+                    sm, donate_argnums=(0, 1) if donate else ())
             return compiled[key](params, opt_state, batch)
 
         return jitted

@@ -34,7 +34,14 @@ from ...compat import axis_size
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .tp_utils import gather_from_sp, reduce_from_tp, scatter_to_sp, split_to_sp
+from .tp_utils import (
+    gather_from_sp,
+    reduce_from_tp,
+    ring_ag_matmul,
+    ring_matmul_rs,
+    scatter_to_sp,
+    split_to_sp,
+)
 
 PyTree = Any
 
@@ -108,6 +115,19 @@ class TransformerConfig:
     # the KV-cache decode mask; rejected for the CP impls (a ring shard
     # boundary would silently change the window's reach).
     sliding_window: "int | None" = None
+    # Collective matmul (opt-in, SP mode only): decompose the SP
+    # all-gather ⊕ column-parallel matmul and the row-parallel matmul ⊕
+    # reduce-scatter at the attention/MLP boundaries into ppermute rings
+    # (tp_utils.ring_ag_matmul / ring_matmul_rs) so each chunk transfer
+    # overlaps the previous chunk's partial matmul — the manual
+    # counterpart of XLA's windowed-einsum decomposition
+    # (dist/overlap.py).  Falls back to the fused gather/scatter path
+    # when the gathered activation is smaller than ``cm_min_bytes``
+    # (ring latency — n-1 ppermute hops per boundary — beats the fused
+    # collective only once the payload is bandwidth-bound), when sp is
+    # off, or when the TP axis has size 1.
+    collective_matmul: bool = False
+    cm_min_bytes: int = 1 << 20
 
     def __post_init__(self):
         if self.sliding_window is not None:
@@ -537,6 +557,111 @@ def _close_row_parallel(
     return y + bias
 
 
+# ------------------------------------------------- collective-matmul paths
+# The SP block boundaries rewritten as ppermute rings
+# (tp_utils.ring_ag_matmul / ring_matmul_rs): the entering all-gather is
+# fused with the column-parallel projection (each chunk transfer overlaps
+# the previous chunk's partial matmul) and the closing psum_scatter is
+# fused with the row-parallel matmul.  Opt-in via
+# ``TransformerConfig.collective_matmul``; numerics match the fused path
+# up to summation order (fp32-level reassociation).
+
+
+def _use_cm(cfg: TransformerConfig, x: jnp.ndarray,
+            axis: Optional[str], sp: bool) -> bool:
+    """Static (trace-time) decision: collective matmul only in SP mode on
+    a real TP axis, and only when the gathered activation is big enough
+    that the ring's n-1 extra latency hops pay for themselves."""
+    if not (cfg.collective_matmul and axis is not None and sp):
+        return False
+    n = axis_size(axis)
+    if n <= 1:
+        return False
+    gathered_bytes = x.size * n * jnp.dtype(x.dtype).itemsize
+    return gathered_bytes >= cfg.cm_min_bytes
+
+
+def attention_partial_cm(
+    p: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,
+    cfg: TransformerConfig,
+    axis: str,
+    rope: "tuple | None" = None,
+) -> jnp.ndarray:
+    """Collective-matmul attention on an SP-sharded input.
+
+    x: [B, s_local, D] sequence shard -> [B, s_local, D] FINAL output
+    (TP-reduced into SP layout), WITHOUT the output bias — the ring
+    already performs the row-parallel reduction, so the caller must NOT
+    apply :func:`_close_row_parallel` (only add ``bo``).
+
+    The QKV projection runs inside :func:`ring_ag_matmul` (per-chunk
+    projection overlapped with the next chunk's transfer); attention
+    itself sees the assembled full sequence exactly as the fused path
+    does; the output projection closes through :func:`ring_matmul_rs`.
+    """
+    B, s, D = x.shape
+    hd = cfg.head_dim
+    n = axis_size(axis)
+    S = s * n
+
+    def proj(xc):
+        # chunk [B, sc, D] -> {'q','k','v'}: [B, h, sc, hd] (seq dim 2) —
+        # the head split/transpose is per-sequence-row, so folding it into
+        # the ring mm keeps the assembled output identical to compute_qkv
+        sc = xc.shape[1]
+        if "wqkv" in p:
+            h_loc = p["wqkv"].shape[-1] // hd
+            qkv = dense(xc, p["wqkv"], "bsd,tdh->tbsh") + p["bqkv"][:, None, None, :]
+            f = lambda t: t.reshape(B, sc, h_loc, hd).transpose(0, 2, 1, 3)
+            return {"q": f(qkv[0]), "k": f(qkv[1]), "v": f(qkv[2])}
+        h_loc = p["wq"].shape[-1] // hd
+        hkv_loc, rem = divmod(p["wkv"].shape[-1], hd)
+        if rem or hkv_loc == 0:
+            raise ValueError(
+                f"TP shard holds {p['wkv'].shape[-1]} kv columns = "
+                f"{p['wkv'].shape[-1] / hd:g} heads of dim {hd}; GQA under "
+                f"TP needs kv_heads % tp_size == 0 (whole heads per shard)"
+            )
+        q = (dense(xc, p["wq"]) + p["bq"]).reshape(B, sc, h_loc, hd).transpose(0, 2, 1, 3)
+        kv = dense(xc, p["wkv"], "bsd,tdh->tbsh") + p["bkv"][:, None, None, :]
+        k = kv[0].reshape(B, sc, hkv_loc, hd).transpose(0, 2, 1, 3)
+        v = kv[1].reshape(B, sc, hkv_loc, hd).transpose(0, 2, 1, 3)
+        return {"q": q, "k": k, "v": v}
+
+    qkv = ring_ag_matmul(x, proj, axis, out_seq_dim=2)
+    q, k, v = qkv["q"], qkv["k"], qkv["v"]
+    if cfg.rope:
+        cache = rope if rope is not None else rope_cache(
+            _rope_positions(cfg, S), hd, cfg.rope_theta,
+            scaling=cfg.rope_scaling)
+        q = apply_rope(q, cache=cache)
+        k = apply_rope(k, cache=cache)
+    out = core_attention(q, k, v, cfg)
+    h_loc = q.shape[1]
+    out = out.transpose(0, 2, 1, 3).reshape(B, S, h_loc * hd)
+    return ring_matmul_rs(out, lambda oc: dense(oc, p["wo"]), axis)
+
+
+def mlp_partial_cm(
+    p: Dict[str, jnp.ndarray], x: jnp.ndarray, axis: str
+) -> jnp.ndarray:
+    """Collective-matmul MLP on an SP-sharded input: [B, s_local, D] ->
+    [B, s_local, D] FINAL (TP-reduced into SP layout) WITHOUT ``b2`` —
+    the ring performs the reduction, the caller only adds the bias.  The
+    activation is pointwise per sequence row, so it folds into the ring's
+    chunk function and the hidden [B, S, F] never materializes whole."""
+    if p["w1"].ndim == 3:
+        def mm1(xc):
+            gu = dense(xc, p["w1"], "bsd,tdf->tbsf") + p["b1"][:, None, None, :]
+            return jax.nn.silu(gu[0]) * gu[1]
+    else:
+        def mm1(xc):
+            return jax.nn.gelu(dense(xc, p["w1"]) + p["b1"])
+    h = ring_ag_matmul(x, mm1, axis, out_seq_dim=1)
+    return ring_matmul_rs(h, lambda hc: dense(hc, p["w2"]), axis)
+
+
 def dropout(
     x: jnp.ndarray, rate: float, key: Optional[jax.Array]
 ) -> jnp.ndarray:
@@ -682,16 +807,26 @@ def block_forward(
     k_attn = k_mlp = None
     if dropout_key is not None and cfg.dropout_rate > 0.0:
         k_attn, k_mlp = jax.random.split(dropout_key)
+    use_cm = _use_cm(cfg, x, axis, sp)
     h = layer_norm(x, p["ln1"], cfg.norm_eps)
-    full = gather_from_sp(h, axis) if (axis and sp) else h
-    y = attention_partial(p["attn"], full, cfg, rope=rope)
-    y = _close_row_parallel(y, p["attn"]["bo"], axis, sp)
+    if use_cm:
+        # ring path: gather⊕QKV-matmul and WO-matmul⊕scatter decomposed;
+        # the ring already reduced over TP, so only the bias remains
+        y = attention_partial_cm(p["attn"], h, cfg, axis, rope=rope)
+        y = y + p["attn"]["bo"]
+    else:
+        full = gather_from_sp(h, axis) if (axis and sp) else h
+        y = attention_partial(p["attn"], full, cfg, rope=rope)
+        y = _close_row_parallel(y, p["attn"]["bo"], axis, sp)
     x = x + dropout(y, cfg.dropout_rate, k_attn)
 
     h = layer_norm(x, p["ln2"], cfg.norm_eps)
-    full = gather_from_sp(h, axis) if (axis and sp) else h
-    z = mlp_partial(p["mlp"], full)
-    z = _close_row_parallel(z, p["mlp"]["b2"], axis, sp)
+    if use_cm:
+        z = mlp_partial_cm(p["mlp"], h, axis) + p["mlp"]["b2"]
+    else:
+        full = gather_from_sp(h, axis) if (axis and sp) else h
+        z = mlp_partial(p["mlp"], full)
+        z = _close_row_parallel(z, p["mlp"]["b2"], axis, sp)
     return x + dropout(z, cfg.dropout_rate, k_mlp)
 
 
