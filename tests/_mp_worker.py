@@ -100,3 +100,23 @@ print(
     f"straggler={stats['straggler']} ratio={stats['straggler_ratio']:.2f}",
     flush=True,
 )
+
+# --- resilience consistency guard over the REAL two-process allgather:
+# agreeing fingerprints (step + config + the DP-replicated params, whose
+# per-host local-shard checksums must match) pass on both ranks; a
+# rank-skewed step counter must raise desync_detected on EVERY process
+from torchdistpackage_tpu.obs import default_event_log
+from torchdistpackage_tpu.resilience import check_consistency
+
+agree = check_consistency(step=7, config={"lr": 1e-2}, params=sharded)
+assert agree["ok"] and agree["n_hosts"] == 2, agree
+
+skewed = check_consistency(step=7 + rank, config={"lr": 1e-2})
+assert not skewed["ok"] and skewed["mismatched"] == ["step"], skewed
+desync = default_event_log().of_kind("desync_detected")
+assert len(desync) == 1 and desync[0]["mismatched"] == ["step"], desync
+print(
+    f"rank {rank}: CONSISTENCY ok_hosts={agree['n_hosts']} "
+    f"desync={skewed['mismatched']}",
+    flush=True,
+)

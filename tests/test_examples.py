@@ -38,6 +38,10 @@ OBS_EXAMPLES = {
     # the ZeRO owner-scatter both ledger onto the data axis
     "train_fsdp_offload.py": {"comm": "dp"},
     "train_zero_ema_ckpt.py": {"comm": "dp"},
+    # self-healing loop (PR 4): chaos NaN spike -> rollback -> recovered;
+    # the report must carry the resilience verdict AND the fault/rollback
+    # events on its timeline
+    "train_resilient.py": {"comm": "dp", "resilience": "recovered"},
 }
 
 
@@ -96,6 +100,14 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
             assert val < 1.0
         if probe["counter"] == "moe":
             assert sum(counters["moe"]["expert_tokens"]) > 0
+
+    if probe.get("resilience"):
+        res = report.get("resilience")
+        assert res, (script, "no resilience section")
+        assert res["verdict"] == probe["resilience"], (script, res)
+        assert res["rollbacks"] >= 1 and res["faults_injected"] >= 1, res
+        kinds = {e["kind"] for e in report["events"]}
+        assert {"fault_injected", "rollback"} <= kinds, (script, kinds)
 
     if probe.get("comm"):
         # the comm section must ledger this example's parallelism dimension

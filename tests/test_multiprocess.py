@@ -79,6 +79,11 @@ def test_two_process_mesh_comm_and_dp_parity(devices8):
     for r, out in enumerate(outs):
         assert f"rank {r}: OBS_AGG n_hosts=2 straggler=1" in out, out
 
+    # resilience consistency guard: the agreeing fingerprint passed on the
+    # real 2-process allgather AND the skewed step was flagged on BOTH ranks
+    for r, out in enumerate(outs):
+        assert f"rank {r}: CONSISTENCY ok_hosts=2 desync=['step']" in out, out
+
     # cross-rank loss parity (same global step seen by both processes)
     losses = []
     for r, out in enumerate(outs):

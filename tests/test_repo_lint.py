@@ -1,5 +1,7 @@
-"""Repo lint: no bare ``print(`` / ``time.time()`` in the package, and no
-``os.environ["XLA_FLAGS"]`` writes outside ``dist/overlap.py``.
+"""Repo lint: no bare ``print(`` / ``time.time()`` in the package, no
+``os.environ["XLA_FLAGS"]`` writes outside ``dist/overlap.py``, every
+emitted event kind registered in ``obs.events.EVENT_KINDS``, and no
+unreviewed ``except: pass`` swallowing.
 
 Observability goes through ``utils.logging.master_print`` (rank-gated) or
 an obs sink — a bare print on a 256-host pod is 256 interleaved copies of
@@ -162,6 +164,134 @@ def _repo_python_files():
         p = REPO / name
         if p.exists():
             yield p
+
+
+# ----------------------------------------------------- event-kind registry
+
+# Call sites look like emit_event("kind", ...) / <something>.emit("kind",
+# ...).  A typo'd kind used to vanish silently (the timeline simply never
+# shows it and no assertion ever matches); every literal kind the package
+# emits must therefore appear in obs.events.EVENT_KINDS.
+
+
+def _literal_kinds(node):
+    """Kind string(s) of an emit call's first arg: plain constants and
+    IfExp-of-constants (telemetry's `"compile" if first else "recompile"`);
+    None for dynamic kinds (those are user-supplied passthroughs)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if (
+        isinstance(node, ast.IfExp)
+        and isinstance(node.body, ast.Constant)
+        and isinstance(node.orelse, ast.Constant)
+    ):
+        return [node.body.value, node.orelse.value]
+    return None
+
+
+def _emit_call_kinds(path: pathlib.Path):
+    """(lineno, kind) for every emit_event(...) / *.emit(...) call with a
+    literal kind in the file."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    hits = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        fn = node.func
+        is_emit = (
+            (isinstance(fn, ast.Name) and fn.id == "emit_event")
+            or (isinstance(fn, ast.Attribute) and fn.attr in ("emit", "emit_event"))
+        )
+        if not is_emit:
+            continue
+        kinds = _literal_kinds(node.args[0])
+        if kinds:
+            hits.extend((node.lineno, k) for k in kinds)
+    return hits
+
+
+def test_event_kinds_registered():
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+
+    offenders = {}
+    used = set()
+    for path in sorted(PKG.rglob("*.py")):
+        for lineno, kind in _emit_call_kinds(path):
+            used.add(kind)
+            if kind not in EVENT_KINDS:
+                offenders.setdefault(
+                    str(path.relative_to(PKG)), []).append((lineno, kind))
+    assert not offenders, (
+        "event kinds emitted but missing from obs.events.EVENT_KINDS — "
+        f"typo, or register the new kind: {offenders}"
+    )
+    # and the registry must not rot: every registered kind is emitted
+    # somewhere in the package (a stale entry hides future typos of it)
+    stale = EVENT_KINDS - used
+    assert not stale, f"EVENT_KINDS entries no call site emits: {sorted(stale)}"
+
+
+# ------------------------------------------- silent exception swallowing
+
+# `except: pass` / `except Exception: pass` swallows the very faults the
+# resilience subsystem claims to handle.  Existing sites are pinned below
+# (count per file, EXACT — adding one to an allowlisted file still fails);
+# new code must handle, narrow, or log instead.  Narrow handlers
+# (`except OSError: pass`) are out of scope: suppressing a *specific*
+# expected error is a decision, suppressing everything is a bug magnet.
+
+SWALLOW_ALLOWLIST = {
+    # best-effort telemetry/bench paths: failure to OBSERVE must never
+    # break the run being observed
+    "dist/comm_bench.py": 2,
+    "dist/overlap.py": 3,
+    "obs/exporters.py": 3,
+    "obs/telemetry.py": 4,
+    "obs/trace.py": 1,
+    "parallel/clip.py": 1,
+    "parallel/data_parallel.py": 1,
+    "tools/debug_nan.py": 1,
+    "tools/profiler.py": 2,
+    # the preemption handler: a telemetry failure inside a signal handler
+    # must never break the grace window (intentional, see module)
+    "utils/preemption.py": 1,
+}
+
+
+def _swallowing_handlers(path: pathlib.Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException")
+        )
+        body_is_pass = len(node.body) == 1 and isinstance(node.body[0], ast.Pass)
+        if broad and body_is_pass:
+            hits.append(node.lineno)
+    return hits
+
+
+def test_no_silent_exception_swallowing():
+    offenders = {}
+    for path in sorted(PKG.rglob("*.py")):
+        rel = str(path.relative_to(PKG))
+        lines = _swallowing_handlers(path)
+        if len(lines) != SWALLOW_ALLOWLIST.get(rel, 0):
+            offenders[rel] = {
+                "lines": lines, "allowed": SWALLOW_ALLOWLIST.get(rel, 0)}
+    assert not offenders, (
+        "broad `except: pass` sites drifted from SWALLOW_ALLOWLIST — "
+        "handle/narrow/log the exception, or (for best-effort observability "
+        f"paths only) update the pinned count with a reason: {offenders}"
+    )
+
+
+def test_swallow_allowlist_entries_exist():
+    for rel in SWALLOW_ALLOWLIST:
+        assert (PKG / rel).exists(), f"allowlisted file gone: {rel}"
 
 
 def test_no_direct_xla_flags_writes():

@@ -17,7 +17,7 @@ from .dist import (
     is_using_pp,
 )
 
-_SUBPACKAGES = ("models", "obs", "ops", "parallel", "tools", "utils")
+_SUBPACKAGES = ("models", "obs", "ops", "parallel", "resilience", "tools", "utils")
 
 
 def __getattr__(name: str):

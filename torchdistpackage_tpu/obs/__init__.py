@@ -42,7 +42,13 @@ every device/backend touch is guarded so the CPU sim, a half-initialized
 backend, or an old jax still produce a report instead of a crash.
 """
 
-from .events import EventLog, default_event_log, emit_event, set_default_event_log
+from .events import (
+    EVENT_KINDS,
+    EventLog,
+    default_event_log,
+    emit_event,
+    set_default_event_log,
+)
 from .exporters import (
     JsonlSink,
     MultiSink,
@@ -59,6 +65,7 @@ from .aggregate import (
     step_time_stats,
 )
 from .report import (
+    RESILIENCE_VERDICTS,
     RUNREPORT_SCHEMA,
     default_report_path,
     render_markdown,
@@ -82,6 +89,7 @@ from .trace import (
 )
 
 __all__ = [
+    "EVENT_KINDS",
     "EventLog",
     "default_event_log",
     "emit_event",
@@ -99,6 +107,7 @@ __all__ = [
     "percentiles",
     "pipeline_bubble_fraction",
     "step_time_stats",
+    "RESILIENCE_VERDICTS",
     "RUNREPORT_SCHEMA",
     "default_report_path",
     "render_markdown",

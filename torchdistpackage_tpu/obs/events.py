@@ -7,8 +7,11 @@ JSONL file (when a path/sink is attached), with both wall-clock and
 monotonic timestamps plus the emitting process index — enough to interleave
 events from several hosts after the fact.
 
-Well-known kinds (free-form kinds are fine too; these are what the report
-timeline and tests key on):
+Every kind the package emits is declared in :data:`EVENT_KINDS` below —
+the central registry ``tests/test_repo_lint.py`` checks call sites
+against, so a typo'd kind fails CI instead of silently vanishing from the
+timeline.  (User code may emit free-form kinds; the registry governs the
+package only.)
 
 ==================  =====================================================
 ``run_start/end``   session boundaries (Telemetry emits these)
@@ -16,10 +19,31 @@ timeline and tests key on):
 ``recompile``       a wrapped step saw a NEW input signature — the silent
                     throughput killer Telemetry exists to catch
 ``checkpoint_save`` / ``checkpoint_restore``
-``preemption``      a termination signal arrived (GracefulShutdown)
+``preemption``      a termination signal arrived (GracefulShutdown); the
+                    record carries the grace deadline when configured
 ``nan_watchdog``    a ``nan_guard``-ed function produced non-finite output
 ``loss_scale``      dynamic loss-scale change
 ``straggler``       a host's step time is an outlier (obs.aggregate)
+``decode_cell``     one decode-bench latency cell (tools.decode_bench)
+``overlap_configure``  XLA latency-hiding flag outcome (dist.overlap)
+``xla_trace_start/stop``  scoped jax.profiler capture window (obs.trace)
+==================  =====================================================
+
+Resilience kinds (``torchdistpackage_tpu.resilience``, PR 4):
+
+==================  =====================================================
+``fault_injected``  the chaos harness fired a declared fault
+``ckpt_retry``      a checkpoint I/O attempt failed and is being retried
+``ckpt_quarantine`` a corrupt checkpoint step was renamed aside; resume
+                    walked back to the newest good step
+``rollback``        the self-healing loop rewound to a good checkpoint
+                    after divergence (non-finite / loss-spike)
+``resilience_abort``  retry budget spent — the run aborted cleanly with
+                    a RUNREPORT ``resilience`` verdict
+``hang_suspected`` / ``hang_resolved`` / ``hang_abort``  watchdog
+                    heartbeat-gap escalation
+``desync_detected`` cross-host consistency check found disagreement
+                    (step / config hash / code hash / RNG / param sum)
 ==================  =====================================================
 
 A module-level default log lets deep call sites (signal handlers, debug
@@ -32,7 +56,27 @@ from __future__ import annotations
 import collections
 import datetime
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, FrozenSet, Optional
+
+#: Every event kind the package itself emits.  tests/test_repo_lint.py
+#: AST-scans the package for ``emit_event("...")`` / ``.emit("...")``
+#: call sites and asserts each literal kind appears here — an unregistered
+#: kind is either a typo (the bug this catches) or a new feature that must
+#: document itself by adding a line.
+EVENT_KINDS: FrozenSet[str] = frozenset({
+    # telemetry session
+    "run_start", "run_end", "compile", "recompile",
+    # checkpoint / preemption
+    "checkpoint_save", "checkpoint_restore", "preemption",
+    # numerics + hosts
+    "nan_watchdog", "loss_scale", "straggler",
+    # tools / comm
+    "decode_cell", "overlap_configure", "xla_trace_start", "xla_trace_stop",
+    # resilience (PR 4)
+    "fault_injected", "ckpt_retry", "ckpt_quarantine", "rollback",
+    "resilience_abort", "hang_suspected", "hang_resolved", "hang_abort",
+    "desync_detected",
+})
 
 
 def _process_index() -> int:

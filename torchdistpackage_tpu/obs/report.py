@@ -16,6 +16,9 @@ from typing import Any, Dict, List, Optional
 
 RUNREPORT_SCHEMA = "tdp-runreport/v1"
 
+# the self-healing loop's end states (resilience/loop.py summary verdicts)
+RESILIENCE_VERDICTS = ("clean", "recovered", "preempted", "aborted")
+
 # top-level key -> required python type (None = any); everything Telemetry
 # emits, and everything validate checks.
 _REQUIRED: Dict[str, type] = {
@@ -80,6 +83,14 @@ def validate_runreport(report: Any) -> List[str]:
             errs.append("comm section lacks ledger/verdict")
         elif comm["verdict"] not in ("comm-bound", "compute-bound", "unknown"):
             errs.append(f"comm verdict {comm['verdict']!r} invalid")
+    res = report.get("resilience")
+    if res is not None:  # optional: present when a ResilientLoop drove the run
+        if not isinstance(res, dict):
+            errs.append(f"resilience is {type(res).__name__}, expected dict")
+        elif res.get("verdict") not in RESILIENCE_VERDICTS:
+            errs.append(f"resilience verdict {res.get('verdict')!r} invalid")
+        elif not isinstance(res.get("rollbacks"), int) or res["rollbacks"] < 0:
+            errs.append("resilience.rollbacks missing/negative")
     return errs
 
 
@@ -107,6 +118,11 @@ def render_summary_line(report: Dict[str, Any]) -> str:
         parts.append(
             f"{comm['verdict']}"
             + (f"(comm {frac:.0%})" if isinstance(frac, (int, float)) else ""))
+    res = report.get("resilience")
+    if res and res.get("verdict") and res["verdict"] != "clean":
+        parts.append(
+            f"RESILIENCE={res['verdict']}"
+            f"(rollbacks {res.get('rollbacks', 0)})")
     return "  ".join(parts)
 
 
@@ -223,6 +239,24 @@ def render_markdown(report: Dict[str, Any]) -> str:
                 f"| {dim} | {st['ops']} | {st['bytes']:,} | "
                 + (f"{t * 1e3:.3f} ms |" if isinstance(t, (int, float))
                    else "- |"))
+        L.append("")
+
+    res = report.get("resilience")
+    if res:
+        L.append("## Resilience")
+        L.append("")
+        L.append(f"- verdict: **{res.get('verdict', '?')}**")
+        L.append(f"- rollbacks: {res.get('rollbacks', 0)} "
+                 f"(budget {res.get('max_rollbacks', '?')})")
+        if res.get("faults_injected"):
+            L.append(f"- chaos faults injected: {res['faults_injected']}")
+        if res.get("data_offset"):
+            L.append(f"- data stream advanced by {res['data_offset']} "
+                     f"batch(es) past poisoned windows")
+        if res.get("last_checkpoint") is not None:
+            L.append(f"- last good checkpoint: step {res['last_checkpoint']}")
+        if res.get("hang_suspected"):
+            L.append(f"- watchdog hang episodes: {res['hang_suspected']}")
         L.append("")
 
     counters = report.get("counters", {})

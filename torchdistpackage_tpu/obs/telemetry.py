@@ -204,6 +204,7 @@ class Telemetry:
             set_default_event_log(event_log)
         self.events = event_log
         self.counters: Dict[str, Any] = {}
+        self.resilience: Optional[Dict[str, Any]] = None
         self.history: List[Dict[str, Any]] = []
         self._history_max = history_max
 
@@ -397,6 +398,12 @@ class Telemetry:
         moe=moe_load_stats(...))``."""
         self.counters.update(named)
 
+    def record_resilience(self, summary: Dict[str, Any]) -> None:
+        """Attach the self-healing loop's summary as the report's optional
+        ``resilience`` section (``ResilientLoop.run`` calls this when a
+        Telemetry is wired in; validated by ``validate_runreport``)."""
+        self.resilience = dict(summary)
+
     # ------------------------------------------------------------- finalize
 
     def _steady_steps(self) -> List[Dict[str, Any]]:
@@ -507,6 +514,8 @@ class Telemetry:
             "counters": self.counters,
             "events": self.events.as_list(),
         }
+        if self.resilience is not None:
+            report["resilience"] = self.resilience
         if extra:
             report.update(extra)
         if self._is_master:

@@ -1,0 +1,78 @@
+"""resilience — survive faults instead of merely observing them.
+
+PR 1–3 built the observability to *see* failures (preemption, NaN
+watchdog, straggler events, the comm ledger, RUNREPORT); this subsystem
+is the machinery to *survive* them, plus the chaos harness that proves it:
+
+- :mod:`.chaos` — deterministic, seed-driven fault injection (checkpoint
+  corruption, mid-step SIGTERM, NaN/Inf spikes, per-host stalls, host
+  dropout); every injection is a structured ``fault_injected`` event, so
+  recovery is asserted against the timeline.
+- :mod:`.ckpt_guard` — hardened checkpoint I/O: bounded retry with
+  exponential backoff + jitter, per-checkpoint integrity manifests
+  (file hashes + per-leaf tree spec) written at commit and verified at
+  restore, quarantine-and-fall-back for checkpoints that fail.
+- :mod:`.loop` — :class:`ResilientLoop`, the self-healing driver:
+  divergence monitor (non-finite / loss-spike z-score) → rollback to the
+  last good checkpoint → advance the data stream past the poisoned
+  window → clean abort with a RUNREPORT verdict once the retry budget is
+  spent.  Exact-trajectory parity with an unfaulted run when no fault
+  fires.
+- :mod:`.watchdog` — heartbeat hang detection (``hang_suspected`` →
+  configurable hard abort so the babysitter can relaunch) and cross-host
+  consistency guards (step / config hash / code hash / RNG / param
+  checksum agreement via one small allgather → ``desync_detected``).
+
+Like ``obs``, this package imports the rest of the repo lazily where
+possible so the chaos/verification helpers stay usable from lightweight
+tooling.
+"""
+
+from .chaos import FAULT_KINDS, ChaosMonkey, Fault, corrupt_checkpoint
+from .ckpt_guard import (
+    CheckpointCorruptError,
+    GuardedCheckpointManager,
+    manifest_path,
+    quarantine_checkpoint,
+    quarantine_dir,
+    tree_spec,
+    verify_checkpoint,
+    verify_template,
+    with_retries,
+    write_manifest,
+)
+from .loop import DivergenceMonitor, LoopResult, ResilientLoop
+from .watchdog import (
+    Watchdog,
+    check_consistency,
+    code_fingerprint,
+    config_fingerprint,
+    consistency_fingerprint,
+    param_checksum,
+)
+
+__all__ = [
+    "FAULT_KINDS",
+    "ChaosMonkey",
+    "Fault",
+    "corrupt_checkpoint",
+    "CheckpointCorruptError",
+    "GuardedCheckpointManager",
+    "manifest_path",
+    "quarantine_checkpoint",
+    "quarantine_dir",
+    "tree_spec",
+    "verify_checkpoint",
+    "verify_template",
+    "with_retries",
+    "write_manifest",
+    "DivergenceMonitor",
+    "LoopResult",
+    "ResilientLoop",
+    "Watchdog",
+    "check_consistency",
+    "code_fingerprint",
+    "config_fingerprint",
+    "consistency_fingerprint",
+    "param_checksum",
+]

@@ -1,0 +1,243 @@
+"""Deterministic fault injection — the chaos harness recovery is proven with.
+
+The reference has no failure testing at all: its babysitter
+(``tools/slurm_job_monitor.py``) relaunches dead jobs but nothing ever
+*creates* a dead job on purpose, so the recovery path ships untested.  At
+pod scale worker failure is the steady state, so every recovery claim in
+:mod:`..resilience` is asserted against faults injected here — in the CPU
+sim and the multiprocess test worker, never by waiting for real hardware
+to break.
+
+Faults are **declared up front** (a list of :class:`Fault` records) and
+**seed-driven** (byte positions for checkpoint bit-flips come from a
+``random.Random(seed)``), so a failing chaos run replays exactly.  Every
+injection lands on the obs timeline as a structured ``fault_injected``
+event — tests assert recovery *against the timeline*, not against prints.
+
+Supported fault kinds (``Fault.kind``):
+
+==================  ====================================================
+``ckpt_corrupt``    truncate or bit-flip a data file of a *committed*
+                    checkpoint step (the failure Orbax's atomic-commit
+                    markers cannot catch: the commit succeeded, the bytes
+                    rotted afterwards)
+``sigterm``         deliver a real SIGTERM to this process before the
+                    step runs (preemption mid-run)
+``nan_spike``       poison the step's loss (or a grad tree) with
+                    NaN/Inf at a chosen step — the divergence the
+                    :class:`~.loop.ResilientLoop` monitor must catch
+``stall``           sleep ``duration_s`` before the step — an artificial
+                    straggler / hung-host window for the
+                    :class:`~.watchdog.Watchdog` to detect
+``host_dropout``    hard-exit this process (``os._exit``) — the host
+                    simply vanishes, as a real failed worker does
+==================  ====================================================
+
+Usage::
+
+    chaos = ChaosMonkey(faults=[Fault("nan_spike", step=5)], seed=0)
+    loop = ResilientLoop(step_fn, make_batch, mgr, total_steps=10,
+                         chaos=chaos)
+
+A :class:`ChaosMonkey` with no faults (or ``enabled=False``) is inert:
+``before_step`` and ``perturb_loss`` are pure pass-throughs, so a run
+with the harness armed but no fault fired is bit-identical to a run
+without it (asserted in ``tests/test_resilience.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import signal
+import time
+from typing import Any, List, Optional, Sequence
+
+FAULT_KINDS = ("ckpt_corrupt", "sigterm", "nan_spike", "stall", "host_dropout")
+
+
+@dataclasses.dataclass
+class Fault:
+    """One declared fault.  ``step`` is the loop step it fires at (before
+    the step's computation, except ``nan_spike`` which poisons the step's
+    outputs).  Each fault fires once unless ``repeat=True`` — a repeating
+    ``nan_spike`` models a *persistently* diverged trajectory, which is how
+    the retry budget is exhausted in tests."""
+
+    kind: str
+    step: int
+    mode: str = "truncate"            # ckpt_corrupt: "truncate" | "bitflip"
+    value: float = float("nan")       # nan_spike: injected value (inf works)
+    duration_s: float = 0.0           # stall: sleep length
+    process: Optional[int] = None     # restrict to one host (None = all)
+    target_step: Optional[int] = None  # ckpt_corrupt: ckpt step (None = latest)
+    exit_code: int = 42               # host_dropout
+    repeat: bool = False
+    fired: int = dataclasses.field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; one of {FAULT_KINDS}")
+
+
+def _data_files(step_dir: str) -> List[str]:
+    """All regular files of a checkpoint step, largest first — corrupting
+    the largest data file guarantees we hit array bytes, not a marker."""
+    out = []
+    for root, _dirs, files in os.walk(step_dir):
+        for f in files:
+            out.append(os.path.join(root, f))
+    out.sort(key=lambda p: (-os.path.getsize(p), p))
+    return out
+
+
+def corrupt_checkpoint(
+    directory: str,
+    step: Optional[int] = None,
+    mode: str = "truncate",
+    rng: Optional[random.Random] = None,
+) -> str:
+    """Corrupt a committed checkpoint under ``directory`` (a
+    ``CheckpointManager`` root): truncate the largest data file of ``step``
+    to half, or flip one byte at a seed-chosen offset.  Returns the path of
+    the file corrupted.  ``step=None`` targets the newest step."""
+    rng = rng or random.Random(0)
+    steps = sorted(
+        int(d) for d in os.listdir(directory)
+        if d.isdigit() and os.path.isdir(os.path.join(directory, d))
+    )
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint steps under {directory}")
+    step = steps[-1] if step is None else int(step)
+    step_dir = os.path.join(directory, str(step))
+    files = _data_files(step_dir)
+    if not files:
+        raise FileNotFoundError(f"checkpoint step {step} has no files")
+    victim = files[0]
+    size = os.path.getsize(victim)
+    if mode == "truncate":
+        with open(victim, "r+b") as f:
+            f.truncate(max(0, size // 2))
+    elif mode == "bitflip":
+        pos = rng.randrange(max(1, size))
+        with open(victim, "r+b") as f:
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([(b[0] if b else 0) ^ 0xFF]))
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    from ..obs.events import emit_event
+
+    emit_event(
+        "fault_injected", fault="ckpt_corrupt", target_step=step, mode=mode,
+        file=os.path.relpath(victim, directory),
+    )
+    return victim
+
+
+class ChaosMonkey:
+    """Drives the declared fault plan against a training loop.
+
+    The :class:`~.loop.ResilientLoop` calls :meth:`before_step` at the top
+    of each iteration and passes the fetched loss through
+    :meth:`perturb_loss`; custom loops can do the same, plus
+    :meth:`perturb_grads` for grad-tree injection.  ``ckpt_dir`` names the
+    checkpoint root ``ckpt_corrupt`` faults operate on (the loop wires its
+    manager's directory in automatically).
+    """
+
+    def __init__(
+        self,
+        faults: Sequence[Fault] = (),
+        seed: int = 0,
+        ckpt_dir: Optional[str] = None,
+        enabled: bool = True,
+    ) -> None:
+        self.faults = [dataclasses.replace(f) for f in faults]
+        self.rng = random.Random(seed)
+        self.ckpt_dir = ckpt_dir
+        self.enabled = enabled
+
+    # ------------------------------------------------------------- plumbing
+
+    @property
+    def fired_count(self) -> int:
+        return sum(f.fired for f in self.faults)
+
+    def _due(self, step: int, kinds: Sequence[str]) -> List[Fault]:
+        if not self.enabled:
+            return []
+        try:
+            import jax
+
+            proc = int(jax.process_index())
+        except Exception:  # backend not up: single-process semantics
+            proc = 0
+        out = []
+        for f in self.faults:
+            if f.kind not in kinds or f.step != step:
+                continue
+            if f.fired and not f.repeat:
+                continue
+            if f.process is not None and f.process != proc:
+                continue
+            out.append(f)
+        return out
+
+    def _emit(self, fault: Fault, **extra: Any) -> None:
+        fault.fired += 1
+        from ..obs.events import emit_event
+
+        emit_event("fault_injected", fault=fault.kind, step=fault.step, **extra)
+
+    # ------------------------------------------------------------ injectors
+
+    def before_step(self, step: int) -> None:
+        """Fire pre-step faults due at ``step``: stall, checkpoint
+        corruption, SIGTERM, host dropout (in that order — a stall that
+        precedes a SIGTERM models the common 'hung then reclaimed' event)."""
+        for f in self._due(step, ("stall",)):
+            self._emit(f, duration_s=f.duration_s)
+            time.sleep(f.duration_s)
+        for f in self._due(step, ("ckpt_corrupt",)):
+            if self.ckpt_dir is None:
+                raise RuntimeError("ckpt_corrupt fault needs ChaosMonkey(ckpt_dir=...)")
+            f.fired += 1  # corrupt_checkpoint emits the event itself
+            corrupt_checkpoint(
+                self.ckpt_dir, step=f.target_step, mode=f.mode, rng=self.rng)
+        for f in self._due(step, ("sigterm",)):
+            self._emit(f)
+            os.kill(os.getpid(), signal.SIGTERM)
+        for f in self._due(step, ("host_dropout",)):
+            self._emit(f, exit_code=f.exit_code)
+            os._exit(f.exit_code)
+
+    def perturb_loss(self, step: int, loss: float) -> float:
+        """Poison the step's (host-fetched) loss when a ``nan_spike`` is
+        due — the cheap deterministic stand-in for a diverged device step:
+        the loop must discard the step's outputs and roll back either way."""
+        for f in self._due(step, ("nan_spike",)):
+            self._emit(f, value=repr(f.value), target="loss")
+            loss = f.value
+        return loss
+
+    def perturb_grads(self, step: int, grads: Any) -> Any:
+        """Poison every leaf of a grad pytree when a ``nan_spike`` is due —
+        for custom loops that hand grads to their optimizer themselves."""
+        due = self._due(step, ("nan_spike",))
+        if not due:
+            return grads
+        import jax
+        import jax.numpy as jnp
+
+        for f in due:
+            self._emit(f, value=repr(f.value), target="grads")
+            grads = jax.tree.map(
+                lambda g: jnp.full_like(g, f.value)
+                if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.floating)
+                else g,
+                grads,
+            )
+        return grads

@@ -1,0 +1,389 @@
+"""Hardened checkpoint I/O: retries, integrity manifests, quarantine.
+
+Orbax's atomic-commit markers protect against *interrupted* saves (a
+half-written step is never listed), but nothing protects against a
+*committed* checkpoint whose bytes rot afterwards — a flaky FUSE mount, a
+truncated object-store upload, a bad disk.  Today that surfaces as an
+opaque deserialization crash at restore time, hours after the damage, and
+the run is dead even though an older good checkpoint sits right next to
+the bad one.  This module closes that gap three ways:
+
+- :func:`with_retries` — bounded retry with exponential backoff + jitter
+  around transient I/O errors (each attempt lands as a ``ckpt_retry``
+  event, so flaky storage is *visible* in the RUNREPORT timeline, not
+  silently absorbed).
+- **Integrity manifests** — at commit, :func:`write_manifest` records the
+  checkpoint's file list (size + SHA-256 each) plus the state's per-leaf
+  tree structure / shapes / dtypes under ``<dir>/manifests/<step>.json``
+  (outside the step dir, so Orbax's layout is untouched).
+  :func:`verify_checkpoint` re-hashes at restore; any mismatch is caught
+  *before* deserialization.
+- **Quarantine + fall-back** — :func:`quarantine_checkpoint` renames a bad
+  step aside (``<dir>.quarantine/<step>``) and emits ``ckpt_quarantine``;
+  :func:`~..utils.checkpoint.auto_resume` walks back to the newest step
+  that verifies AND restores, so a corrupted latest checkpoint costs one
+  save interval instead of the run.
+
+:class:`GuardedCheckpointManager` composes all three over the existing
+:class:`~..utils.checkpoint.CheckpointManager` — same API, hardened I/O.
+Async saves keep their manifest honest: the manifest is written only after
+``wait_until_finished`` proves the step committed (pending steps are
+flushed at the next save / wait / exit).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.checkpoint import CheckpointManager, PyTree
+
+MANIFEST_DIRNAME = "manifests"
+MANIFEST_SCHEMA = "tdp-ckpt-manifest/v1"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification."""
+
+
+# ------------------------------------------------------------------ retries
+
+
+def with_retries(
+    fn: Callable[[], Any],
+    retries: int = 3,
+    base_delay_s: float = 0.05,
+    max_delay_s: float = 2.0,
+    jitter: float = 0.5,
+    retry_on: Tuple[type, ...] = (OSError,),
+    label: str = "ckpt",
+    rng: Optional[random.Random] = None,
+) -> Any:
+    """Call ``fn()``; on a ``retry_on`` exception retry up to ``retries``
+    times with exponential backoff (``base * 2**attempt``, capped, plus
+    uniform jitter so a pod's hosts don't hammer storage in lockstep).
+    Every retry emits a ``ckpt_retry`` event; the last failure re-raises.
+    """
+    rng = rng or random.Random()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt >= retries:
+                raise
+            delay = min(max_delay_s, base_delay_s * (2 ** attempt))
+            delay += delay * jitter * rng.random()
+            from ..obs.events import emit_event
+
+            emit_event(
+                "ckpt_retry", label=label, attempt=attempt + 1,
+                retries=retries, delay_s=round(delay, 4), error=repr(e),
+            )
+            time.sleep(delay)
+            attempt += 1
+
+
+# ---------------------------------------------------------------- manifests
+
+
+def _sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def tree_spec(state: PyTree) -> List[Dict[str, Any]]:
+    """Per-leaf structure record (path, shape, dtype) — the cheap half of
+    the manifest, checked against the restore template so a template/ckpt
+    structure drift fails loudly instead of restoring garbage."""
+    import jax
+
+    out = []
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(state)[0]
+    for path, leaf in leaves_with_paths:
+        out.append({
+            "path": jax.tree_util.keystr(path),
+            "shape": list(np.shape(leaf)),
+            "dtype": str(getattr(leaf, "dtype", np.asarray(leaf).dtype)),
+        })
+    return out
+
+
+def manifest_path(directory: str, step: int) -> str:
+    return os.path.join(directory, MANIFEST_DIRNAME, f"{int(step)}.json")
+
+
+def write_manifest(
+    directory: str, step: int, state: Optional[PyTree] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Hash every file of committed step ``step`` under ``directory`` into
+    ``<directory>/manifests/<step>.json`` (atomic tmp+rename write).  Call
+    only after the save committed (``wait_until_finished``)."""
+    step_dir = os.path.join(directory, str(int(step)))
+    if not os.path.isdir(step_dir):
+        raise FileNotFoundError(f"checkpoint step dir missing: {step_dir}")
+    files = []
+    for root, _dirs, names in os.walk(step_dir):
+        for name in sorted(names):
+            p = os.path.join(root, name)
+            files.append({
+                "path": os.path.relpath(p, step_dir),
+                "size": os.path.getsize(p),
+                "sha256": _sha256(p),
+            })
+    files.sort(key=lambda f: f["path"])
+    manifest: Dict[str, Any] = {
+        "schema": MANIFEST_SCHEMA,
+        "step": int(step),
+        "n_files": len(files),
+        "files": files,
+    }
+    if state is not None:
+        manifest["tree"] = tree_spec(state)
+    if extra:
+        manifest.update(extra)
+    mpath = manifest_path(directory, step)
+    os.makedirs(os.path.dirname(mpath), exist_ok=True)
+    tmp = mpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, mpath)
+    return manifest
+
+
+def verify_checkpoint(directory: str, step: int) -> List[str]:
+    """Problems with committed step ``step`` (empty list = verified OK).
+
+    A checkpoint without a manifest (written before the guard existed)
+    returns ``[]`` — it cannot be *proven* good, but back-compat demands it
+    not be condemned either; a restore failure still triggers the
+    auto_resume walk-back.  With a manifest: every recorded file must
+    exist with matching size and SHA-256, and no unrecorded file may have
+    appeared in its place.
+    """
+    mpath = manifest_path(directory, step)
+    if not os.path.exists(mpath):
+        return []
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"manifest unreadable: {e!r}"]
+    step_dir = os.path.join(directory, str(int(step)))
+    if not os.path.isdir(step_dir):
+        return [f"step dir missing: {step_dir}"]
+    problems: List[str] = []
+    on_disk = set()
+    for root, _dirs, names in os.walk(step_dir):
+        for name in names:
+            on_disk.add(os.path.relpath(os.path.join(root, name), step_dir))
+    for rec in manifest.get("files", []):
+        rel = rec["path"]
+        p = os.path.join(step_dir, rel)
+        if rel not in on_disk:
+            problems.append(f"missing file: {rel}")
+            continue
+        size = os.path.getsize(p)
+        if size != rec["size"]:
+            problems.append(f"size mismatch: {rel} ({size} != {rec['size']})")
+            continue  # hash would fail too; one precise problem per file
+        if _sha256(p) != rec["sha256"]:
+            problems.append(f"checksum mismatch: {rel}")
+    for rel in sorted(on_disk - {r["path"] for r in manifest.get("files", [])}):
+        problems.append(f"unrecorded file: {rel}")
+    return problems
+
+
+def verify_template(
+    directory: str, step: int, template: PyTree,
+) -> List[str]:
+    """Structure check: the manifest's recorded tree (when present) must
+    match ``template``'s paths/shapes/dtypes — catches restoring into a
+    model that drifted since the save."""
+    mpath = manifest_path(directory, step)
+    if not os.path.exists(mpath):
+        return []
+    with open(mpath) as f:
+        manifest = json.load(f)
+    recorded = manifest.get("tree")
+    if not recorded:
+        return []
+    want = {r["path"]: (r["shape"], r["dtype"]) for r in recorded}
+    have = {r["path"]: (r["shape"], r["dtype"]) for r in tree_spec(template)}
+    problems = []
+    for p in sorted(set(want) - set(have)):
+        problems.append(f"template lacks leaf {p}")
+    for p in sorted(set(have) - set(want)):
+        problems.append(f"checkpoint lacks leaf {p}")
+    for p in sorted(set(want) & set(have)):
+        if want[p] != have[p]:
+            problems.append(f"leaf {p}: ckpt {want[p]} vs template {have[p]}")
+    return problems
+
+
+# --------------------------------------------------------------- quarantine
+
+
+def quarantine_dir(directory: str) -> str:
+    return directory.rstrip(os.sep) + ".quarantine"
+
+
+def quarantine_checkpoint(
+    directory: str, step: int, reason: str = "",
+) -> Optional[str]:
+    """Rename bad step ``step`` aside to ``<directory>.quarantine/<step>``
+    (kept for post-mortem, invisible to the manager) and emit a
+    ``ckpt_quarantine`` event.  Returns the new path (None if the step dir
+    is already gone)."""
+    step_dir = os.path.join(directory, str(int(step)))
+    dest = None
+    if os.path.isdir(step_dir):
+        qdir = quarantine_dir(directory)
+        os.makedirs(qdir, exist_ok=True)
+        dest = os.path.join(qdir, str(int(step)))
+        if os.path.exists(dest):  # re-quarantine of a recycled step number
+            dest = f"{dest}.{int(time.perf_counter() * 1e6)}"
+        try:
+            os.replace(step_dir, dest)
+            mpath = manifest_path(directory, step)
+            if os.path.exists(mpath):
+                os.replace(mpath, os.path.join(qdir, os.path.basename(mpath)))
+        except FileNotFoundError:
+            # another host of the pod quarantined it first — same outcome
+            dest = None
+    from ..obs.events import emit_event
+
+    emit_event(
+        "ckpt_quarantine", step=int(step), directory=str(directory),
+        quarantined_to=dest, reason=reason[:500],
+    )
+    return dest
+
+
+# ------------------------------------------------------- guarded manager
+
+
+class GuardedCheckpointManager(CheckpointManager):
+    """Drop-in :class:`~..utils.checkpoint.CheckpointManager` with the
+    hardened I/O path: retried saves/restores, integrity manifests at
+    commit, verification (+ quarantine via ``auto_resume``) at restore.
+
+    ::
+
+        with GuardedCheckpointManager(dir, max_to_keep=3) as mgr:
+            mgr.save(step, state)              # retried; manifest at commit
+            ...
+            start, state = auto_resume(mgr, template)   # walks back past
+                                                        # corrupt steps
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+        retries: int = 3,
+        base_delay_s: float = 0.05,
+        verify_on_restore: bool = True,
+    ) -> None:
+        super().__init__(directory, max_to_keep=max_to_keep,
+                         save_interval_steps=save_interval_steps)
+        self.retries = retries
+        self.base_delay_s = base_delay_s
+        self.verify_on_restore = verify_on_restore
+        self._pending_manifests: Dict[int, Optional[List[Dict[str, Any]]]] = {}
+
+    # -- manifest bookkeeping ------------------------------------------
+
+    def _flush_manifests(self) -> None:
+        """Write manifests for every pending step that has committed (and
+        survived retention).  Called after ``wait_until_finished``."""
+        if not self._pending_manifests:
+            return
+        from ..obs.events import _process_index
+
+        if _process_index() != 0:
+            # every host shares one manifest on the (shared) ckpt fs; only
+            # the master writes it, every host verifies against it
+            self._pending_manifests.clear()
+            return
+        live = set(self.all_steps())
+        for step, spec in sorted(self._pending_manifests.items()):
+            if step in live:
+                extra = {"tree": spec} if spec is not None else None
+                with_retries(
+                    lambda s=step, e=extra: write_manifest(
+                        self.directory, s, extra=e),
+                    retries=self.retries, base_delay_s=self.base_delay_s,
+                    label="manifest",
+                )
+        self._pending_manifests.clear()
+
+    # -- hardened API --------------------------------------------------
+
+    def save(self, step: int, state: PyTree, wait: bool = False) -> bool:
+        # the previous async save has committed by the time a new one is
+        # accepted, so flushing here costs (almost) no extra waiting
+        self.wait_until_finished()
+        saved = with_retries(
+            lambda: CheckpointManager.save(self, step, state, wait=False),
+            retries=self.retries, base_delay_s=self.base_delay_s, label="save",
+        )
+        if saved:
+            # tree spec is captured NOW (shapes/dtypes are host metadata —
+            # no device sync); file hashes wait for the commit
+            self._pending_manifests[int(step)] = tree_spec(state)
+        if wait:
+            self.wait_until_finished()
+        return saved
+
+    def restore(
+        self,
+        step: Optional[int] = None,
+        template: Optional[PyTree] = None,
+        mesh: Optional[Any] = None,
+        specs: Optional[PyTree] = None,
+    ) -> PyTree:
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if self.verify_on_restore:
+            problems = verify_checkpoint(self.directory, step)
+            if not problems and template is not None:
+                problems = verify_template(self.directory, step, template)
+            if problems:
+                raise CheckpointCorruptError(
+                    f"checkpoint step {step} failed verification: "
+                    + "; ".join(problems[:5])
+                    + (f" (+{len(problems) - 5} more)" if len(problems) > 5 else "")
+                )
+        return with_retries(
+            lambda: CheckpointManager.restore(
+                self, step, template=template, mesh=mesh, specs=specs),
+            retries=self.retries, base_delay_s=self.base_delay_s,
+            label="restore", retry_on=(OSError,),
+        )
+
+    def wait_until_finished(self) -> None:
+        super().wait_until_finished()
+        self._flush_manifests()
+
+    def close(self) -> None:
+        try:
+            self.wait_until_finished()
+        finally:
+            super().close()

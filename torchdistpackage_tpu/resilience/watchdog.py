@@ -1,0 +1,315 @@
+"""Hang detection + cross-host consistency guards.
+
+Two failure modes no amount of checkpointing fixes, because the run never
+*crashes* — it just stops making progress or silently computes the wrong
+thing:
+
+- **Hangs**: a collective whose participant died blocks forever (the
+  default ICI/DCN timeout is minutes-to-infinite); the SLURM babysitter
+  sees a live process and never relaunches.  :class:`Watchdog` runs a
+  daemon heartbeat thread: the loop calls :meth:`Watchdog.beat` each step,
+  and a beat gap over ``timeout_s`` escalates ``hang_suspected`` →
+  (optionally, after a further grace) a hard ``os._exit`` so the
+  babysitter *can* relaunch.
+- **Silent desync**: replicas that should be bit-identical drift apart
+  (a host loaded stale code, a data loader double-served a shard, a
+  collective was dropped) and training continues producing garbage.
+  :func:`check_consistency` allgathers a cheap per-host fingerprint —
+  step counter, config hash, code hash, RNG key, a low-cost param-tree
+  checksum — and turns any disagreement into a loud ``desync_detected``
+  event.  Run it at startup and every N steps
+  (``ResilientLoop(consistency_every=N)``).
+
+Both guards are collective-free on single-process runs and cost one small
+``process_allgather`` per check on pods.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+# --------------------------------------------------------------- watchdog
+
+
+class Watchdog:
+    """Heartbeat-gap detector (daemon thread; context manager).
+
+    ::
+
+        with Watchdog(timeout_s=300, abort=True) as dog:
+            for step in range(start, total):
+                dog.beat(step)
+                ...
+
+    - gap > ``timeout_s``    → ``hang_suspected`` event (once per episode)
+    - beat arrives after one → ``hang_resolved`` event
+    - gap > ``timeout_s + abort_grace_s`` with ``abort=True`` →
+      ``hang_abort`` event then ``os._exit(exit_code)`` — the process
+      must *die*, not unwind: the stuck collective would swallow any
+      exception, and the babysitter's relaunch is the recovery.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float = 300.0,
+        poll_s: Optional[float] = None,
+        abort: bool = False,
+        abort_grace_s: Optional[float] = None,
+        exit_code: int = 87,
+        _exit: Optional[Callable[[int], None]] = None,  # test seam
+    ) -> None:
+        self.timeout_s = float(timeout_s)
+        self.poll_s = poll_s if poll_s is not None else max(0.05, timeout_s / 4.0)
+        self.abort = abort
+        self.abort_grace_s = (
+            float(abort_grace_s) if abort_grace_s is not None else self.timeout_s
+        )
+        self.exit_code = exit_code
+        self._exit = _exit or os._exit
+        self._last_beat = time.perf_counter()
+        self._last_step: Optional[int] = None
+        self._suspected = False
+        self._stalled_since = self._last_beat
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.n_suspected = 0
+
+    def beat(self, step: Optional[int] = None) -> None:
+        """The loop is alive; call once per iteration (thread-safe)."""
+        self._last_beat = time.perf_counter()
+        if step is not None:
+            self._last_step = int(step)
+
+    def start(self) -> "Watchdog":
+        if self._thread is not None:
+            return self
+        self._last_beat = time.perf_counter()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._watch, name="tdp-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _watch(self) -> None:
+        from ..obs.events import emit_event
+
+        while not self._stop.wait(self.poll_s):
+            age = time.perf_counter() - self._last_beat
+            if not self._suspected and age > self.timeout_s:
+                self._suspected = True
+                self.n_suspected += 1
+                self._stalled_since = self._last_beat
+                emit_event(
+                    "hang_suspected", age_s=round(age, 3),
+                    timeout_s=self.timeout_s, last_step=self._last_step,
+                    will_abort=self.abort,
+                )
+            elif self._suspected and age <= self.timeout_s:
+                self._suspected = False
+                emit_event(
+                    "hang_resolved", last_step=self._last_step,
+                    stalled_for_s=round(self._last_beat - self._stalled_since, 3),
+                )
+            if (
+                self.abort and self._suspected
+                and age > self.timeout_s + self.abort_grace_s
+            ):
+                emit_event(
+                    "hang_abort", age_s=round(age, 3),
+                    last_step=self._last_step, exit_code=self.exit_code,
+                )
+                self._exit(self.exit_code)
+                return  # only reached with an injected test _exit
+
+
+# ----------------------------------------------------- consistency guards
+
+
+def config_fingerprint(obj: Any) -> str:
+    """Stable SHA-256 of any config-ish object (dict/dataclass/str) — the
+    cross-host 'are we even running the same experiment' check."""
+    try:
+        blob = json.dumps(obj, sort_keys=True, default=repr)
+    except TypeError:
+        blob = repr(obj)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def code_fingerprint(root: Optional[str] = None) -> str:
+    """SHA-256 over the package's ``.py`` sources (sorted relpath +
+    contents) — catches a host running stale code after a partial deploy.
+    Computed once per process and cached (~70 small files)."""
+    global _CODE_FP
+    if root is None and _CODE_FP is not None:
+        return _CODE_FP
+    base = root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.sha256()
+    paths = []
+    for dirpath, _dirs, files in os.walk(base):
+        if "__pycache__" in dirpath:
+            continue
+        for f in files:
+            if f.endswith(".py"):
+                paths.append(os.path.join(dirpath, f))
+    for p in sorted(paths):
+        h.update(os.path.relpath(p, base).encode())
+        with open(p, "rb") as f:
+            h.update(f.read())
+    digest = h.hexdigest()
+    if root is None:
+        _CODE_FP = digest
+    return digest
+
+
+_CODE_FP: Optional[str] = None
+
+
+def param_checksum(tree: Any) -> float:
+    """Low-cost host-side checksum of a param pytree: sum of ``|x|`` over
+    every leaf's *locally addressable* shards.  On symmetric meshes (every
+    host holds the same shard layout) in-sync hosts produce bit-identical
+    sums; a replica whose weights drifted produces a different one.  Not a
+    cryptographic digest — a cheap tripwire run every N steps."""
+    import jax
+
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "addressable_shards"):
+            for sh in leaf.addressable_shards:
+                total += float(np.sum(np.abs(np.asarray(sh.data, np.float64))))
+        elif hasattr(leaf, "dtype") or np.isscalar(leaf):
+            total += float(np.sum(np.abs(np.asarray(leaf, np.float64))))
+    return total
+
+
+def _hash_parts(hexdigest: str) -> List[float]:
+    """Two 16-bit chunks of a hash, exactly representable in float32 (the
+    allgather dtype) — one chunk alone would collide too easily."""
+    v = int(hexdigest[:16], 16)
+    return [float(v % 65521), float((v // 65521) % 65521)]
+
+
+def consistency_fingerprint(
+    step: Optional[int] = None,
+    config: Any = None,
+    params: Any = None,
+    rng_key: Any = None,
+    code: bool = False,
+) -> "tuple[List[str], List[float]]":
+    """(labels, values) — the per-host vector :func:`check_consistency`
+    allgathers.  Only the components you pass are included, so the check
+    costs exactly what you ask for (``params=`` walks the local shards;
+    ``code=True`` hashes the package sources once per process)."""
+    labels: List[str] = []
+    values: List[float] = []
+    if step is not None:
+        labels.append("step")
+        values.append(float(int(step)))
+    if config is not None:
+        labels += ["config_a", "config_b"]
+        values += _hash_parts(config_fingerprint(config))
+    if code:
+        labels += ["code_a", "code_b"]
+        values += _hash_parts(code_fingerprint())
+    if rng_key is not None:
+        labels.append("rng")
+        try:
+            import jax
+
+            data = jax.random.key_data(rng_key)
+        except (AttributeError, TypeError):
+            data = rng_key
+        values.append(float(np.asarray(data, np.float64).sum()))
+    if params is not None:
+        labels.append("params")
+        values.append(param_checksum(params))
+    return labels, values
+
+
+def check_consistency(
+    step: Optional[int] = None,
+    config: Any = None,
+    params: Any = None,
+    rng_key: Any = None,
+    code: bool = False,
+    event_log=None,
+    _gathered: Optional[np.ndarray] = None,
+) -> Dict[str, Any]:
+    """Cross-host agreement check; **collective** — call on every process.
+
+    Returns ``{"ok", "n_hosts", "labels", "mismatched", "per_host"}``.  Any
+    component on which hosts disagree lands in ``mismatched`` and emits one
+    ``desync_detected`` event (on ``event_log`` or the process default)
+    carrying the per-host values — silent desync becomes a loud artifact.
+
+    ``_gathered`` is a test seam: a pre-gathered ``(n_hosts, n_components)``
+    array standing in for the ``process_allgather``.
+    """
+    labels, values = consistency_fingerprint(
+        step=step, config=config, params=params, rng_key=rng_key, code=code)
+    if not labels:
+        raise ValueError("check_consistency: nothing to check "
+                         "(pass step/config/params/rng_key/code)")
+    if _gathered is not None:
+        gathered = np.asarray(_gathered, np.float64).reshape(-1, len(labels))
+    else:
+        try:
+            import jax
+
+            n_proc = jax.process_count()
+        except Exception:  # backend not up: single-host semantics
+            n_proc = 1
+        if n_proc <= 1:
+            gathered = np.asarray([values], np.float64)
+        else:
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
+
+            gathered = np.asarray(
+                multihost_utils.process_allgather(
+                    jnp.asarray(values, jnp.float32))
+            ).reshape(n_proc, len(labels)).astype(np.float64)
+
+    mismatched = [
+        labels[i] for i in range(len(labels))
+        if not np.all(gathered[:, i] == gathered[0, i])
+    ]
+    out = {
+        "ok": not mismatched,
+        "n_hosts": int(gathered.shape[0]),
+        "labels": labels,
+        "mismatched": mismatched,
+        "per_host": gathered.tolist(),
+    }
+    if mismatched:
+        from ..obs.events import default_event_log
+
+        (event_log or default_event_log()).emit(
+            "desync_detected",
+            step=step,
+            mismatched=mismatched,
+            per_host={
+                lab: [gathered[h, labels.index(lab)] for h in range(out["n_hosts"])]
+                for lab in mismatched
+            },
+        )
+    return out

@@ -129,23 +129,51 @@ def auto_resume(
     template: PyTree,
     mesh: Optional[Mesh] = None,
     specs: Optional[PyTree] = None,
+    verify: bool = True,
 ):
     """``(start_step, state)`` for a preemption-safe loop: restore the
-    latest checkpoint when one exists (resuming at ``latest + 1``), else
-    start fresh from ``template``.  One call makes any training script
-    relaunch-safe::
+    newest *good* checkpoint when one exists (resuming at ``step + 1``),
+    else start fresh from ``template``.  One call makes any training
+    script relaunch-safe::
 
         start, state = auto_resume(mgr, {'params': params, 'opt': opt_state})
         with GracefulShutdown() as stop:
             for step in range(start, total): ...
 
+    "Newest good", not "latest": a step that fails integrity verification
+    (``resilience.ckpt_guard`` manifest mismatch) or whose restore raises
+    is **quarantined** — renamed aside to ``<dir>.quarantine/<step>`` with
+    a ``ckpt_quarantine`` event recording the step and reason — and the
+    walk continues to the next older step.  A corrupted latest checkpoint
+    therefore costs one save interval instead of the run (``verify=False``
+    restores the old raise-on-corruption behavior).
+
     ``mesh``/``specs`` flow through to :meth:`CheckpointManager.restore`
     for resharding resumes (checkpoint from one mesh layout, resume on
     another)."""
-    step = mgr.latest_step()
-    if step is None:
-        return 0, template
-    return step + 1, mgr.restore(step, template=template, mesh=mesh, specs=specs)
+    steps = sorted(mgr.all_steps(), reverse=True)
+    for step in steps:
+        try:
+            if verify:
+                from ..resilience.ckpt_guard import verify_checkpoint
+
+                problems = verify_checkpoint(mgr.directory, step)
+                if problems:
+                    raise RuntimeError(
+                        "integrity verification failed: "
+                        + "; ".join(problems[:3]))
+            state = mgr.restore(step, template=template, mesh=mesh, specs=specs)
+            return step + 1, state
+        except Exception as e:  # corrupt step: quarantine, walk back
+            if not verify:
+                raise
+            from ..resilience.ckpt_guard import quarantine_checkpoint
+
+            quarantine_checkpoint(mgr.directory, step, reason=repr(e))
+            reload_fn = getattr(mgr, "reload", None)
+            if callable(reload_fn):
+                reload_fn()
+    return 0, template
 
 
 class CheckpointManager:
@@ -235,6 +263,13 @@ class CheckpointManager:
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()
 
+    def reload(self) -> None:
+        """Re-scan the directory (needed after a step dir was renamed
+        aside externally, e.g. quarantine of a corrupt checkpoint)."""
+        reload_fn = getattr(self._mgr, "reload", None)
+        if callable(reload_fn):
+            reload_fn()
+
     def close(self) -> None:
         self._mgr.close()
 
@@ -242,4 +277,12 @@ class CheckpointManager:
         return self
 
     def __exit__(self, *exc) -> None:
-        self.close()
+        # Wait for outstanding ASYNC saves before closing, even when the
+        # block is unwinding on an exception: a crash between save() and
+        # process teardown must not strand a partially-committed step
+        # (Orbax only lists fully-committed steps, so an abandoned save
+        # would silently lose the newest checkpoint).
+        try:
+            self.wait_until_finished()
+        finally:
+            self.close()

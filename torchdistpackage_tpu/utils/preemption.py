@@ -12,13 +12,33 @@ not the run.
 Composes with :class:`..utils.checkpoint.CheckpointManager` +
 :func:`..utils.checkpoint.auto_resume`; end-to-end in
 ``examples/train_preemptible.py`` (exact-trajectory resume proven in
-``tests/test_utils.py::test_preemption_resume_exact_trajectory``).
+``tests/test_utils.py::test_preemption_resume_exact_trajectory``) and in
+the self-healing :class:`..resilience.ResilientLoop`.
 """
 
 from __future__ import annotations
 
 import signal
-from typing import Sequence
+import threading
+import time
+from typing import Optional, Sequence, Union
+
+SignalLike = Union[int, str, signal.Signals]
+
+
+def _resolve_signal(s: SignalLike) -> int:
+    """Accept ``signal.SIGTERM``, ``15``, ``"SIGUSR1"`` or ``"USR1"`` —
+    SLURM jobs configure ``--signal=USR1@60``-style names, so string specs
+    keep launch scripts and python in one vocabulary."""
+    if isinstance(s, str):
+        name = s.upper()
+        if not name.startswith("SIG"):
+            name = "SIG" + name
+        try:
+            return int(getattr(signal, name))
+        except AttributeError:
+            raise ValueError(f"unknown signal name {s!r}") from None
+    return int(s)
 
 
 class GracefulShutdown:
@@ -34,16 +54,35 @@ class GracefulShutdown:
                 if stop.requested:
                     break   # exit inside the preemption grace window
 
-    Handlers are installed on ``__enter__`` and the previous handlers
-    restored on ``__exit__``, so nesting and library embedding are safe.
-    A SECOND signal re-raises the default behavior (kill) — operators can
-    still hard-stop a hung save.
+    - ``signals`` accepts ints, ``signal.Signals`` members, or names
+      (``"SIGUSR1"`` / ``"USR2"``) — SLURM's common ``--signal`` choices
+      (``USR1``/``USR2``) work out of the box:
+      ``GracefulShutdown(signals=("SIGTERM", "SIGUSR1", "SIGUSR2"))``.
+    - ``grace_s`` (when given, e.g. the ``@60`` of ``--signal=USR1@60``)
+      is recorded in the ``preemption`` event together with the monotonic
+      deadline, so the RUNREPORT timeline shows how much of the grace
+      window the final save actually used.
+    - Handlers are installed on ``__enter__`` and the previous handlers
+      restored on ``__exit__``, so nesting and library embedding are safe.
+      ``signal.signal`` only works on the **main thread** — entering from
+      a worker thread raises a clear ``RuntimeError`` instead of CPython's
+      opaque ``ValueError: signal only works in main thread...``.
+    - A SECOND signal re-raises the default behavior (kill) — operators
+      can still hard-stop a hung save.
     """
 
-    def __init__(self, signals: Sequence[int] = (signal.SIGTERM, signal.SIGINT)):
-        self._signals = tuple(signals)
+    def __init__(
+        self,
+        signals: Sequence[SignalLike] = (signal.SIGTERM, signal.SIGINT),
+        grace_s: Optional[float] = None,
+    ):
+        self._signals = tuple(_resolve_signal(s) for s in signals)
         self._previous = {}
+        self.grace_s = grace_s
         self.requested = False
+        #: monotonic (perf_counter) deadline of the grace window; set when
+        #: the first signal arrives and ``grace_s`` was configured
+        self.deadline_mono: Optional[float] = None
 
     def _handler(self, signum, frame):
         if self.requested:
@@ -51,17 +90,29 @@ class GracefulShutdown:
             signal.signal(signum, signal.SIG_DFL)
             signal.raise_signal(signum)
         self.requested = True
+        fields = {"signum": int(signum), "signal": signal.Signals(signum).name}
+        if self.grace_s is not None:
+            self.deadline_mono = time.perf_counter() + self.grace_s
+            fields["grace_s"] = float(self.grace_s)
+            fields["grace_deadline_mono"] = self.deadline_mono
         try:
             # structured timeline entry instead of a print that evaporates:
             # the final RUNREPORT shows when the grace window opened
             from ..obs.events import emit_event
 
-            emit_event("preemption", signum=int(signum),
-                       signal=signal.Signals(signum).name)
+            emit_event("preemption", **fields)
         except Exception:
             pass  # a telemetry failure must never break the grace window
 
     def __enter__(self) -> "GracefulShutdown":
+        if threading.current_thread() is not threading.main_thread():
+            raise RuntimeError(
+                "GracefulShutdown must be entered from the main thread: "
+                "signal.signal() is a main-thread-only CPython API (got "
+                f"thread {threading.current_thread().name!r}). Enter it in "
+                "the main thread and share the instance, or poll its "
+                "`requested` flag from workers."
+            )
         for s in self._signals:
             self._previous[s] = signal.signal(s, self._handler)
         return self
