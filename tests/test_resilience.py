@@ -154,6 +154,55 @@ def test_unmanifested_corruption_still_walks_back(tmp_path, events):
     assert [e["step"] for e in events.of_kind("ckpt_quarantine")] == [1]
 
 
+def test_template_drift_reraises_not_quarantines(tmp_path, events):
+    """A restore template that drifted from the checkpoint is a CALLER
+    bug: auto_resume must fail loudly, not rename good checkpoints aside
+    one by one until the run silently restarts from step 0."""
+    params, opt = _init()
+    d = str(tmp_path / "run")
+    with GuardedCheckpointManager(d, max_to_keep=4) as mgr:
+        for s in range(2):
+            mgr.save(s, _payload(params, opt, offset=s), wait=True)
+        drifted = {"params": {"w": jnp.zeros((5,))}}
+        with pytest.raises(ValueError, match="does not match its recorded"):
+            auto_resume(mgr, drifted)
+        # every checkpoint survived untouched
+        assert sorted(mgr.all_steps()) == [0, 1]
+    assert not os.path.exists(d + ".quarantine")
+    assert events.of_kind("ckpt_quarantine") == []
+
+
+def test_manifestless_template_drift_reraises(tmp_path, events):
+    """Even without a manifest, a readable checkpoint + failing restore is
+    a template problem: the template-free probe proves the bytes fine and
+    the original error surfaces instead of a quarantine."""
+    params, opt = _init()
+    d = str(tmp_path / "run")
+    with CheckpointManager(d, max_to_keep=4) as mgr:
+        mgr.save(0, _payload(params, opt), wait=True)
+        with pytest.raises(Exception, match="[Kk]ey mismatch"):
+            auto_resume(mgr, {"params": {"w": jnp.zeros((5,))}})
+        assert mgr.latest_step() == 0
+    assert events.of_kind("ckpt_quarantine") == []
+
+
+def test_transient_oserror_retries_then_reraises(tmp_path, events):
+    """Persistent OSError (storage down) must NOT quarantine: retry with
+    backoff, then fail loudly with every checkpoint still in place."""
+    params, opt = _init()
+    d = str(tmp_path / "run")
+    with CheckpointManager(d, max_to_keep=4) as mgr:
+        mgr.save(0, _payload(params, opt), wait=True)
+        real_restore = mgr.restore
+        mgr.restore = lambda *a, **k: (_ for _ in ()).throw(OSError("mount gone"))
+        with pytest.raises(OSError, match="mount gone"):
+            auto_resume(mgr, _payload(params, opt))
+        mgr.restore = real_restore
+        assert mgr.latest_step() == 0
+    assert events.of_kind("ckpt_quarantine") == []
+    assert len(events.of_kind("ckpt_retry")) == 3  # backoff was attempted
+
+
 def test_with_retries_backoff_and_budget(events):
     calls = []
 
@@ -170,6 +219,66 @@ def test_with_retries_backoff_and_budget(events):
                      retries=2, base_delay_s=0.001)
     # budget exhausted after exactly `retries` retry events more
     assert len(events.of_kind("ckpt_retry")) == 4
+
+
+def test_manifests_pruned_with_retention(tmp_path):
+    """Retention-removed steps must not leave manifests behind: the
+    manifests dir stays bounded over a long run."""
+    params, opt = _init()
+    d = str(tmp_path / "run")
+    with GuardedCheckpointManager(d, max_to_keep=2) as mgr:
+        for s in range(5):
+            mgr.save(s, _payload(params, opt, offset=s), wait=True)
+        assert sorted(mgr.all_steps()) == [3, 4]
+    mdir = os.path.join(d, "manifests")
+    assert sorted(os.listdir(mdir)) == ["3.json", "4.json"]
+
+
+def test_stale_manifest_pruned_at_init(tmp_path, events):
+    """Fresh run, same directory: a manifest lingering from a previous
+    run's step 0 must not condemn the new run's step 0."""
+    import shutil
+
+    params, opt = _init()
+    d = str(tmp_path / "run")
+    with GuardedCheckpointManager(d, max_to_keep=3) as mgr:
+        mgr.save(0, _payload(params, opt), wait=True)
+    shutil.rmtree(os.path.join(d, "0"))  # steps cleared, manifests forgotten
+    assert os.path.exists(os.path.join(d, "manifests", "0.json"))
+    with GuardedCheckpointManager(d, max_to_keep=3) as mgr2:
+        # construction pruned the orphaned manifest...
+        assert not os.path.exists(os.path.join(d, "manifests", "0.json"))
+        mgr2.save(0, _payload(params, opt, offset=7), wait=True)
+        # ...so the recycled step 0 verifies against ITS manifest, clean
+        assert verify_checkpoint(d, 0) == []
+        start, state = auto_resume(mgr2, _payload(params, opt))
+        assert start == 1 and int(state["loop"]["data_offset"]) == 7
+    assert events.of_kind("ckpt_quarantine") == []
+
+
+def test_stale_manifest_mtime_crosscheck(tmp_path):
+    """verify_checkpoint ignores a manifest whose recorded files all
+    postdate it (recycled step) but still flags real tampering."""
+    import json as _json
+
+    params, opt = _init()
+    d = str(tmp_path / "run")
+    with GuardedCheckpointManager(d, max_to_keep=2) as mgr:
+        mgr.save(0, _payload(params, opt), wait=True)
+    mpath = os.path.join(d, "manifests", "0.json")
+    with open(mpath) as f:
+        manifest = _json.load(f)
+    # poison a checksum: an APPLICABLE manifest must flag it...
+    manifest["files"][0]["sha256"] = "0" * 64
+    with open(mpath, "w") as f:
+        _json.dump(manifest, f)
+    assert any("checksum" in p for p in verify_checkpoint(d, 0))
+    # ...but the same manifest pushed into the past (as if every file were
+    # rewritten by a new incarnation of step 0) proves nothing
+    manifest["files_max_mtime"] -= 10_000.0
+    with open(mpath, "w") as f:
+        _json.dump(manifest, f)
+    assert verify_checkpoint(d, 0) == []
 
 
 def test_ckpt_manager_ctx_waits_on_exception(tmp_path):
@@ -302,6 +411,46 @@ def test_sigterm_mid_run_resume_exact_trajectory(tmp_path, events):
         p, res2.params)
 
 
+def test_grace_save_forced_past_save_interval(tmp_path, events):
+    """A manager with save_interval_steps > 1 declines off-interval saves;
+    the preemption grace-window save must be FORCED through, and the
+    reported last_checkpoint must be a checkpoint that actually exists."""
+    params, opt = _init()
+    d = str(tmp_path / "run")
+    with GuardedCheckpointManager(d, max_to_keep=4,
+                                  save_interval_steps=5) as mgr:
+        loop = ResilientLoop(
+            _step, _make_batch, mgr, total_steps=8, save_every=1,
+            chaos=ChaosMonkey([Fault("sigterm", step=2)]))
+        res = loop.run(params, opt)
+    assert res.preempted
+    # step 2 is off the 5-step interval — only the forced save committed it
+    assert res.summary["last_checkpoint"] == 2
+    with GuardedCheckpointManager(d, max_to_keep=4) as mgr2:
+        assert mgr2.latest_step() == 2
+        start, state = auto_resume(mgr2, _payload(params, opt))
+        assert start == 3
+
+
+def test_declined_forced_save_is_loud(tmp_path, events):
+    """If even a forced save is declined, the summary must not claim the
+    step was checkpointed — and the decline lands on the timeline."""
+
+    class _DecliningManager(CheckpointManager):
+        def save(self, step, state, wait=False, force=False):
+            return False
+
+    params, opt = _init()
+    d = str(tmp_path / "run")
+    with _DecliningManager(d, max_to_keep=2) as mgr:
+        res = ResilientLoop(_step, _make_batch, mgr, total_steps=2,
+                            save_every=1).run(params, opt)
+    assert res.verdict == "clean"
+    assert res.summary["last_checkpoint"] is None
+    skipped = events.of_kind("checkpoint_save_skipped")
+    assert skipped and skipped[-1]["forced"] and skipped[-1]["step"] == 1
+
+
 def test_stall_trips_watchdog_hang_suspected(tmp_path, events):
     """Host stall (chaos sleep) longer than the watchdog timeout ->
     hang_suspected on the timeline; the beat after the stall resolves it."""
@@ -362,6 +511,38 @@ def test_desync_detected_on_divergent_fingerprints(events):
     assert not bad["ok"] and bad["mismatched"] == ["step"]
     ev = events.of_kind("desync_detected")
     assert len(ev) == 1 and ev[0]["mismatched"] == ["step"]
+
+
+def test_fingerprint_gather_is_exact():
+    """The allgather must compare fingerprints exactly: float64 values
+    travel bit-cast as int32 lanes, so step counters above 2**24 and
+    param-checksum sums that a float32 gather would conflate stay
+    distinct."""
+    from torchdistpackage_tpu.resilience.watchdog import (
+        _f64_to_lanes,
+        _lanes_to_f64,
+    )
+
+    # values float32 provably conflates (same f32, different f64)
+    pairs = [
+        (float(2 ** 24), float(2 ** 24 + 1)),     # big step counters
+        (1.0e9, 1.0e9 + 1.0),                      # param checksums
+        (123456789.0, np.nextafter(123456789.0, np.inf)),  # 1-ulp drift
+    ]
+    for a, b in pairs:
+        assert np.float32(a) == np.float32(b)  # the old failure mode
+        vec_a, vec_b = [a, 7.0], [b, 7.0]
+        gathered = _lanes_to_f64(
+            np.stack([_f64_to_lanes(vec_a), _f64_to_lanes(vec_b)]), 2)
+        assert gathered[0, 0] != gathered[1, 0]  # drift stays visible
+        assert gathered[0, 1] == gathered[1, 1]
+        np.testing.assert_array_equal(gathered[0], vec_a)
+        np.testing.assert_array_equal(gathered[1], vec_b)
+    # and agreement still compares equal through the round trip
+    res = check_consistency(
+        step=2 ** 30,
+        _gathered=np.asarray([[float(2 ** 30)], [float(2 ** 30)]]))
+    assert res["ok"]
 
 
 def test_fingerprint_components():

@@ -75,7 +75,7 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     # resilience (PR 4)
     "fault_injected", "ckpt_retry", "ckpt_quarantine", "rollback",
     "resilience_abort", "hang_suspected", "hang_resolved", "hang_abort",
-    "desync_detected",
+    "desync_detected", "checkpoint_save_skipped",
 })
 
 

@@ -136,6 +136,7 @@ def write_manifest(
     if not os.path.isdir(step_dir):
         raise FileNotFoundError(f"checkpoint step dir missing: {step_dir}")
     files = []
+    max_mtime = 0.0
     for root, _dirs, names in os.walk(step_dir):
         for name in sorted(names):
             p = os.path.join(root, name)
@@ -144,10 +145,16 @@ def write_manifest(
                 "size": os.path.getsize(p),
                 "sha256": _sha256(p),
             })
+            max_mtime = max(max_mtime, os.path.getmtime(p))
     files.sort(key=lambda f: f["path"])
     manifest: Dict[str, Any] = {
         "schema": MANIFEST_SCHEMA,
         "step": int(step),
+        # pins the manifest to THIS incarnation of the step: a recycled
+        # step number (fresh run in the same directory) rewrites every
+        # file, so all of them end up newer than this stamp and the stale
+        # manifest must prove nothing rather than condemn the fresh step
+        "files_max_mtime": max_mtime,
         "n_files": len(files),
         "files": files,
     }
@@ -170,9 +177,13 @@ def verify_checkpoint(directory: str, step: int) -> List[str]:
     A checkpoint without a manifest (written before the guard existed)
     returns ``[]`` — it cannot be *proven* good, but back-compat demands it
     not be condemned either; a restore failure still triggers the
-    auto_resume walk-back.  With a manifest: every recorded file must
-    exist with matching size and SHA-256, and no unrecorded file may have
-    appeared in its place.
+    auto_resume walk-back.  A manifest that does not belong to this
+    incarnation of the step — recorded step number differs, or the step
+    dir is *newer* than the manifest's recorded mtime (a recycled step
+    number from an earlier run in the same directory) — is stale and
+    proves nothing: also ``[]``.  With an applicable manifest: every
+    recorded file must exist with matching size and SHA-256, and no
+    unrecorded file may have appeared in its place.
     """
     mpath = manifest_path(directory, step)
     if not os.path.exists(mpath):
@@ -185,6 +196,21 @@ def verify_checkpoint(directory: str, step: int) -> List[str]:
     step_dir = os.path.join(directory, str(int(step)))
     if not os.path.isdir(step_dir):
         return [f"step dir missing: {step_dir}"]
+    if int(manifest.get("step", step)) != int(step):
+        return []  # misplaced manifest: not evidence about THIS step
+    rec_max = manifest.get("files_max_mtime")
+    if rec_max is not None:
+        surviving = [
+            os.path.getmtime(os.path.join(step_dir, r["path"]))
+            for r in manifest.get("files", [])
+            if os.path.exists(os.path.join(step_dir, r["path"]))
+        ]
+        if surviving and min(surviving) > float(rec_max) + 1e-3:
+            # EVERY recorded file postdates the manifest: the step number
+            # was recycled and this manifest describes the old incarnation
+            # — stale, proves nothing (tampering leaves older files behind
+            # and still gets caught below)
+            return []
     problems: List[str] = []
     on_disk = set()
     for root, _dirs, names in os.walk(step_dir):
@@ -247,10 +273,18 @@ def quarantine_checkpoint(
     """Rename bad step ``step`` aside to ``<directory>.quarantine/<step>``
     (kept for post-mortem, invisible to the manager) and emit a
     ``ckpt_quarantine`` event.  Returns the new path (None if the step dir
-    is already gone)."""
+    is already gone).
+
+    On a multi-host pod only process 0 performs the rename — the
+    checkpoint fs is shared, and a non-master host renaming a step dir
+    while peers read it would produce exactly the desync this subsystem
+    exists to prevent.  Every host still emits the event (callers reach
+    cross-host agreement first; see ``auto_resume``)."""
+    from ..obs.events import _process_index
+
     step_dir = os.path.join(directory, str(int(step)))
     dest = None
-    if os.path.isdir(step_dir):
+    if _process_index() == 0 and os.path.isdir(step_dir):
         qdir = quarantine_dir(directory)
         os.makedirs(qdir, exist_ok=True)
         dest = os.path.join(qdir, str(int(step)))
@@ -305,12 +339,40 @@ class GuardedCheckpointManager(CheckpointManager):
         self.base_delay_s = base_delay_s
         self.verify_on_restore = verify_on_restore
         self._pending_manifests: Dict[int, Optional[List[Dict[str, Any]]]] = {}
+        # a fresh run over a cleaned directory restarts step numbering at
+        # 0; manifests lingering from the previous run would get a fresh
+        # step falsely condemned — drop every manifest whose step is gone
+        self._prune_manifests()
 
     # -- manifest bookkeeping ------------------------------------------
 
+    def _prune_manifests(self) -> None:
+        """Delete ``manifests/<step>.json`` for steps the manager no longer
+        lists (retention-removed or from an earlier run in the same dir):
+        keeps the manifests dir bounded and stale manifests from ever
+        meeting a recycled step number.  Master-only (shared ckpt fs)."""
+        from ..obs.events import _process_index
+
+        if _process_index() != 0:
+            return
+        mdir = os.path.join(self.directory, MANIFEST_DIRNAME)
+        if not os.path.isdir(mdir):
+            return
+        live = {int(s) for s in self.all_steps()}
+        for name in os.listdir(mdir):
+            stem, ext = os.path.splitext(name)
+            if ext != ".json" or not stem.isdigit():
+                continue
+            if int(stem) not in live:
+                try:
+                    os.remove(os.path.join(mdir, name))
+                except OSError:
+                    pass  # gone already / transient fs hiccup: not fatal
+
     def _flush_manifests(self) -> None:
         """Write manifests for every pending step that has committed (and
-        survived retention).  Called after ``wait_until_finished``."""
+        survived retention), prune the rest.  Called after
+        ``wait_until_finished``."""
         if not self._pending_manifests:
             return
         from ..obs.events import _process_index
@@ -331,15 +393,18 @@ class GuardedCheckpointManager(CheckpointManager):
                     label="manifest",
                 )
         self._pending_manifests.clear()
+        self._prune_manifests()
 
     # -- hardened API --------------------------------------------------
 
-    def save(self, step: int, state: PyTree, wait: bool = False) -> bool:
+    def save(self, step: int, state: PyTree, wait: bool = False,
+             force: bool = False) -> bool:
         # the previous async save has committed by the time a new one is
         # accepted, so flushing here costs (almost) no extra waiting
         self.wait_until_finished()
         saved = with_retries(
-            lambda: CheckpointManager.save(self, step, state, wait=False),
+            lambda: CheckpointManager.save(
+                self, step, state, wait=False, force=force),
             retries=self.retries, base_delay_s=self.base_delay_s, label="save",
         )
         if saved:

@@ -267,10 +267,21 @@ class ResilientLoop:
 
                 last = step == self.total_steps - 1
                 if stop.requested or last or (step + 1) % self.save_every == 0:
-                    self.mgr.save(
+                    # grace-window and final saves must not be declined by
+                    # the manager's save interval: force them through
+                    must_save = bool(stop.requested or last)
+                    saved = self.mgr.save(
                         step, self._payload(params, opt_state, data_offset),
-                        wait=bool(stop.requested))
-                    last_good_ckpt = step
+                        wait=bool(stop.requested), force=must_save)
+                    if saved:
+                        last_good_ckpt = step
+                    elif must_save:
+                        # a forced save was still declined — the resume
+                        # point is older than this step; say so loudly
+                        # instead of reporting a checkpoint that isn't there
+                        emit_event(
+                            "checkpoint_save_skipped", step=step,
+                            forced=True, last_checkpoint=last_good_ckpt)
                 if stop.requested:
                     preempted = True
                     break

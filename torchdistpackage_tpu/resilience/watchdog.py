@@ -31,7 +31,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -202,10 +202,27 @@ def param_checksum(tree: Any) -> float:
 
 
 def _hash_parts(hexdigest: str) -> List[float]:
-    """Two 16-bit chunks of a hash, exactly representable in float32 (the
-    allgather dtype) — one chunk alone would collide too easily."""
+    """Two 16-bit chunks of a hash as exact small floats — one chunk alone
+    would collide too easily (the gather itself is exact: fingerprints
+    travel bit-cast as int32 lanes, see :func:`_f64_to_lanes`)."""
     v = int(hexdigest[:16], 16)
     return [float(v % 65521), float((v // 65521) % 65521)]
+
+
+def _f64_to_lanes(values: "Sequence[float]") -> np.ndarray:
+    """Bit-cast a float64 vector to int32 lane pairs for the allgather.
+    ``process_allgather`` is exact on int32, while a float32 gather would
+    round step counters above 2**24 and param-checksum sums — small real
+    drifts would compare equal and desyncs go unseen.  (Assumes one
+    endianness across the pod, which any homogeneous slice satisfies.)"""
+    return np.ascontiguousarray(np.asarray(values, np.float64)).view(np.int32)
+
+
+def _lanes_to_f64(lanes: np.ndarray, n_components: int) -> np.ndarray:
+    """Inverse of :func:`_f64_to_lanes` over a gathered ``(n_hosts, 2n)``
+    int32 array → exact ``(n_hosts, n)`` float64 values."""
+    arr = np.ascontiguousarray(np.asarray(lanes, np.int32))
+    return arr.view(np.float64).reshape(arr.shape[0], n_components)
 
 
 def consistency_fingerprint(
@@ -284,10 +301,16 @@ def check_consistency(
             import jax.numpy as jnp
             from jax.experimental import multihost_utils
 
-            gathered = np.asarray(
-                multihost_utils.process_allgather(
-                    jnp.asarray(values, jnp.float32))
-            ).reshape(n_proc, len(labels)).astype(np.float64)
+            # exact gather: float64 fingerprints travel bit-cast as int32
+            # lanes (a float32 gather would round steps > 2**24 and the
+            # float64 param checksums, hiding small real drifts)
+            lanes = _f64_to_lanes(values)
+            gathered = _lanes_to_f64(
+                np.asarray(
+                    multihost_utils.process_allgather(jnp.asarray(lanes))
+                ).reshape(n_proc, lanes.size),
+                len(labels),
+            )
 
     mismatched = [
         labels[i] for i in range(len(labels))

@@ -124,6 +124,40 @@ def load_checkpoint(
         return ckptr.restore(path, template)
 
 
+def _process_count() -> int:
+    """Best-effort pod size: 1 before/without distributed init."""
+    try:
+        import jax
+
+        return int(jax.process_count())
+    except Exception:
+        return 1
+
+
+def _pod_any(flag: bool, n_proc: int) -> bool:
+    """OR-reduce a per-host boolean across the pod.  **Collective** when
+    ``n_proc > 1`` — every process must call it; single-host: identity."""
+    if n_proc <= 1:
+        return bool(flag)
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        jnp.asarray([1 if flag else 0], jnp.int32))
+    return bool(np.any(np.asarray(gathered)))
+
+
+def _probe_readable(mgr: "CheckpointManager", step: int) -> bool:
+    """Can step ``step`` be deserialized at all (template-less, host-side)?
+    Distinguishes a corrupt checkpoint (unreadable no matter what) from a
+    caller bug (readable checkpoint, mismatched restore template)."""
+    try:
+        mgr.restore(step)
+        return True
+    except Exception:
+        return False
+
+
 def auto_resume(
     mgr: "CheckpointManager",
     template: PyTree,
@@ -140,39 +174,91 @@ def auto_resume(
         with GracefulShutdown() as stop:
             for step in range(start, total): ...
 
-    "Newest good", not "latest": a step that fails integrity verification
-    (``resilience.ckpt_guard`` manifest mismatch) or whose restore raises
-    is **quarantined** — renamed aside to ``<dir>.quarantine/<step>`` with
-    a ``ckpt_quarantine`` event recording the step and reason — and the
-    walk continues to the next older step.  A corrupted latest checkpoint
-    therefore costs one save interval instead of the run (``verify=False``
-    restores the old raise-on-corruption behavior).
+    "Newest good", not "latest": a step that is **proven corrupt** is
+    quarantined — renamed aside to ``<dir>.quarantine/<step>`` with a
+    ``ckpt_quarantine`` event recording the step and reason — and the walk
+    continues to the next older step, so a corrupted latest checkpoint
+    costs one save interval instead of the run.  Proven corrupt means the
+    integrity manifest (``resilience.ckpt_guard``) fails verification, or
+    a manifest-less step cannot be deserialized even template-free.
+    Everything else fails **loudly** instead of wiping resume state:
 
+    - a transient ``OSError`` is retried with backoff and, if persistent,
+      re-raised — an infra outage must not quarantine good checkpoints;
+    - a restore error on a step whose manifest verified (or that a
+      template-free probe can read) is a caller bug — wrong/drifted
+      template, resharding misconfig — and is re-raised as-is;
+    - on a multi-host pod, the per-step verification verdict is agreed
+      across hosts (any host seeing corruption condemns the step for
+      all), only process 0 performs the rename, and restore errors after
+      an agreed-good verification re-raise rather than rename a step dir
+      out from under peers mid-restore.
+
+    ``verify=False`` restores the old raise-on-any-failure behavior.
     ``mesh``/``specs`` flow through to :meth:`CheckpointManager.restore`
     for resharding resumes (checkpoint from one mesh layout, resume on
     another)."""
+    from ..resilience.ckpt_guard import (
+        CheckpointCorruptError,
+        GuardedCheckpointManager,
+        manifest_path,
+        quarantine_checkpoint,
+        verify_checkpoint,
+        verify_template,
+        with_retries,
+    )
+
+    n_proc = _process_count()
+    # a GuardedCheckpointManager already retries transient I/O internally;
+    # wrapping it again would only multiply the backoff schedule
+    restore_retries = 0 if isinstance(mgr, GuardedCheckpointManager) else 3
+
+    def _quarantine(step: int, reason: str) -> None:
+        quarantine_checkpoint(mgr.directory, step, reason=reason)
+        reload_fn = getattr(mgr, "reload", None)
+        if callable(reload_fn):
+            reload_fn()
+
     steps = sorted(mgr.all_steps(), reverse=True)
     for step in steps:
+        has_manifest = False
+        if verify:
+            problems = verify_checkpoint(mgr.directory, step)
+            if _pod_any(bool(problems), n_proc):
+                _quarantine(step, reason="integrity verification failed: "
+                            + "; ".join(problems[:3] or ["(on another host)"]))
+                continue
+            has_manifest = os.path.exists(manifest_path(mgr.directory, step))
+            if has_manifest:
+                drift = verify_template(mgr.directory, step, template)
+                if drift:
+                    raise ValueError(
+                        f"auto_resume: checkpoint step {step} verified OK "
+                        "but the restore template does not match its "
+                        "recorded tree (drifted model/config?): "
+                        + "; ".join(drift[:5]))
         try:
-            if verify:
-                from ..resilience.ckpt_guard import verify_checkpoint
-
-                problems = verify_checkpoint(mgr.directory, step)
-                if problems:
-                    raise RuntimeError(
-                        "integrity verification failed: "
-                        + "; ".join(problems[:3]))
-            state = mgr.restore(step, template=template, mesh=mesh, specs=specs)
+            state = with_retries(
+                lambda s=step: mgr.restore(
+                    s, template=template, mesh=mesh, specs=specs),
+                retries=restore_retries, label="restore",
+                retry_on=(OSError,))
             return step + 1, state
-        except Exception as e:  # corrupt step: quarantine, walk back
-            if not verify:
+        except OSError:
+            # transient-I/O retries exhausted: storage trouble, not proven
+            # corruption — fail loudly, keep every checkpoint in place
+            raise
+        except Exception as e:
+            if not verify or n_proc > 1:
                 raise
-            from ..resilience.ckpt_guard import quarantine_checkpoint
-
-            quarantine_checkpoint(mgr.directory, step, reason=repr(e))
-            reload_fn = getattr(mgr, "reload", None)
-            if callable(reload_fn):
-                reload_fn()
+            if not isinstance(e, CheckpointCorruptError) and (
+                has_manifest or _probe_readable(mgr, step)
+            ):
+                # bytes are hash-verified (or deserialize fine without the
+                # template): the failure is the caller's restore request,
+                # not the checkpoint — quarantining would wipe good state
+                raise
+            _quarantine(step, reason=repr(e))
     return 0, template
 
 
@@ -200,9 +286,14 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, step: int, state: PyTree, wait: bool = False) -> bool:
+    def save(self, step: int, state: PyTree, wait: bool = False,
+             force: bool = False) -> bool:
+        """Returns True iff the step was actually saved — with
+        ``save_interval_steps > 1`` Orbax declines off-interval steps
+        unless ``force=True`` (the grace-window/final-save path)."""
         ocp = _ocp()
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force)
         if wait:
             self._mgr.wait_until_finished()
         if saved:
