@@ -19,7 +19,11 @@ import pytest
 pytestmark = pytest.mark.slow
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
-EXAMPLES = sorted(p.name for p in (REPO / "examples").glob("train_*.py"))
+EXAMPLES = sorted(
+    p.name
+    for pat in ("train_*.py", "serve_*.py")
+    for p in (REPO / "examples").glob(pat)
+)
 
 # Examples wired through obs.Telemetry: each must produce a valid
 # RUNREPORT.json under the CI runner.  Per-example extra assertions probe
@@ -42,6 +46,10 @@ OBS_EXAMPLES = {
     # the report must carry the resilience verdict AND the fault/rollback
     # events on its timeline
     "train_resilient.py": {"comm": "dp", "resilience": "recovered"},
+    # continuous-batching engine (PR 5): the report must carry the serving
+    # section (TTFT/TPOT, tokens/s, occupancy, pool) with the compile-once
+    # evidence, plus the request lifecycle events
+    "serve_gpt.py": {"serving": True},
 }
 
 
@@ -108,6 +116,22 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
         assert res["rollbacks"] >= 1 and res["faults_injected"] >= 1, res
         kinds = {e["kind"] for e in report["events"]}
         assert {"fault_injected", "rollback"} <= kinds, (script, kinds)
+
+    if probe.get("serving"):
+        srv = report.get("serving")
+        assert srv, (script, "no serving section")
+        assert srv["requests"]["completed"] > 0, srv
+        assert srv["tokens_per_sec"] > 0, srv
+        for key in ("ttft_s", "tpot_s"):
+            assert {"p50", "p95", "p99"} <= set(srv[key]), (key, srv[key])
+        assert 0.0 < srv["slot_occupancy"]["mean"] <= 1.0, srv
+        assert 0.0 < srv["kv_pool"]["mean_utilization"] <= 1.0, srv
+        # compile-once: one decode + one prefill signature for the whole run
+        assert srv["decode_signatures"] == 1, srv
+        assert srv["prefill_signatures"] == 1, srv
+        kinds = {e["kind"] for e in report["events"]}
+        assert {"request_admitted", "prefill_chunk",
+                "request_retired", "slots_snapshot"} <= kinds, kinds
 
     if probe.get("comm"):
         # the comm section must ledger this example's parallelism dimension

@@ -231,6 +231,18 @@ def test_event_kinds_registered():
     assert not stale, f"EVENT_KINDS entries no call site emits: {sorted(stale)}"
 
 
+def test_event_kind_pass_covers_serving():
+    """The serving package (PR 5) is inside the AST pass's scan set: its
+    lifecycle kinds are emitted nowhere else, so a scan that missed
+    serving/ would silently exempt the whole subsystem from the registry
+    check (and the stale-entry guard above would start failing)."""
+    emitted = set()
+    for path in sorted((PKG / "serving").rglob("*.py")):
+        emitted.update(k for _, k in _emit_call_kinds(path))
+    assert {"request_admitted", "prefill_chunk", "request_retired",
+            "slots_snapshot"} <= emitted, emitted
+
+
 # ------------------------------------------- silent exception swallowing
 
 # `except: pass` / `except Exception: pass` swallows the very faults the

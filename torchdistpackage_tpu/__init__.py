@@ -17,7 +17,10 @@ from .dist import (
     is_using_pp,
 )
 
-_SUBPACKAGES = ("models", "obs", "ops", "parallel", "resilience", "tools", "utils")
+_SUBPACKAGES = (
+    "models", "obs", "ops", "parallel", "resilience", "serving", "tools",
+    "utils",
+)
 
 
 def __getattr__(name: str):

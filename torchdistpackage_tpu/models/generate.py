@@ -115,6 +115,13 @@ def _cached_attention(q: jnp.ndarray, ck, cv, offset, window=None) -> jnp.ndarra
     ck/cv [B, Hkv, T, hd], masked to ``key_pos <= offset + query_row``.
     f32 softmax, 1/sqrt(hd) scale — the mha_reference conventions.
 
+    ``offset`` is a scalar (every row at the same position — the
+    ``generate()`` batch) OR a [B] vector of per-row positions — the
+    serving engine's continuous batch, where every slot sits at its own
+    depth.  The vector form broadcasts the mask per row and is otherwise
+    the identical computation, so the two agree bitwise when the vector is
+    constant.
+
     Quantized caches pass ``(q8, scale)`` pairs: the int8 payload is upcast
     in-register and the per-position scale folds into the scores (k) or
     the probabilities (v) — both exact because the scale is constant along
@@ -135,11 +142,14 @@ def _cached_attention(q: jnp.ndarray, ck, cv, offset, window=None) -> jnp.ndarra
     if k_scale is not None:
         s = s * k_scale[:, :, None, None, :]
     s = s * (1.0 / math.sqrt(hd))
-    qpos = offset + jnp.arange(S_in)
-    mask = jnp.arange(T)[None, :] <= qpos[:, None]  # [S_in, T]
+    key_pos = jnp.arange(T)
+    qpos = jnp.asarray(offset)[..., None] + jnp.arange(S_in)  # [S_in] | [B, S_in]
+    mask = key_pos[None, :] <= qpos[..., None]
     if window is not None:  # Mistral: key in (qpos - window, qpos]
-        mask = mask & (jnp.arange(T)[None, :] > qpos[:, None] - window)
-    s = jnp.where(mask[None, None, None], s, -jnp.inf)
+        mask = mask & (key_pos[None, :] > qpos[..., None] - window)
+    if mask.ndim == 2:  # scalar offset: broadcast over the batch
+        mask = mask[None]
+    s = jnp.where(mask[:, None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     if v_scale is not None:
         p = p * v_scale[:, :, None, None, :]
@@ -161,6 +171,7 @@ def cached_block_forward(
     axis: Optional[str] = None,
     rope: "tuple | None" = None,
     ffn=None,
+    cache_ops: "tuple | None" = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One pre-LN block with KV caching: writes this call's k/v into the
     cache at ``[offset, offset + S_in)`` and attends against the whole
@@ -171,13 +182,23 @@ def cached_block_forward(
     ``ffn``: optional ``(p, h) -> z`` replacing the dense MLP half (h is
     the post-ln2 activation; z must be the COMPLETE ffn output — no
     pending TP partial sums) — how the MoE families plug their expert
-    layer into the same cached block."""
+    layer into the same cached block.
+
+    ``cache_ops``: optional ``(write, attend)`` pair swapping the cache
+    LAYOUT under the same block: ``write(c, val, offset) -> c`` and
+    ``attend(q, ck, cv, offset, window=...) -> out``.  Default is the
+    contiguous ``[B, Hkv, T, hd]`` buffer; ``serving/paged_cache.py``
+    passes block-pool ops (and [B]-vector offsets) so the serving engine
+    reuses this exact block — the transformer math cannot drift between
+    the two layouts because there is only one copy of it."""
     B, S_in, D = x.shape
+    write, attend = cache_ops if cache_ops is not None else (
+        _cache_write, _cached_attention)
     h = layer_norm(x, p["ln1"], cfg.norm_eps)
     q, k, v = compute_qkv(p["attn"], h, cfg, rope=rope)
-    ck = _cache_write(ck, k, offset)
-    cv = _cache_write(cv, v, offset)
-    if isinstance(offset, int) and offset == 0 and S_in > 1:
+    ck = write(ck, k, offset)
+    cv = write(cv, v, offset)
+    if cache_ops is None and isinstance(offset, int) and offset == 0 and S_in > 1:
         # prefill: every cached key IS this call's k, so causal attention
         # over (q, k, v) equals the cache-masked form — and runs the
         # model's own kernel via the shared core_attention dispatch (flash
@@ -187,8 +208,7 @@ def cached_block_forward(
 
         out = core_attention(q, k, v, cfg)
     else:
-        out = _cached_attention(q, ck, cv, offset,
-                                window=cfg.sliding_window)
+        out = attend(q, ck, cv, offset, window=cfg.sliding_window)
     out = out.transpose(0, 2, 1, 3).reshape(B, S_in, q.shape[1] * cfg.head_dim)
     y = dense(out, p["attn"]["wo"])
     y = _close_row_parallel(y, p["attn"]["bo"], axis, False)

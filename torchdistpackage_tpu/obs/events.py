@@ -46,6 +46,18 @@ Resilience kinds (``torchdistpackage_tpu.resilience``, PR 4):
                     (step / config hash / code hash / RNG / param sum)
 ==================  =====================================================
 
+Serving kinds (``torchdistpackage_tpu.serving``, PR 5):
+
+==================  =====================================================
+``request_admitted``  a queued request took a free slot (blocks
+                    allocated; record carries the queue wait)
+``prefill_chunk``   one chunked-prefill slice ran for the prefilling
+                    slots (the admission path that never stalls decodes)
+``request_retired`` EOS / max-token completion — slot and blocks freed;
+                    the record carries the request's TTFT
+``slots_snapshot``  periodic occupancy + KV-pool utilization sample
+==================  =====================================================
+
 A module-level default log lets deep call sites (signal handlers, debug
 callbacks) emit without plumbing a handle through every layer:
 ``emit_event("preemption", signum=15)``.
@@ -76,6 +88,8 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     "fault_injected", "ckpt_retry", "ckpt_quarantine", "rollback",
     "resilience_abort", "hang_suspected", "hang_resolved", "hang_abort",
     "desync_detected", "checkpoint_save_skipped",
+    # serving (PR 5)
+    "request_admitted", "prefill_chunk", "request_retired", "slots_snapshot",
 })
 
 

@@ -91,6 +91,43 @@ def validate_runreport(report: Any) -> List[str]:
             errs.append(f"resilience verdict {res.get('verdict')!r} invalid")
         elif not isinstance(res.get("rollbacks"), int) or res["rollbacks"] < 0:
             errs.append("resilience.rollbacks missing/negative")
+    errs.extend(_validate_serving(report.get("serving")))
+    return errs
+
+
+def _validate_serving(srv: Any) -> List[str]:
+    """The optional ``serving`` section (a ServingEngine drove the run):
+    TTFT/TPOT percentiles, aggregate tokens/s, slot occupancy and KV-pool
+    utilization must be present and sane."""
+    if srv is None:
+        return []
+    if not isinstance(srv, dict):
+        return [f"serving is {type(srv).__name__}, expected dict"]
+    errs: List[str] = []
+    tps = srv.get("tokens_per_sec")
+    if not isinstance(tps, (int, float)) or tps < 0:
+        errs.append("serving.tokens_per_sec missing/negative")
+    completed = srv.get("requests", {}).get("completed")
+    if not isinstance(completed, int) or completed < 0:
+        errs.append("serving.requests.completed missing/negative")
+    for key in ("ttft_s", "tpot_s"):
+        pct = srv.get(key)
+        if not isinstance(pct, dict):
+            errs.append(f"serving.{key} missing/non-dict")
+            continue
+        # ttft is stamped for every completed request; tpot may legitimately
+        # be empty (every request retired on its first token)
+        if completed and not pct and key == "ttft_s":
+            errs.append("serving.ttft_s empty with completed requests")
+        for p in ("p50", "p95", "p99"):
+            if pct and not isinstance(pct.get(p), (int, float)):
+                errs.append(f"serving.{key}.{p} missing/non-numeric")
+    occ = srv.get("slot_occupancy", {}).get("mean")
+    if not isinstance(occ, (int, float)) or not (0.0 <= occ <= 1.0):
+        errs.append("serving.slot_occupancy.mean missing/out of [0,1]")
+    util = srv.get("kv_pool", {}).get("mean_utilization")
+    if not isinstance(util, (int, float)) or not (0.0 <= util <= 1.0):
+        errs.append("serving.kv_pool.mean_utilization missing/out of [0,1]")
     return errs
 
 
@@ -123,6 +160,13 @@ def render_summary_line(report: Dict[str, Any]) -> str:
         parts.append(
             f"RESILIENCE={res['verdict']}"
             f"(rollbacks {res.get('rollbacks', 0)})")
+    srv = report.get("serving")
+    if srv and isinstance(srv.get("tokens_per_sec"), (int, float)):
+        tail = ""
+        p50 = srv.get("ttft_s", {}).get("p50")
+        if isinstance(p50, (int, float)):
+            tail = f"(ttft p50 {p50 * 1e3:.0f}ms)"
+        parts.append(f"serve={srv['tokens_per_sec']:.1f}tok/s{tail}")
     return "  ".join(parts)
 
 
@@ -257,6 +301,45 @@ def render_markdown(report: Dict[str, Any]) -> str:
             L.append(f"- last good checkpoint: step {res['last_checkpoint']}")
         if res.get("hang_suspected"):
             L.append(f"- watchdog hang episodes: {res['hang_suspected']}")
+        L.append("")
+
+    srv = report.get("serving")
+    if srv:
+        L.append("## Serving")
+        L.append("")
+        reqs = srv.get("requests", {})
+        L.append(f"- requests: **{reqs.get('completed', 0)} completed** "
+                 f"({reqs.get('queued', 0)} queued, "
+                 f"{reqs.get('in_flight', 0)} in flight at finalize)")
+        L.append(f"- aggregate throughput: "
+                 f"**{srv.get('tokens_per_sec', 0.0):.1f} tok/s** "
+                 f"({srv.get('generated_tokens', 0)} tokens)")
+        for key, label in (("ttft_s", "TTFT"), ("tpot_s", "TPOT")):
+            pct = srv.get(key) or {}
+            if pct:
+                L.append(
+                    f"- {label}: " + " / ".join(
+                        f"{p} {pct[p] * 1e3:.2f} ms"
+                        for p in ("p50", "p95", "p99") if p in pct))
+        occ = srv.get("slot_occupancy", {})
+        pool = srv.get("kv_pool", {})
+        if occ:
+            L.append(f"- slot occupancy: mean "
+                     f"**{occ.get('mean', 0.0):.1%}** of "
+                     f"{occ.get('num_slots', '?')} slots")
+        if pool:
+            L.append(
+                f"- KV pool: {pool.get('num_blocks', '?')} blocks x "
+                f"{pool.get('block_size', '?')} positions "
+                f"(x{pool.get('dp_groups', 1)} dp) — mean utilization "
+                f"{pool.get('mean_utilization', 0.0):.1%}, peak "
+                f"{pool.get('peak_utilization', 0.0):.1%}")
+        L.append(
+            f"- {srv.get('decode_steps', 0)} decode steps "
+            f"(mean batch {srv.get('decode_batch_mean', 0.0):.2f}) + "
+            f"{srv.get('prefill_chunks', 0)} prefill chunks; "
+            f"{srv.get('decode_signatures', '?')} decode signature(s) "
+            f"compiled")
         L.append("")
 
     counters = report.get("counters", {})

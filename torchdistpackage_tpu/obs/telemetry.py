@@ -205,6 +205,7 @@ class Telemetry:
         self.events = event_log
         self.counters: Dict[str, Any] = {}
         self.resilience: Optional[Dict[str, Any]] = None
+        self.serving: Optional[Dict[str, Any]] = None
         self.history: List[Dict[str, Any]] = []
         self._history_max = history_max
 
@@ -404,6 +405,13 @@ class Telemetry:
         Telemetry is wired in; validated by ``validate_runreport``)."""
         self.resilience = dict(summary)
 
+    def record_serving(self, summary: Dict[str, Any]) -> None:
+        """Attach a ``ServingEngine.serving_summary()`` as the report's
+        optional ``serving`` section (TTFT/TPOT percentiles, aggregate
+        tokens/s, slot occupancy, KV-pool utilization — validated by
+        ``validate_runreport``)."""
+        self.serving = dict(summary)
+
     # ------------------------------------------------------------- finalize
 
     def _steady_steps(self) -> List[Dict[str, Any]]:
@@ -516,6 +524,8 @@ class Telemetry:
         }
         if self.resilience is not None:
             report["resilience"] = self.resilience
+        if self.serving is not None:
+            report["serving"] = self.serving
         if extra:
             report.update(extra)
         if self._is_master:

@@ -1,0 +1,45 @@
+"""serving — paged KV cache + continuous batching for high-throughput decode.
+
+``models/generate.py`` gives the framework *a* decode path; this package
+gives it a SERVING path: a vLLM-style block-pool KV cache
+(:mod:`.paged_cache`) and a slot-based continuous-batching engine
+(:mod:`.engine`) whose hot loop is two statically-shaped compiled programs
+— one decode step, one prefill-chunk step — however many requests of
+whatever shapes flow through.  Host code between ticks only rewrites
+small int32 block tables.
+
+The transformer math is NOT reimplemented here: ``cached_block_forward``
+(models/generate.py) takes ``cache_ops`` and both cache layouts run the
+same block, so paged decode agrees with contiguous ``generate()`` to the
+bit (tests/test_serving.py).  TP/DP sharding comes from the same mesh
+axes as training; ``obs`` integration reports TTFT/TPOT percentiles,
+aggregate tokens/s, slot occupancy and pool utilization in the RUNREPORT
+``serving`` section.  See docs/serving.md.
+"""
+
+from .engine import Request, ServingEngine
+from .paged_cache import (
+    NULL_BLOCK,
+    BlockAllocator,
+    block_size_of,
+    gather_kv,
+    init_paged_kv,
+    paged_attention,
+    paged_forward,
+    paged_forward_moe,
+    paged_write,
+)
+
+__all__ = [
+    "Request",
+    "ServingEngine",
+    "NULL_BLOCK",
+    "BlockAllocator",
+    "block_size_of",
+    "gather_kv",
+    "init_paged_kv",
+    "paged_attention",
+    "paged_forward",
+    "paged_forward_moe",
+    "paged_write",
+]

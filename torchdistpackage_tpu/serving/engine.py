@@ -1,0 +1,639 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+``generate()`` is a *batch* API: every sequence in a call shares one
+prompt length and one decode budget, and a new request waits for the whole
+batch to drain.  Serving traffic is nothing like that — requests arrive
+staggered, prompts and output lengths vary wildly, and throughput comes
+from keeping a fixed-size decode batch FULL (Orca/vLLM continuous
+batching).  This engine is that scheduler, built TPU-first:
+
+- **Fixed slots, compiled once.**  The decode batch is ``num_slots`` rows
+  forever.  A request occupies a slot from admission to retirement; freed
+  slots are refilled from the FIFO queue on the next tick.  Because every
+  device-side shape is static (``[num_slots, 1]`` tokens, ``[num_slots,
+  max_blocks]`` int32 tables, the block pool), the hot loop is exactly TWO
+  compiled programs — one decode step, one prefill-chunk step — and host
+  code between ticks only rewrites small int32 tables.  No shape ever
+  depends on which requests are in flight, so there is no per-request
+  retrace (``serving_summary()['decode_signatures']`` is the evidence).
+- **Chunked prefill.**  Prompts enter through the same paged forward in
+  ``chunk``-token slices, one slice per tick, batched across every
+  prefilling slot — a long prompt never stalls in-flight decodes for more
+  than one chunk's latency.  The final slice samples the first token
+  (per-slot ``last_idx`` picks the true last prompt row out of the padded
+  chunk), which is also when TTFT stops ticking.
+- **Per-slot sampling.**  Temperature / top-k / top-p and the PRNG key are
+  ``[num_slots]`` arrays, so every request keeps its own sampling policy
+  and stream inside one compiled sampler (temperature 0 = greedy, exactly
+  ``generate()``'s argmax).
+- **Retirement.**  EOS or the request's ``max_new_tokens`` frees the slot
+  and returns its blocks to the pool the same tick — no token of decode
+  compute is spent on finished rows beyond the step that finished them.
+- **TP/DP come from the mesh, not the code.**  With a mesh, the step runs
+  inside shard_map: KV heads and the vocab-parallel head shard over
+  ``axis`` (tp) exactly as in training/`generate()`, and slots + block
+  pool shard over ``dp_axis`` — each data group runs its own slice of the
+  slot batch against its own pool shard, so a ``tp_dp`` mesh serves with
+  zero engine changes.
+
+Observability: every lifecycle transition is a structured event
+(``request_admitted`` / ``prefill_chunk`` / ``request_retired`` /
+``slots_snapshot``), decode ticks are Telemetry steps when a session is
+wired in, and :meth:`ServingEngine.serving_summary` is the RUNREPORT
+``serving`` section — TTFT/TPOT percentiles, aggregate tokens/s, slot
+occupancy, and KV-pool utilization (the serving counterpart of the
+training MFU loop).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generate import _full_logits
+from ..models.gpt import GPTConfig
+from ..obs.aggregate import percentiles
+from ..obs.events import EventLog, default_event_log
+from .paged_cache import (
+    BlockAllocator,
+    init_paged_kv,
+    paged_forward,
+    paged_forward_moe,
+)
+
+# slot lifecycle
+FREE, PREFILL, DECODE = "free", "prefill", "decode"
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request.  ``temperature=0`` is greedy (bit-identical to
+    ``generate()``'s argmax); otherwise ``seed`` starts the slot's private
+    sampling stream.  ``eos_id`` retires the request early — a serving-
+    layer concern ``generate()`` deliberately doesn't have."""
+
+    tokens: Sequence[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_id: Optional[int] = None
+    seed: int = 0
+    rid: int = -1  # assigned at submit()
+
+    def __post_init__(self) -> None:
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if len(self.tokens) < 1:
+            raise ValueError("empty prompt")
+
+
+def _split_keys(keys: jnp.ndarray):
+    """[B, 2] uint32 -> (carried keys, this step's sample keys)."""
+    ks = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    return ks[:, 0], ks[:, 1]
+
+
+def _slot_sample(
+    logits: jnp.ndarray,
+    keys: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> jnp.ndarray:
+    """Vectorized per-slot sampler on full [B, V] logits: each row applies
+    ITS OWN temperature -> top-k -> top-p filter chain (the `_sample`
+    semantics, including the rank-0-always-kept nucleus edge) and draws
+    from its own key; ``temperature <= 0`` rows take the plain f32 argmax
+    — bitwise the ``generate()`` greedy choice."""
+    x = logits.astype(jnp.float32)
+    greedy = jnp.argmax(x, axis=-1).astype(jnp.int32)
+    V = x.shape[-1]
+    neg = jnp.float32(-jnp.inf)
+    xs = x / jnp.maximum(temperature, 1e-6)[:, None]
+    k = jnp.clip(top_k, 1, V)[:, None]
+    sorted_x = jnp.sort(xs, axis=-1)[:, ::-1]  # ONE descending sort
+    kth = jnp.take_along_axis(sorted_x, k - 1, axis=-1)
+    xs = jnp.where(xs < kth, neg, xs)
+    sorted_x = jnp.where(jnp.arange(V)[None, :] < k, sorted_x, neg)
+    probs = jax.nn.softmax(sorted_x, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = jnp.roll(cum, 1, axis=-1).at[:, 0].set(0.0) < top_p[:, None]
+    keep = keep.at[:, 0].set(True)  # argmax always survives (top_p -> 0)
+    cutoff = jnp.min(jnp.where(keep, sorted_x, jnp.inf), axis=-1,
+                     keepdims=True)
+    xs = jnp.where(xs < cutoff, neg, xs)
+    sampled = jax.vmap(jax.random.categorical)(keys, xs).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+class _SlotState:
+    """Host-side bookkeeping for one slot (device state lives in the
+    engine's int32/f32 arrays; this carries the request identity)."""
+
+    __slots__ = ("state", "rid", "req", "blocks", "prompt", "off",
+                 "generated", "t_submit", "t_admit", "t_last", "ttft_s",
+                 "tpot_s")
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.state = FREE
+        self.rid = -1
+        self.req: Optional[Request] = None
+        self.blocks: List[int] = []
+        self.prompt: Optional[np.ndarray] = None
+        self.off = 0
+        self.generated: List[int] = []
+        self.t_submit = self.t_admit = self.t_last = 0.0
+        self.ttft_s: Optional[float] = None
+        self.tpot_s: List[float] = []
+
+
+class ServingEngine:
+    """Paged-KV continuous-batching engine — see the module docstring for
+    the design.  Typical driver::
+
+        eng = ServingEngine(params, cfg, num_slots=8, block_size=16,
+                            telemetry=tel)
+        eng.submit(Request(prompt_ids, max_new_tokens=64))
+        eng.run_until_idle()
+        out = eng.finished[0]["tokens"]          # prompt + generated
+        tel.record_serving(eng.serving_summary())
+
+    Parameters
+    ----------
+    params: the model tree — plain arrays (serial) or device_put with the
+        training TP specs when a ``mesh`` is given.
+    num_slots: decode-batch width (divisible by the dp size).
+    block_size: KV positions per pool block.
+    num_blocks: pool blocks PER DP GROUP (incl. the reserved NULL block);
+        default sizes the pool so every slot can hold ``max_ctx``.
+    max_ctx: per-request ceiling on prompt + generated tokens; sets the
+        block-table width.  Default ``cfg.max_seq``.
+    chunk: prefill tokens per slot per tick.
+    mesh / axis / dp_axis / ep_axis: the serving mesh and its tp / dp /
+        expert axes; all None = single-device.  ``param_specs`` overrides
+        the auto-derived (``gpt_param_specs`` family) in_specs.
+    kv_quant: int8 block pool (``_kv_quant`` per-vector scales).
+    telemetry: an ``obs.Telemetry`` — decode ticks become steps (recompile
+        detection guards the compile-once contract) and events land on its
+        timeline.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: GPTConfig,
+        *,
+        num_slots: int = 4,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        max_ctx: Optional[int] = None,
+        chunk: int = 16,
+        mesh: Optional[Any] = None,
+        axis: Optional[str] = None,
+        dp_axis: Optional[str] = None,
+        ep_axis: Optional[str] = None,
+        param_specs: Optional[Any] = None,
+        kv_quant: bool = False,
+        telemetry: Optional[Any] = None,
+        snapshot_every: int = 16,
+    ) -> None:
+        if (axis is not None or dp_axis is not None) and mesh is None:
+            raise ValueError("axis/dp_axis need a mesh")
+        if cfg.attn_impl in ("ring", "ulysses"):
+            raise NotImplementedError(
+                "context-parallel serving is not supported: the KV pool is "
+                "not sequence-sharded (decode a CP-trained checkpoint with "
+                "attn_impl='flash', context_axis=None)")
+        if num_slots < 1 or chunk < 1 or block_size < 1:
+            raise ValueError(
+                f"num_slots/chunk/block_size must be >= 1, got "
+                f"{num_slots}/{chunk}/{block_size}")
+        self.cfg = cfg
+        self.params = params
+        self.num_slots = num_slots
+        self.block_size = block_size
+        self.chunk = chunk
+        self.mesh, self.axis, self.dp_axis = mesh, axis, dp_axis
+        self.ep_axis = ep_axis
+        self.kv_quant = kv_quant
+        self.telemetry = telemetry
+        self.snapshot_every = snapshot_every
+        self._ev: EventLog = (
+            telemetry.events if telemetry is not None else default_event_log())
+
+        self.max_ctx = int(max_ctx if max_ctx is not None else cfg.max_seq)
+        self.max_blocks = -(-self.max_ctx // block_size)  # table width
+        self.dp = int(mesh.shape[dp_axis]) if (mesh is not None and dp_axis) else 1
+        if num_slots % self.dp:
+            raise ValueError(
+                f"num_slots {num_slots} not divisible by dp {self.dp}")
+        self.slots_per_group = num_slots // self.dp
+        if num_blocks is None:
+            num_blocks = 1 + self.slots_per_group * self.max_blocks
+        self.num_blocks = num_blocks  # per dp group
+        self._allocs = [BlockAllocator(num_blocks) for _ in range(self.dp)]
+        self._param_specs = param_specs
+
+        cache = init_paged_kv(cfg, self.dp * num_blocks, block_size,
+                              quantized=kv_quant)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            cache = jax.tree.map(
+                lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+                cache, self._cache_specs(cache))
+        self.cache = cache
+
+        # host-visible device state, one row per slot
+        V = cfg.vocab_size
+        self._tables = np.zeros((num_slots, self.max_blocks), np.int32)
+        self._lengths = np.zeros(num_slots, np.int32)
+        self._last_tok = np.zeros(num_slots, np.int32)
+        self._temps = np.zeros(num_slots, np.float32)
+        self._top_k = np.full(num_slots, V, np.int32)
+        self._top_p = np.ones(num_slots, np.float32)
+        self._keys = np.zeros((num_slots, 2), np.uint32)
+
+        self._slots = [_SlotState() for _ in range(num_slots)]
+        self.queue: collections.deque = collections.deque()
+        self.finished: Dict[int, Dict[str, Any]] = {}
+        self._next_rid = 0
+        self._step_fn = self._build_step()
+        self._decode_fn = (
+            telemetry.wrap_step(self._step_fn) if telemetry is not None
+            else self._step_fn)
+        self.reset_metrics()
+
+    # ------------------------------------------------------------ compiled step
+
+    def _cache_specs(self, cache):
+        from jax.sharding import PartitionSpec as P
+
+        def spec(leaf):
+            lead = (None, self.dp_axis, self.axis)
+            return P(*lead, *([None] * (leaf.ndim - 3)))
+
+        return jax.tree.map(spec, cache)
+
+    def _build_step(self) -> Callable:
+        """ONE python step serves both phases: S_in=1 calls are the decode
+        step, S_in=chunk calls the prefill-chunk step — two signatures of
+        the same program, compiled once each."""
+        cfg, axis, ep_axis = self.cfg, self.axis, self.ep_axis
+        if cfg.moe_experts:
+            import functools
+
+            fwd = functools.partial(paged_forward_moe, ep_axis=ep_axis)
+        else:
+            fwd = paged_forward
+
+        def step(params, cache, tokens, tables, offsets, last_idx, samp, keys):
+            cache, logits = fwd(params, tokens, cfg, cache, tables, offsets,
+                                axis=axis, last_idx=last_idx)
+            full = _full_logits(logits, cfg, axis)
+            keys, sub = _split_keys(keys)
+            tok = _slot_sample(full, sub, samp["temperature"], samp["top_k"],
+                               samp["top_p"])
+            if axis is not None:
+                # every tp shard sampled the identical token (full logits
+                # are psum-assembled, keys replicated); pmax re-types it
+                # axis-invariant for the replicated out_spec
+                tok = jax.lax.pmax(tok, axis)
+            return cache, tok, keys
+
+        if self.mesh is None:
+            return jax.jit(step)
+        return self._mesh_step(step)
+
+    def _mesh_step(self, step):
+        from jax.sharding import PartitionSpec as P
+
+        from ..compat import shard_map
+
+        dp = self.dp_axis
+        row = P(dp) if dp else P()
+        in_specs = (
+            self.param_specs_cached(),
+            self._cache_specs(self.cache),
+            row, row, row, row,
+            {"temperature": row, "top_k": row, "top_p": row},
+            row,
+        )
+        out_specs = (self._cache_specs(self.cache), row, row)
+        return jax.jit(shard_map(
+            step, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs))
+
+    def param_specs_cached(self):
+        if getattr(self, "_param_specs", None) is None:
+            from ..models import gpt_moe_param_specs, gpt_param_specs
+
+            fn = gpt_moe_param_specs if self.cfg.moe_experts else gpt_param_specs
+            kw = {"ep_axis": self.ep_axis} if (
+                self.cfg.moe_experts and self.ep_axis) else {}
+            self._param_specs = fn(self.cfg, tp_axis=self.axis, **kw)
+        return self._param_specs
+
+    # ---------------------------------------------------------------- lifecycle
+
+    def submit(self, req: Request) -> int:
+        """Enqueue; returns the request id.  Raises if the request can
+        never fit the engine's context/pool ceilings (a too-long request
+        must fail loudly at the door, not deadlock the FIFO)."""
+        P, N = len(req.tokens), req.max_new_tokens
+        need = -(-(P + N) // self.block_size)
+        if P + N > self.max_ctx:
+            raise ValueError(
+                f"prompt {P} + max_new {N} exceeds max_ctx {self.max_ctx}")
+        if need > self._allocs[0].n_usable:
+            raise ValueError(
+                f"request needs {need} blocks, pool has "
+                f"{self._allocs[0].n_usable} per group")
+        if self.cfg.pos == "learned" and P + N > self.cfg.max_seq:
+            raise ValueError(
+                f"P + max_new_tokens = {P + N} exceeds the learned position "
+                f"table ({self.cfg.max_seq})")
+        req = dataclasses.replace(req, rid=self._next_rid)
+        self._next_rid += 1
+        self.queue.append((req, time.perf_counter()))
+        return req.rid
+
+    def _admit(self) -> int:
+        """FIFO admission: the head request takes the first free slot
+        whose dp group can cover its blocks.  Head-of-line blocking is
+        deliberate — skipping ahead would starve long requests."""
+        admitted = 0
+        while self.queue:
+            req, t_submit = self.queue[0]
+            P, N = len(req.tokens), req.max_new_tokens
+            need = -(-(P + N) // self.block_size)
+            slot_idx = None
+            for i, s in enumerate(self._slots):
+                if s.state != FREE:
+                    continue
+                if self._allocs[i // self.slots_per_group].n_free >= need:
+                    slot_idx = i
+                    break
+            if slot_idx is None:
+                break
+            self.queue.popleft()
+            blocks = self._allocs[slot_idx // self.slots_per_group].alloc(need)
+            s = self._slots[slot_idx]
+            s.state, s.rid, s.req, s.blocks = PREFILL, req.rid, req, blocks
+            s.prompt = np.asarray(req.tokens, np.int32)
+            s.off, s.generated = 0, []
+            s.t_submit, s.t_admit = t_submit, time.perf_counter()
+            s.ttft_s, s.tpot_s = None, []
+            self._tables[slot_idx] = 0
+            self._tables[slot_idx, :need] = blocks
+            self._lengths[slot_idx] = 0
+            self._temps[slot_idx] = req.temperature
+            self._top_k[slot_idx] = (
+                req.top_k if req.top_k is not None else self.cfg.vocab_size)
+            self._top_p[slot_idx] = (
+                req.top_p if req.top_p is not None else 1.0)
+            self._keys[slot_idx] = np.asarray(
+                jax.random.PRNGKey(req.seed), np.uint32)
+            self._ev.emit(
+                "request_admitted", rid=req.rid, slot=slot_idx,
+                prompt_len=int(P), max_new_tokens=int(N), blocks=need,
+                queue_wait_s=round(s.t_admit - t_submit, 6))
+            admitted += 1
+        return admitted
+
+    def _masked(self, state: str) -> np.ndarray:
+        """Table rows for slots NOT in ``state`` zeroed (NULL block) so a
+        phase's step can never touch another phase's cache blocks."""
+        m = np.array([s.state == state for s in self._slots], bool)
+        t = np.where(m[:, None], self._tables, 0).astype(np.int32)
+        return m, t
+
+    def _samp(self) -> Dict[str, np.ndarray]:
+        return {"temperature": self._temps, "top_k": self._top_k,
+                "top_p": self._top_p}
+
+    def _sig(self, tokens: np.ndarray) -> tuple:
+        return (tokens.shape, str(tokens.dtype), self.num_slots,
+                self.max_blocks)
+
+    def _prefill_tick(self) -> int:
+        """One ``chunk``-token slice for EVERY prefilling slot, batched in
+        one compiled call.  Slots whose slice covers the last prompt row
+        sample their first token (TTFT) and move to DECODE."""
+        mask, tables = self._masked(PREFILL)
+        if not mask.any():
+            return 0
+        B, C = self.num_slots, self.chunk
+        tokens = np.zeros((B, C), np.int32)
+        offsets = np.zeros(B, np.int32)
+        last_idx = np.zeros(B, np.int32)
+        for i, s in enumerate(self._slots):
+            if s.state != PREFILL:
+                continue
+            sl = s.prompt[s.off:s.off + C]
+            tokens[i, :len(sl)] = sl
+            offsets[i] = s.off
+            last_idx[i] = min(len(s.prompt) - 1 - s.off, C - 1)
+        self.cache, tok, keys = self._step_fn(
+            self.params, self.cache, tokens, tables, offsets, last_idx,
+            self._samp(), self._keys)
+        self._prefill_sigs.add(("prefill",) + self._sig(tokens))
+        tok = np.asarray(tok)
+        keys = np.asarray(keys)
+        now = time.perf_counter()
+        rids = []
+        for i, s in enumerate(self._slots):
+            if s.state != PREFILL:
+                continue
+            rids.append(s.rid)
+            s.off += C
+            if s.off >= len(s.prompt):  # final slice: first token sampled
+                self._keys[i] = keys[i]
+                s.state = DECODE
+                s.ttft_s = now - s.t_submit
+                s.t_last = now
+                self._lengths[i] = len(s.prompt)
+                self._last_tok[i] = tok[i]
+                s.generated.append(int(tok[i]))
+                self._maybe_retire(i, int(tok[i]), now)
+        self.stats["prefill_chunks"] += 1
+        self._ev.emit("prefill_chunk", rids=rids, chunk=C,
+                      n_slots=len(rids))
+        return len(rids)
+
+    def _decode_tick(self) -> int:
+        mask, tables = self._masked(DECODE)
+        n_active = int(mask.sum())
+        if n_active == 0:
+            return 0
+        tokens = np.where(mask, self._last_tok, 0).astype(np.int32)[:, None]
+        offsets = np.where(mask, self._lengths, 0).astype(np.int32)
+        last_idx = np.zeros(self.num_slots, np.int32)
+        self.cache, tok, keys = self._decode_fn(
+            self.params, self.cache, tokens, tables, offsets, last_idx,
+            self._samp(), self._keys)
+        self._decode_sigs.add(("decode",) + self._sig(tokens))
+        if self.telemetry is not None:
+            self.telemetry.end_step(active_slots=n_active)
+        tok = np.asarray(tok)
+        keys = np.asarray(keys)
+        now = time.perf_counter()
+        for i, s in enumerate(self._slots):
+            if s.state != DECODE:
+                continue
+            self._keys[i] = keys[i]
+            self._lengths[i] += 1
+            self._last_tok[i] = tok[i]
+            s.generated.append(int(tok[i]))
+            s.tpot_s.append(now - s.t_last)
+            s.t_last = now
+            self._maybe_retire(i, int(tok[i]), now)
+        self.stats["decode_steps"] += 1
+        self.stats["decode_slot_steps"] += n_active
+        return n_active
+
+    def _maybe_retire(self, i: int, tok: int, now: float) -> None:
+        s = self._slots[i]
+        req = s.req
+        done_eos = req.eos_id is not None and tok == req.eos_id
+        done_len = len(s.generated) >= req.max_new_tokens
+        if not (done_eos or done_len):
+            return
+        self.finished[s.rid] = {
+            "rid": s.rid,
+            "tokens": np.concatenate(
+                [s.prompt, np.asarray(s.generated, np.int32)]),
+            "prompt_len": int(len(s.prompt)),
+            "new_tokens": len(s.generated),
+            "reason": "eos" if done_eos else "max_tokens",
+            "ttft_s": s.ttft_s,
+            "tpot_s": list(s.tpot_s),
+            "t_submit": s.t_submit,
+            "t_done": now,
+        }
+        self._ttfts.append(s.ttft_s)
+        self._tpots.extend(s.tpot_s)
+        self.stats["generated_tokens"] += len(s.generated)
+        self._t_first = min(self._t_first, s.t_submit)
+        self._t_last_done = max(self._t_last_done, now)
+        self._ev.emit(
+            "request_retired", rid=s.rid, slot=i,
+            reason=self.finished[s.rid]["reason"],
+            new_tokens=len(s.generated),
+            ttft_s=round(s.ttft_s, 6) if s.ttft_s is not None else None)
+        self._allocs[i // self.slots_per_group].free(s.blocks)
+        self._tables[i] = 0
+        self._lengths[i] = 0
+        self._last_tok[i] = 0
+        self._temps[i] = 0.0
+        s.reset()
+
+    # -------------------------------------------------------------- driver API
+
+    @property
+    def n_busy(self) -> int:
+        return sum(s.state != FREE for s in self._slots)
+
+    def step(self) -> Dict[str, int]:
+        """One engine tick: admit -> one prefill slice -> one decode step.
+        Returns what happened (all zeros = idle)."""
+        self._tick += 1
+        admitted = self._admit()
+        prefilled = self._prefill_tick()
+        decoded = self._decode_tick()
+        busy = self.n_busy
+        self._occ_sum += busy / self.num_slots
+        util = float(np.mean([a.utilization() for a in self._allocs]))
+        self._util_sum += util
+        self._occ_ticks += 1
+        if self.snapshot_every and self._tick % self.snapshot_every == 0:
+            self._ev.emit(
+                "slots_snapshot", tick=self._tick, busy=busy,
+                queued=len(self.queue), pool_utilization=round(util, 4))
+        return {"admitted": admitted, "prefill_slots": prefilled,
+                "decode_slots": decoded, "busy": busy}
+
+    def run_until_idle(self, max_ticks: int = 100_000) -> None:
+        """Drain the queue and every in-flight slot."""
+        while self.queue or self.n_busy:
+            self.step()
+            if self._tick > max_ticks:
+                raise RuntimeError(
+                    f"engine did not drain within {max_ticks} ticks "
+                    f"(queued={len(self.queue)}, busy={self.n_busy})")
+
+    def reset_metrics(self) -> None:
+        """Zero the serving metrics (the bench's warmup/measure split);
+        compiled steps, pool, and queue state are untouched."""
+        self.stats = {"decode_steps": 0, "prefill_chunks": 0,
+                      "decode_slot_steps": 0, "generated_tokens": 0}
+        self._decode_sigs: set = set()
+        self._prefill_sigs: set = set()
+        self._ttfts: List[float] = []
+        self._tpots: List[float] = []
+        self._tick = 0
+        self._occ_sum = self._util_sum = 0.0
+        self._occ_ticks = 0
+        self._t_first = float("inf")
+        self._t_last_done = 0.0
+        self.finished = {}
+        for a in self._allocs:
+            a.peak_in_use = a.in_use
+
+    # ------------------------------------------------------------------ report
+
+    def serving_summary(self) -> Dict[str, Any]:
+        """The RUNREPORT ``serving`` section (``Telemetry.record_serving``
+        attaches it; ``validate_runreport`` checks it)."""
+        span = self._t_last_done - self._t_first
+        n_req = len(self.finished)
+        peak_util = max(a.peak_in_use for a in self._allocs) / (
+            self._allocs[0].n_usable)
+        return {
+            "requests": {"completed": n_req, "queued": len(self.queue),
+                         "in_flight": self.n_busy},
+            "generated_tokens": self.stats["generated_tokens"],
+            "tokens_per_sec": (
+                self.stats["generated_tokens"] / span
+                if span > 0 and n_req else 0.0),
+            "ttft_s": percentiles([t for t in self._ttfts if t is not None]),
+            "tpot_s": percentiles(self._tpots),
+            "slot_occupancy": {
+                "mean": (self._occ_sum / self._occ_ticks
+                         if self._occ_ticks else 0.0),
+                "num_slots": self.num_slots,
+            },
+            "kv_pool": {
+                "num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "dp_groups": self.dp,
+                "mean_utilization": (self._util_sum / self._occ_ticks
+                                     if self._occ_ticks else 0.0),
+                "peak_utilization": peak_util,
+            },
+            "decode_steps": self.stats["decode_steps"],
+            "prefill_chunks": self.stats["prefill_chunks"],
+            "decode_batch_mean": (
+                self.stats["decode_slot_steps"] / self.stats["decode_steps"]
+                if self.stats["decode_steps"] else 0.0),
+            # compile-once evidence: distinct device-call signatures the
+            # engine issued (must be 1 per phase however many requests of
+            # whatever shapes were served)
+            "decode_signatures": len(self._decode_sigs),
+            "prefill_signatures": len(self._prefill_sigs),
+        }

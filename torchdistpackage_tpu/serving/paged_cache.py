@@ -1,0 +1,343 @@
+"""Paged KV cache: a block-pool layout for the serving engine.
+
+``models/generate.py`` reserves one contiguous ``[B, Hkv, max_len, hd]``
+strip per sequence — every request pays ``max_len`` KV positions of HBM up
+front, whatever it actually uses, and a batch must share one prompt length
+and one decode budget.  The vLLM observation is that a KV cache is a heap,
+not an array: carve the buffer into fixed ``block_size``-position blocks,
+hand each sequence an int32 *block table* naming the blocks it owns, and
+both problems disappear — memory is allocated in block quanta as the
+sequence grows, and sequences of wildly different lengths coexist in one
+fixed-shape decode batch.
+
+TPU-first translation (everything here is static-shape, so the decode step
+compiles ONCE):
+
+- **Pool**: ``{'k','v': [L, num_blocks, Hkv, block_size, hd]}`` — one
+  device buffer for the whole engine.  ``quantized=True`` stores int8
+  ``(q8, scale)`` pairs via the same ``_kv_quant`` per-vector symmetric
+  scheme as the contiguous cache (scale ``[L, num_blocks, Hkv,
+  block_size]`` f32), halving KV HBM at long context.
+- **Block tables**: ``[num_slots, max_blocks]`` int32 per-slot rows.  Block
+  ``i`` of a slot's table covers its positions ``[i*bs, (i+1)*bs)``, so the
+  table IS the page table and position arithmetic is two integer ops.
+  Block 0 is the engine's NULL block (never allocated): inactive slots and
+  out-of-range clamped writes land there and are never read.
+- **Write** is a vectorized scatter (disjoint blocks per slot — no
+  collisions among live slots); **attend** gathers a slot's blocks into a
+  dense ``[B, Hkv, max_blocks*bs, hd]`` view through the table and runs
+  the SAME ``_cached_attention`` as the contiguous path with per-slot [B]
+  offsets.  Gathered index == slot-relative position (tables list blocks
+  in order), so the causal/sliding-window mask carries over unchanged, and
+  when the gathered view matches the contiguous buffer's length the two
+  paths agree BITWISE (tests/test_serving.py locks this for dense, GQA,
+  sliding-window, and MoE families).
+
+The allocator (:class:`BlockAllocator`) is host-side and O(blocks): the
+hot loop never reallocates device memory — host code only rewrites small
+int32 tables between compiled steps (see ``serving/engine.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.generate import (
+    _cached_attention,
+    _embed_at,
+    _kv_quant,
+    cached_block_forward,
+)
+from ..models.gpt import GPTConfig, gpt_head
+from ..parallel.tensor_parallel.layers import rope_cache
+
+PyTree = Any
+
+#: Block id 0 is reserved by the engine as the write-off target: inactive
+#: slots' tables are all-zero and clamped out-of-range writes land here.
+#: No live slot's table ever references it, so its contents are never read.
+NULL_BLOCK = 0
+
+
+def init_paged_kv(
+    cfg: GPTConfig, num_blocks: int, block_size: int, axis_size: int = 1,
+    quantized: bool = False,
+) -> Dict[str, Any]:
+    """Zeroed block pool ``{'k','v': [L, num_blocks, Hkv_local, block_size,
+    hd]}`` in ``cfg.dtype`` — the paged analogue of ``init_kv_cache``.
+    ``axis_size`` divides the KV heads for TP (build the global array and
+    shard dim 2 over the tensor axis, or call inside shard_map).
+    ``quantized=True``: int8 ``(q8, scale)`` pairs per entry, the same
+    per-position-vector symmetric scheme as the contiguous cache."""
+    hkv, rem = divmod(cfg.block.kv_head_count, axis_size)
+    if rem or hkv == 0:
+        raise ValueError(
+            f"kv_heads {cfg.block.kv_head_count} not divisible by tp "
+            f"{axis_size} (whole KV heads per shard)"
+        )
+    if num_blocks < 2:
+        raise ValueError(
+            f"num_blocks must be >= 2 (block 0 is the reserved NULL block), "
+            f"got {num_blocks}")
+    shape = (cfg.nlayers, num_blocks, hkv, block_size, cfg.block.head_dim)
+    if quantized:
+        def entry():
+            return (jnp.zeros(shape, jnp.int8),
+                    jnp.ones(shape[:-1], jnp.float32))
+        return {"k": entry(), "v": entry()}
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def block_size_of(cache: Dict[str, Any]) -> int:
+    """The pool's block size, tuple-safe (quantized pools store pairs)."""
+    k = cache["k"]
+    return (k[0] if isinstance(k, tuple) else k).shape[3]
+
+
+def _scatter_positions(tables: jnp.ndarray, pos: jnp.ndarray, block_size: int):
+    """Map absolute per-slot positions [B, S] -> (block ids [B*S], in-block
+    offsets [B*S]) through the block tables.  Positions past a table's
+    width clamp to its last entry — unallocated entries are NULL_BLOCK, so
+    overshoot (padded prefill tails) lands in the write-off block."""
+    max_blocks = tables.shape[1]
+    blk = jnp.take_along_axis(
+        tables, jnp.clip(pos // block_size, 0, max_blocks - 1), axis=1)
+    return blk.reshape(-1), (pos % block_size).reshape(-1)
+
+
+def paged_write(c, val: jnp.ndarray, offset, *, tables: jnp.ndarray):
+    """Scatter ``val`` [B, Hkv, S_in, hd] into the per-layer pool ``c``
+    ([num_blocks, Hkv, bs, hd] or its quantized pair) at per-slot positions
+    ``offset[b] + arange(S_in)`` via the block tables.  Live slots own
+    disjoint blocks, so the scatter has no racing duplicates (only the
+    NULL block absorbs colliding writes, and it is never read)."""
+    B, Hkv, S_in, hd = val.shape
+    bs = (c[0] if isinstance(c, tuple) else c).shape[2]
+    pos = jnp.asarray(offset)[:, None] + jnp.arange(S_in)[None, :]  # [B, S]
+    blk, idx = _scatter_positions(tables, pos, bs)
+    vals = val.transpose(0, 2, 1, 3).reshape(B * S_in, Hkv, hd)
+    if isinstance(c, tuple):
+        q8, scale = c
+        vq, vs = _kv_quant(vals)  # per-vector: identical to contiguous path
+        return (q8.at[blk, :, idx].set(vq), scale.at[blk, :, idx].set(vs))
+    return c.at[blk, :, idx].set(vals.astype(c.dtype))
+
+
+def gather_kv(c, tables: jnp.ndarray):
+    """Per-layer pool -> dense per-slot view [B, Hkv, max_blocks*bs, hd]
+    (or its quantized pair) through the block tables.  Gathered index ==
+    slot-relative position, so the result drops straight into
+    ``_cached_attention`` in place of the contiguous buffer."""
+    if isinstance(c, tuple):
+        q8, scale = c
+        g = q8[tables]  # [B, nb, Hkv, bs, hd]
+        B, nb, Hkv, bs, hd = g.shape
+        gs = scale[tables].transpose(0, 2, 1, 3).reshape(B, Hkv, nb * bs)
+        return (g.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, nb * bs, hd), gs)
+    g = c[tables]
+    B, nb, Hkv, bs, hd = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, nb * bs, hd)
+
+
+def paged_attention(
+    q: jnp.ndarray, ck, cv, offset, *, tables: jnp.ndarray, window=None,
+) -> jnp.ndarray:
+    """Attention of q [B, H, S_in, hd] against each slot's paged context:
+    gather the slot's blocks dense, then the contiguous `_cached_attention`
+    with per-slot [B] offsets — one attention implementation, two cache
+    layouts."""
+    return _cached_attention(
+        q, gather_kv(ck, tables), gather_kv(cv, tables), offset,
+        window=window)
+
+
+def _paged_cache_ops(tables: jnp.ndarray):
+    """The ``cache_ops`` pair ``cached_block_forward`` needs to run on the
+    block pool instead of the contiguous buffer."""
+    def attend(q, ck, cv, offset, window=None):
+        return paged_attention(q, ck, cv, offset, tables=tables,
+                               window=window)
+    return functools.partial(paged_write, tables=tables), attend
+
+
+def _batched_rope(bcfg, positions: jnp.ndarray):
+    """Per-slot rope tables: positions [B, S] -> (cos, sin) [B, 1, S,
+    hd/2].  Reuses ``rope_cache`` on the flattened positions so each
+    position's rotation is bitwise the table the contiguous path computes
+    for it."""
+    if not bcfg.rope:
+        return None
+    B, S = positions.shape
+    cos, sin = rope_cache(
+        positions.reshape(-1), bcfg.head_dim, bcfg.rope_theta,
+        scaling=bcfg.rope_scaling)
+    half = cos.shape[-1]
+    return (cos.reshape(B, S, half)[:, None], sin.reshape(B, S, half)[:, None])
+
+
+def _select_row(h: jnp.ndarray, last_idx) -> jnp.ndarray:
+    """h [B, S, D] -> [B, 1, D] at per-slot row ``last_idx`` ([B] int32);
+    None = the last row (the decode case, bitwise the contiguous slice)."""
+    if last_idx is None:
+        return h[:, -1:, :]
+    idx = jnp.clip(jnp.asarray(last_idx), 0, h.shape[1] - 1)
+    return jnp.take_along_axis(h, idx[:, None, None], axis=1)
+
+
+def paged_forward(
+    params: Dict[str, PyTree],
+    tokens: jnp.ndarray,
+    cfg: GPTConfig,
+    cache: Dict[str, Any],
+    tables: jnp.ndarray,
+    offset: jnp.ndarray,
+    axis: Optional[str] = None,
+    last_idx=None,
+) -> Tuple[Dict[str, Any], jnp.ndarray]:
+    """``forward_cached`` over the block pool: run ``tokens`` [B, S_in]
+    (slot b's rows occupy global positions ``offset[b] + arange(S_in)``)
+    through the cached stack, writing k/v into each slot's blocks and
+    attending through its table.  Returns the updated pool and the logits
+    [B, V_local] read at per-slot row ``last_idx`` (default: the last row
+    — the decode case).  The layer dim rides the same ``lax.scan`` as the
+    contiguous path; chunked prefill is just S_in=chunk at a running
+    offset — one implementation, both phases, either layout."""
+    bcfg = cfg.block
+    S_in = tokens.shape[1]
+    offset = jnp.asarray(offset, jnp.int32)
+    positions = offset[:, None] + jnp.arange(S_in)[None, :]
+    h = _embed_at(params, tokens, positions, axis)
+    rope = _batched_rope(bcfg, positions)
+    ops = _paged_cache_ops(tables)
+
+    def body(hc, xs):
+        lp, ck, cv = xs
+        y, ck, cv = cached_block_forward(
+            lp, hc, bcfg, ck, cv, offset, axis=axis, rope=rope,
+            cache_ops=ops)
+        return y, (ck, cv)
+
+    h, (ck, cv) = jax.lax.scan(
+        body, h, (params["blocks"], cache["k"], cache["v"]))
+    logits = gpt_head(params, _select_row(h, last_idx), axis, False,
+                      eps=cfg.norm_eps)
+    return {"k": ck, "v": cv}, logits[:, 0, :]
+
+
+def paged_forward_moe(
+    params: Dict[str, PyTree],
+    tokens: jnp.ndarray,
+    cfg: GPTConfig,
+    cache: Dict[str, Any],
+    tables: jnp.ndarray,
+    offset: jnp.ndarray,
+    axis: Optional[str] = None,
+    last_idx=None,
+    ep_axis: Optional[str] = None,
+) -> Tuple[Dict[str, Any], jnp.ndarray]:
+    """:func:`paged_forward` for the MoE family (heterogeneous block list,
+    expert FFN every moe_every-th block) — the same exact no-drop serving
+    dispatch as ``forward_cached_moe`` (its docstring has the semantics:
+    ragged grouped GEMMs when ``ep_axis`` is None, EP-sharded exchange at
+    no-drop capacity when set), attending through the block tables."""
+    import dataclasses as _dc
+
+    from ..models.gpt_moe import moe_layer_config
+    from ..parallel.moe import moe_forward, moe_serve_forward
+
+    bcfg = cfg.block
+    mcfg = moe_layer_config(cfg)
+    mcfg = _dc.replace(
+        mcfg,
+        capacity_factor=max(mcfg.capacity_factor,
+                            mcfg.num_experts / mcfg.top_k),
+    )
+    S_in = tokens.shape[1]
+    offset = jnp.asarray(offset, jnp.int32)
+    positions = offset[:, None] + jnp.arange(S_in)[None, :]
+    h = _embed_at(params, tokens, positions, axis)
+    rope = _batched_rope(bcfg, positions)
+    ops = _paged_cache_ops(tables)
+
+    if ep_axis is None:
+        def moe_ffn(p, hh):
+            return moe_serve_forward(p["moe"], hh, mcfg)
+    else:
+        def moe_ffn(p, hh):
+            z, _aux = moe_forward(
+                p["moe"], hh, mcfg, ep_axis=ep_axis, causal=bcfg.causal)
+            return z
+
+    ks, vs = [], []
+    layer = lambda c, i: jax.tree.map(lambda a: a[i], c)  # tuple-safe (int8)
+    for i, bp in enumerate(params["blocks"]):
+        h, ck, cv = cached_block_forward(
+            bp, h, bcfg, layer(cache["k"], i), layer(cache["v"], i), offset,
+            axis=axis, rope=rope, ffn=moe_ffn if "moe" in bp else None,
+            cache_ops=ops,
+        )
+        ks.append(ck)
+        vs.append(cv)
+    stack = lambda cs: jax.tree.map(lambda *xs: jnp.stack(xs), *cs)
+    cache = {"k": stack(ks), "v": stack(vs)}
+    logits = gpt_head(params, _select_row(h, last_idx), axis, False,
+                      eps=cfg.norm_eps)
+    return cache, logits[:, 0, :]
+
+
+class BlockAllocator:
+    """Host-side free-list over a pool's blocks (block 0 reserved as the
+    NULL block).  LIFO reuse keeps recently-freed blocks hot.  Pure
+    python — allocation happens between compiled steps and only ever
+    rewrites int32 tables, never device buffers."""
+
+    def __init__(self, num_blocks: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is reserved), "
+                f"got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._live: set = set()
+        self.peak_in_use = 0
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_usable(self) -> int:
+        """Allocatable blocks (pool minus the NULL block)."""
+        return self.num_blocks - 1
+
+    @property
+    def in_use(self) -> int:
+        return len(self._live)
+
+    def utilization(self) -> float:
+        return self.in_use / self.n_usable
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` blocks, or None when the pool can't cover the request
+        (the engine's admission back-pressure signal — nothing is
+        partially allocated)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._live.update(blocks)
+        self.peak_in_use = max(self.peak_in_use, len(self._live))
+        return blocks
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b == NULL_BLOCK or b not in self._live:
+                raise ValueError(
+                    f"freeing block {b} not handed out by this allocator")
+            self._live.discard(b)
+            self._free.append(b)

@@ -22,6 +22,14 @@ Phase separation without a profiler: a generation of n tokens costs
 rep gives one sample of each phase per rep by differencing.  Results are
 recorded in docs/BENCH_AB.md.
 
+``--serve`` benches the continuous-batching engine
+(``serving.ServingEngine``) against the sequential batch-of-1
+``generate()`` baseline at the same params, over a fixed-seed Poisson-ish
+arrival schedule with mixed output lengths — the workload continuous
+batching exists for.  Emits ``serve-latency`` JSON lines (TTFT/TPOT
+percentiles, same schema as the per-phase cells), an aggregate
+serve-vs-sequential speedup line, and the RUNREPORT ``serving`` section.
+
 ``--trace out.json`` additionally prints the comm-ledger summary of the
 compiled decode step (one extra AOT compile) and writes the run's
 Perfetto-loadable Chrome trace — cells appear as instant events on the
@@ -31,6 +39,7 @@ per-step spans; the event timeline and ledger still render).
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -110,7 +119,144 @@ def _phase_lines(B, ctx, variant, prefill_s, decode_s):
     return out
 
 
-def main():
+def bench_serve(jax, jnp, cfg, params, tel, *, n_requests, num_slots,
+                block_size, chunk, seed, smoke):
+    """Continuous batching vs sequential batch-of-1 ``generate()`` at
+    EQUAL params, over a fixed-seed Poisson-ish arrival schedule with
+    mixed prompt/output lengths — the traffic shape the engine exists
+    for.  Both arms replay the identical schedule (a request cannot start
+    before its arrival time) with compiles warmed up-front, so the
+    speedup line measures scheduling, not tracing.  Returns the engine's
+    ``serving_summary()`` plus the baseline numbers."""
+    import numpy as np
+
+    from ..models import generate
+    from ..serving import Request, ServingEngine
+    from ..utils.logging import master_print
+
+    rng = np.random.RandomState(seed)
+    # shapes drawn from small sets so the baseline's per-(P, N) jit
+    # signatures stay bounded (the engine needs no such mercy: its two
+    # programs are shape-blind)
+    # arrivals must outpace single-request service for continuous batching
+    # to have anything to win: mean gap ~ a fraction of one request's
+    # decode time, so the sequential arm queues while the engine overlaps
+    p_lens = [4, 8] if smoke else [16, 32, 64]
+    n_lens = [8, 12] if smoke else [8, 16, 32]
+    arrival_scale = 0.002 if smoke else 0.05
+    sched, t = [], 0.0
+    for _ in range(n_requests):
+        P, N = int(rng.choice(p_lens)), int(rng.choice(n_lens))
+        prompt = rng.randint(0, cfg.vocab_size, size=P).tolist()
+        t += float(rng.exponential(scale=arrival_scale))
+        sched.append((t, prompt, N))
+
+    # --- engine arm (throwaway request warms both compiled steps)
+    eng = ServingEngine(params, cfg, num_slots=num_slots,
+                        block_size=block_size, chunk=chunk, telemetry=tel,
+                        max_ctx=max(p_lens) + max(n_lens))
+    eng.submit(Request(sched[0][1], sched[0][2]))
+    eng.run_until_idle()
+    eng.reset_metrics()
+    pending = list(sched)
+    t0 = time.perf_counter()
+    while pending or eng.n_busy or eng.queue:
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            _, prompt, N = pending.pop(0)
+            eng.submit(Request(prompt, N))
+        if not (eng.n_busy or eng.queue):
+            time.sleep(min(1e-3, max(0.0, pending[0][0] - now)))
+            continue
+        eng.step()
+    summary = eng.serving_summary()
+
+    # --- sequential baseline: batch-of-1 generate(), FIFO, arrival-gated
+    fns = {}
+    for _, prompt, N in sched:
+        key = (len(prompt), N)
+        if key not in fns:
+            f = jax.jit(lambda p, tk, n=N: generate(
+                p, tk, cfg, max_new_tokens=n))
+            int(f(params, jnp.ones((1, key[0]), jnp.int32))[0, -1])  # warm
+            fns[key] = f
+    t0 = time.perf_counter()
+    t_first = None
+    tokens = 0
+    for arr, prompt, N in sched:
+        wait = arr - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        if t_first is None:
+            t_first = time.perf_counter()
+        int(fns[(len(prompt), N)](
+            params, jnp.asarray(prompt, jnp.int32)[None])[0, -1])  # sync
+        tokens += N
+    seq_tok_s = tokens / (time.perf_counter() - t_first)
+
+    for phase, key in (("ttft", "ttft_s"), ("tpot", "tpot_s")):
+        pct = summary.get(key) or {}
+        if not pct:
+            continue
+        master_print(json.dumps({
+            "metric": "serve-latency", "phase": phase, "unit": "ms",
+            "n_requests": summary["requests"]["completed"],
+            "num_slots": num_slots,
+            **{f"{k}_ms": round(v * 1e3, 4) for k, v in pct.items()},
+        }), flush=True)
+    master_print(json.dumps({
+        "metric": "serve-throughput",
+        "n_requests": n_requests, "num_slots": num_slots,
+        "block_size": block_size, "chunk": chunk,
+        "serve_tok_s": round(summary["tokens_per_sec"], 1),
+        "sequential_tok_s": round(seq_tok_s, 1),
+        "speedup": round(summary["tokens_per_sec"] / seq_tok_s, 3)
+        if seq_tok_s > 0 else None,
+        "slot_occupancy_mean": round(
+            summary["slot_occupancy"]["mean"], 4),
+        "kv_pool_mean_utilization": round(
+            summary["kv_pool"]["mean_utilization"], 4),
+        # compile-once evidence: however many request shapes flowed
+        # through, the engine issued exactly one signature per phase
+        "decode_signatures": summary["decode_signatures"],
+        "prefill_signatures": summary["prefill_signatures"],
+    }), flush=True)
+    summary["sequential_tok_s"] = seq_tok_s
+    tel.record_serving(summary)
+    return summary
+
+
+def _parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m torchdistpackage_tpu.tools.decode_bench",
+        description="Decode/serving throughput benchmark "
+                    "(bf16 vs int8 cells; --serve for the "
+                    "continuous-batching engine A/B).")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny config (implied by TDP_CPU_SIM)")
+    ap.add_argument("--trace", metavar="OUT_JSON", default=None,
+                    help="write a Perfetto-loadable Chrome trace and print "
+                         "the compiled decode step's comm ledger")
+    ap.add_argument("--serve", action="store_true",
+                    help="bench the continuous-batching engine against the "
+                         "sequential batch-of-1 generate() baseline "
+                         "(replaces the weight-quant cells)")
+    ap.add_argument("--serve-requests", type=int, default=None,
+                    metavar="N", help="requests in the --serve schedule "
+                    "(default: 8 smoke / 24 full)")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="--serve decode-batch width (default 4)")
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="--serve KV pool block size (default 16)")
+    ap.add_argument("--chunk", type=int, default=16,
+                    help="--serve prefill chunk tokens (default 16)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="--serve arrival-schedule seed (default 0)")
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv)
     if os.environ.get("TDP_CPU_SIM"):
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -124,7 +270,7 @@ def main():
     from ..utils.logging import master_print
     from .surgery import quantize_decode_params
 
-    smoke = bool(os.environ.get("TDP_CPU_SIM")) or "--smoke" in sys.argv
+    smoke = bool(os.environ.get("TDP_CPU_SIM")) or args.smoke
     dt = jnp.bfloat16
     if smoke:
         cfg = GPTConfig(vocab_size=256, dim=128, nheads=4, nlayers=2,
@@ -138,11 +284,7 @@ def main():
         cells = [(1, 128), (1, 1024), (8, 128), (8, 1024)]
         steps, reps = 64, 5
 
-    trace_path = None
-    if "--trace" in sys.argv:
-        i = sys.argv.index("--trace")
-        if i + 1 < len(sys.argv):
-            trace_path = sys.argv[i + 1]
+    trace_path = args.trace
 
     # the bench is its own telemetry session: latency cells land in the
     # counters of an end-of-run RUNREPORT (TDP_RUNREPORT env) like any
@@ -182,6 +324,13 @@ def main():
                          file=sys.stderr)
 
     latency_cells = []
+    if args.serve:
+        cells = []  # the engine A/B is its own arm
+        bench_serve(
+            jax, jnp, cfg, params, tel,
+            n_requests=args.serve_requests or (12 if smoke else 24),
+            num_slots=args.slots, block_size=args.block_size,
+            chunk=args.chunk, seed=args.seed, smoke=smoke)
     for B, ctx in cells:
         r_bf, pre_bf, dec_bf = bench_decode(jax, jnp, cfg, params, B, ctx,
                                             steps, reps)
