@@ -289,7 +289,13 @@ def _last_good_accel_line(baselines: dict, reason: str = "unreachable"):
 def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None,
                 trace=None):
     """One timed measurement; returns (tokens_per_sec_chip, global_batch,
-    flops_per_token, xla_flops_per_token, comm_ledger).
+    flops_per_token, xla_flops_per_token, comm_ledger, mem).
+
+    ``mem`` carries the run's memory evidence (obs.mem_ledger):
+    ``peak_hbm_bytes`` (max per-device measured peak) and
+    ``mem_headroom_frac`` (1 - peak/capacity on the hottest device) when
+    the backend reports memory stats, plus ``mem_modeled_peak_bytes``
+    from the compiled step's static buffer ledger — {} on the CPU sim.
 
     ``comm_ledger`` is the HLO collective ledger of the compiled step
     (``obs.comm_ledger``) — None when AOT compilation was unavailable.
@@ -384,9 +390,11 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
     # is what the loop runs).  Per-device FLOPs -> per-token via the
     # per-chip token count.
     from torchdistpackage_tpu.obs import compiled_cost, ledger_from_compiled
+    from torchdistpackage_tpu.obs import mem_ledger as _mem
 
     xla_flops_per_token = None
     ledger = None
+    mem_led = None
     run_step = step
     try:
         compiled = step.lower(params, state, batch).compile()
@@ -397,6 +405,8 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
         # the same no-second-compile hook feeds the comm ledger: which
         # collectives the step runs, over which axes, moving which bytes
         ledger = ledger_from_compiled(compiled, mesh=mesh)
+        # ... and the static memory ledger (args/temps/donation savings)
+        mem_led = _mem.static_ledger(compiled, label="train_step")
         run_step = compiled
     except Exception as e:
         print(f"bench: AOT compile/cost-analysis unavailable ({e!r}); "
@@ -437,8 +447,22 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
         except Exception as e:
             print(f"bench: trace export failed ({e!r})", file=sys.stderr)
 
+    # memory evidence for the JSON line: measured per-device peak +
+    # headroom against capacity (the number that decides whether a bigger
+    # batch even runs), modeled static peak alongside
+    mem = {}
+    live = _mem.live_memory()
+    if live["reported"]:
+        mem["peak_hbm_bytes"] = max(
+            r["peak_bytes_in_use"] for r in live["per_device"])
+        if live["peak_frac"]:
+            mem["mem_headroom_frac"] = round(1.0 - live["peak_frac"], 4)
+    if mem_led is not None:
+        mem["mem_modeled_peak_bytes"] = mem_led["peak_estimate_bytes"]
+        print(_mem.render_table(mem_led), file=sys.stderr)
+
     return (global_batch * cfg.max_seq * steps / dt / n_chips, global_batch,
-            flops_per_token, xla_flops_per_token, ledger)
+            flops_per_token, xla_flops_per_token, ledger, mem)
 
 
 def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
@@ -526,7 +550,7 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
         run_cfg = (
             dataclasses.replace(cfg, moe_dispatch=dispatch) if dispatch else cfg
         )
-        tps, global_batch, fpt, fpt_xla, ledger = _run_config(
+        tps, global_batch, fpt, fpt_xla, ledger, mem = _run_config(
             jax, jnp, run_cfg, batch_size, steps, warmup, remat,
             xent_chunk=xent_chunk, trace=trace)
         # remat: False | True | 'flash' | 'flash_offload' (save the flash
@@ -585,6 +609,9 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
                 round(a["bytes"] / tot, 4) if tot else 0.0)
             if a.get("mean_sched_distance") is not None:
                 line["overlap_mean_sched_distance"] = a["mean_sched_distance"]
+        # memory columns: measured peak HBM + headroom fraction (absent on
+        # the CPU sim, which reports no memory stats), modeled static peak
+        line.update(mem)
         if peak:
             line["peak_flops_est"] = peak
             line["mfu"] = round(tps * fpt / peak, 4)

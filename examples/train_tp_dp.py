@@ -52,6 +52,20 @@ def main():
     specs = transformer_param_specs(cfg, axis="tensor") if tp > 1 else None
     axis = "tensor" if tp > 1 else None
 
+    if specs is not None:
+        # score the layout BEFORE compiling anything: the planner-facing
+        # memory model (docs/memory.md) — per-device resident bytes from
+        # (config, mesh, specs) alone; the RUNREPORT memory section later
+        # reports what the compiled program actually allocated
+        from torchdistpackage_tpu.obs import MemoryModel
+
+        est = MemoryModel().estimate(
+            cfg, tpc.get_view(), specs, params=params,
+            batch_per_device=4, seq_len=32)
+        print(f"memory estimate: params {est['params_bytes'] / 1e6:.2f} MB "
+              f"+ opt {est['opt_bytes'] / 1e6:.2f} MB per device "
+              f"-> verdict {est['verdict']}")
+
     def loss_fn(p, batch):
         out = transformer_forward(p, batch["x"], cfg, axis=axis, sp=tp > 1)
         return jnp.mean((out - batch["y"]) ** 2)

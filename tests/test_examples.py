@@ -33,14 +33,17 @@ EXAMPLES = sorted(
 # as a valid Perfetto-loadable Chrome trace.
 OBS_EXAMPLES = {
     "train_llama.py": {},
-    "train_tp_dp.py": {"comm": "dp"},
+    "train_tp_dp.py": {"comm": "dp", "memory": True},
     "train_pipeline.py": {"counter": "pipeline", "field": "bubble_fraction"},
     "train_interleaved_pipeline.py": {
         "counter": "pipeline", "field": "bubble_fraction"},
     "train_moe.py": {"counter": "moe", "field": "imbalance", "comm": "moe"},
     # overlap-audited examples (PR 3): GSPMD FSDP's param all-gathers and
-    # the ZeRO owner-scatter both ledger onto the data axis
-    "train_fsdp_offload.py": {"comm": "dp"},
+    # the ZeRO owner-scatter both ledger onto the data axis.  ``memory``
+    # probes the PR-6 mem-ledger section; for the FSDP example the probe
+    # additionally demands SHARDED leaf evidence (resident < global) —
+    # ZeRO-3 proven from the compiled program's own input layouts
+    "train_fsdp_offload.py": {"comm": "dp", "memory": "sharded"},
     "train_zero_ema_ckpt.py": {"comm": "dp"},
     # self-healing loop (PR 4): chaos NaN spike -> rollback -> recovered;
     # the report must carry the resilience verdict AND the fault/rollback
@@ -132,6 +135,30 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
         kinds = {e["kind"] for e in report["events"]}
         assert {"request_admitted", "prefill_chunk",
                 "request_retired", "slots_snapshot"} <= kinds, kinds
+
+    if probe.get("memory"):
+        # the PR-6 memory section: per-program static breakdown captured
+        # through the same AOT hook as the comm ledger, verdict validated
+        mem = report["memory"]
+        from torchdistpackage_tpu.obs import MEM_VERDICTS
+
+        assert mem["verdict"] in MEM_VERDICTS, mem
+        progs = mem["programs"]
+        assert progs, (script, "no static mem ledgers captured")
+        for p in progs:
+            assert p["argument_bytes"] > 0, (script, p)
+            assert p["peak_estimate_bytes"] >= p["temp_bytes"], (script, p)
+        if probe["memory"] == "sharded":
+            # FSDP evidence: at least one param leaf resident at a
+            # fraction of its replicated (global) estimate
+            rows = [r for p in progs for r in p.get("per_leaf", [])]
+            sharded = [r for r in rows if r["shard_count"] > 1]
+            assert sharded, (script, "no sharded leaves evidenced")
+            assert all(
+                r["resident_bytes"] < r["global_bytes"] for r in sharded)
+            assert any(r["shard_count"] >= 8 for r in sharded), (
+                script, "expected a fully FSDP-sharded leaf on the "
+                "8-device sim", sorted({r['shard_count'] for r in sharded}))
 
     if probe.get("comm"):
         # the comm section must ledger this example's parallelism dimension

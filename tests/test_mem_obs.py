@@ -1,0 +1,321 @@
+"""Memory observability (obs/mem_ledger.py): static buffer ledger parsed
+from real CPU-sim compiled steps, donation accounting, per-leaf sharding
+evidence (FSDP resident bytes scale ~1/N across shard counts), headroom
+verdict math, the planner-facing MemoryModel, and the Telemetry-built
+RUNREPORT ``memory`` section.
+
+Everything compiles TINY programs (a 2-leaf train step) — the whole file
+costs a handful of sub-second compiles.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchdistpackage_tpu.obs import (
+    MEM_VERDICTS,
+    MemoryModel,
+    Telemetry,
+    headroom_verdict,
+    live_memory,
+    mem_report,
+    static_ledger,
+    validate_runreport,
+)
+from torchdistpackage_tpu.obs.events import EventLog, set_default_event_log
+from torchdistpackage_tpu.obs.mem_ledger import OOM_RISK_FRAC, TIGHT_FRAC
+
+
+def _mesh(devices, n):
+    return Mesh(np.array(devices[:n]), axis_names=("data",))
+
+
+def _step_fn(lr=0.1):
+    def step(p, x):
+        def loss(pp):
+            return jnp.mean((x @ pp["w"]) ** 2) + pp["ln"].sum()
+
+        g = jax.grad(loss)(p)
+        return jax.tree.map(lambda a, b: a - lr * b, p, g), jnp.mean(x)
+
+    return step
+
+
+def _sharded_inputs(mesh, d=64):
+    params = {
+        "w": jax.device_put(
+            jnp.ones((d, d)), NamedSharding(mesh, P("data"))),
+        "ln": jax.device_put(jnp.ones((7,)), NamedSharding(mesh, P())),
+    }
+    x = jax.device_put(jnp.ones((8, d)), NamedSharding(mesh, P("data")))
+    return params, x
+
+
+def _compile(mesh, donate=False, d=64):
+    params, x = _sharded_inputs(mesh, d)
+    j = jax.jit(_step_fn(), donate_argnums=(0,) if donate else ())
+    return j.lower(params, x).compile()
+
+
+# ------------------------------------------------------------ static ledger
+
+
+def test_static_ledger_parses_real_compiled_step(devices8):
+    led = static_ledger(_compile(_mesh(devices8, 8)), label="step")
+    assert led is not None and led["label"] == "step"
+    for key in ("argument_bytes", "output_bytes", "temp_bytes",
+                "alias_bytes", "generated_code_bytes",
+                "peak_estimate_bytes"):
+        assert isinstance(led[key], int) and led[key] >= 0, key
+    # args + outputs + temps + codegen - alias is the static upper bound
+    assert led["peak_estimate_bytes"] == (
+        led["argument_bytes"] + led["output_bytes"] + led["temp_bytes"]
+        + led["generated_code_bytes"] - led["alias_bytes"])
+    # per-leaf attribution sums to XLA's own argument accounting
+    assert led["n_leaves"] == 3  # w, ln, x
+    assert sum(r["resident_bytes"] for r in led["per_leaf"]) == (
+        led["argument_bytes"])
+
+
+def test_per_leaf_sharding_evidence(devices8):
+    """The attribution must EVIDENCE the sharding: the P('data') leaves
+    show global/8 resident bytes, the tiny ln leaf is flagged
+    replicated."""
+    led = static_ledger(_compile(_mesh(devices8, 8)))
+    rows = {r["path"]: r for r in led["per_leaf"]}
+    w = rows["[0]['w']"]
+    assert w["global_bytes"] == 64 * 64 * 4
+    assert w["resident_bytes"] == w["global_bytes"] // 8
+    assert w["shard_count"] == 8 and not w["replicated"]
+    ln = rows["[0]['ln']"]
+    assert ln["replicated"] and ln["shard_count"] == 1
+    assert ln["resident_bytes"] == ln["global_bytes"] == 7 * 4
+    assert led["sharded_leaves"] == 2 and led["replicated_leaves"] == 1
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_fsdp_resident_bytes_scale_inverse_n(devices8, n):
+    """The acceptance bar: per-leaf resident param bytes scale ~1/N
+    across shard counts on the CPU sim — sharding is evidenced from the
+    compiled program's own input layouts, not from caller intent."""
+    led = static_ledger(_compile(_mesh(devices8, n)))
+    w = next(r for r in led["per_leaf"] if r["path"] == "[0]['w']")
+    assert w["resident_bytes"] == (64 * 64 * 4) // n
+    assert w["shard_count"] == n
+
+
+def test_donation_accounting(devices8):
+    """``donate_argnums`` must SHOW UP as alias bytes: the donated param
+    tree's resident bytes are aliased into the outputs; the undonated
+    compile of the identical program shows zero."""
+    mesh = _mesh(devices8, 8)
+    plain = static_ledger(_compile(mesh, donate=False))
+    donated = static_ledger(_compile(mesh, donate=True))
+    assert plain["alias_bytes"] == 0
+    # everything donatable: w's shard + ln (both returned updated)
+    want = (64 * 64 * 4) // 8 + 7 * 4
+    assert donated["alias_bytes"] == want
+    # and the savings land in the static peak estimate
+    assert donated["peak_estimate_bytes"] == (
+        plain["peak_estimate_bytes"] - want)
+
+
+# ----------------------------------------------------------- verdict math
+
+
+def test_headroom_verdict_thresholds():
+    cap = 10 ** 9
+    assert headroom_verdict(0.5 * cap, cap)["verdict"] == "ok"
+    assert headroom_verdict(TIGHT_FRAC * cap, cap)["verdict"] == "tight"
+    assert headroom_verdict(0.9 * cap, cap)["verdict"] == "tight"
+    assert headroom_verdict(OOM_RISK_FRAC * cap, cap)["verdict"] == "oom_risk"
+    assert headroom_verdict(2 * cap, cap)["verdict"] == "oom_risk"
+    hv = headroom_verdict(0.25 * cap, cap)
+    assert hv["frac"] == 0.25 and hv["headroom_frac"] == 0.75
+    for bad in ((None, cap), (cap, None), (cap, 0), (0, cap)):
+        assert headroom_verdict(*bad)["verdict"] == "unknown"
+    assert set(MEM_VERDICTS) == {"ok", "tight", "oom_risk", "unknown"}
+
+
+def test_mem_report_modeled_vs_measured(devices8):
+    led = static_ledger(_compile(_mesh(devices8, 8)))
+    cap = 10 ** 9
+    # modeled only: the static peak decides
+    sec = mem_report(programs=[led], capacity_bytes=cap, emit=False)
+    assert sec["modeled_peak_bytes"] == led["peak_estimate_bytes"]
+    assert sec["verdict"] == "ok" and "modeled" in sec["verdict_basis"]
+    # measured side wins when present (per-device frac is ground truth)
+    sec = mem_report(programs=[led], measured_peak_frac=0.97,
+                     capacity_bytes=cap, emit=False)
+    assert sec["verdict"] == "oom_risk"
+    assert "measured" in sec["verdict_basis"]
+    # no capacity, no measurement -> unknown (the CPU-sim default)
+    assert mem_report(programs=[led], emit=False)["verdict"] == "unknown"
+
+
+def test_oom_risk_event_emitted():
+    log = EventLog()
+    set_default_event_log(log)
+    try:
+        sec = mem_report(measured_peak_frac=0.99, capacity_bytes=1)
+        assert sec["verdict"] == "oom_risk"
+        events = log.of_kind("oom_risk")
+        assert len(events) == 1 and events[0]["peak_frac"] == 0.99
+        # ok verdicts stay quiet
+        mem_report(measured_peak_frac=0.5, capacity_bytes=1)
+        assert len(log.of_kind("oom_risk")) == 1
+    finally:
+        set_default_event_log(None)
+
+
+def test_kv_pool_cross_check():
+    kv = {"pool_bytes": 4096, "pool_bytes_expected": 4096}
+    sec = mem_report(kv_pool=kv, emit=False)
+    assert sec["kv_pool"]["accounting_match"] is True
+    bad = mem_report(
+        kv_pool={"pool_bytes": 4096, "pool_bytes_expected": 8192},
+        emit=False)
+    assert bad["kv_pool"]["accounting_match"] is False
+
+
+# ------------------------------------------------------------- live reader
+
+
+def test_live_memory_cpu_sim_shape():
+    mem = live_memory()
+    # the CPU sim reports nothing — the reader must say so, not crash
+    assert set(mem) == {"reported", "live_bytes", "peak_bytes",
+                        "limit_bytes", "peak_frac", "per_device"}
+    if not mem["reported"]:
+        assert mem["per_device"] == [] and mem["peak_frac"] is None
+
+
+# ---------------------------------------------------------- planner model
+
+
+def test_memory_model_estimate_sharding(devices8):
+    from torchdistpackage_tpu.parallel.tensor_parallel import (
+        TransformerConfig,
+        transformer_param_specs,
+    )
+
+    cfg = TransformerConfig(dim=32, nheads=4, nlayers=2, ffn_mult=2)
+    mesh = Mesh(np.array(devices8).reshape(2, 4), axis_names=("data", "tensor"))
+    specs = transformer_param_specs(cfg, axis="tensor")
+    mm = MemoryModel(capacity_bytes=10 ** 9, optimizer_slots=2)
+    tp = mm.estimate(cfg, mesh, specs, batch_per_device=2, seq_len=16)
+    rep = mm.estimate(cfg, mesh, jax.tree.map(
+        lambda s: P(), specs, is_leaf=lambda x: isinstance(x, P)),
+        batch_per_device=2, seq_len=16)
+    # TP sharding strictly shrinks resident params vs replicated
+    assert tp["params_bytes"] < rep["params_bytes"]
+    assert rep["replicated_leaves"] > 0
+    # optimizer moments follow the param sharding at f32
+    assert tp["opt_bytes"] == 2 * sum(
+        -(-r["global_bytes"] // 4 // r["shard_count"]) * 4
+        for r in tp["per_leaf"])
+    assert tp["act_bytes"] > 0
+    assert tp["total_bytes"] == (
+        tp["params_bytes"] + tp["grads_bytes"] + tp["opt_bytes"]
+        + tp["act_bytes"])
+    assert tp["verdict"] in MEM_VERDICTS
+
+
+def test_memory_model_verdict_against_budget(devices8):
+    """The planner contract: the same layout flips ok -> oom_risk purely
+    on the capacity budget."""
+    from torchdistpackage_tpu.parallel.tensor_parallel import (
+        TransformerConfig,
+        transformer_param_specs,
+    )
+
+    cfg = TransformerConfig(dim=32, nheads=4, nlayers=2, ffn_mult=2)
+    mesh = Mesh(np.array(devices8[:4]), axis_names=("tensor",))
+    specs = transformer_param_specs(cfg, axis="tensor")
+    roomy = MemoryModel(capacity_bytes=10 ** 9).estimate(cfg, mesh, specs)
+    total = roomy["total_bytes"]
+    assert roomy["verdict"] == "ok"
+    squeezed = MemoryModel(capacity_bytes=int(total * 1.01)).estimate(
+        cfg, mesh, specs)
+    assert squeezed["verdict"] == "oom_risk"
+    unknown = MemoryModel(capacity_bytes=None).estimate(cfg, mesh, specs)
+    assert unknown["verdict"] == "unknown"  # CPU sim: no capacity
+
+
+# ------------------------------------------------------- telemetry section
+
+
+@pytest.fixture()
+def _fresh_log():
+    log = EventLog()
+    set_default_event_log(log)
+    yield log
+    set_default_event_log(None)
+
+
+def test_telemetry_memory_section_validates(devices8, _fresh_log):
+    mesh = _mesh(devices8, 8)
+    params, x = _sharded_inputs(mesh)
+    tel = Telemetry(run="mem", report_path=None, mesh=mesh)
+    step = tel.wrap_step(jax.jit(_step_fn(), donate_argnums=(0,)))
+    for i in range(3):
+        params, loss = step(params, x)
+        tel.end_step(step=i, loss=loss)
+    report = tel.finalize(print_summary=False)
+    assert validate_runreport(report) == []
+    mem = report["memory"]
+    assert mem["verdict"] in MEM_VERDICTS
+    assert len(mem["programs"]) == 1  # one signature, one static ledger
+    prog = mem["programs"][0]
+    assert prog["alias_bytes"] > 0  # donation evidenced through Telemetry
+    assert prog["n_leaves"] == 3
+    assert mem["modeled_peak_bytes"] == prog["peak_estimate_bytes"]
+    # legacy keys intact for pre-existing consumers
+    assert "peak_bytes_in_use" in mem and "reported" in mem
+
+
+def test_trace_exports_hbm_counter_track():
+    """Step records carrying memory samples must land in the Chrome trace
+    as a counter track (ph 'C', name hbm_bytes) — the scrubbing view of
+    the mem_snapshot timeline."""
+    from torchdistpackage_tpu.obs.trace import chrome_trace_events
+
+    history = [{
+        "type": "step", "step": i, "t_end_s": 10.0 + i,
+        "step_time_s": 0.5, "span_device_s": 0.5,
+        "bytes_in_use": 1000 + i, "peak_bytes_in_use": 2000,
+    } for i in range(3)]
+    events = chrome_trace_events(history)
+    counters = [e for e in events
+                if e.get("ph") == "C" and e["name"] == "hbm_bytes"]
+    assert len(counters) == 3
+    assert counters[0]["args"] == {"live": 1000, "peak": 2000}
+
+
+def test_serving_pool_accounting_cross_check(devices8, _fresh_log):
+    """The engine's kv_pool summary must carry matching shape-math and
+    device-buffer byte counts, and the Telemetry memory section must
+    surface the cross-check."""
+    from torchdistpackage_tpu.models import GPTConfig, init_gpt_params
+    from torchdistpackage_tpu.serving import (
+        ServingEngine,
+        expected_pool_bytes,
+        pool_bytes,
+    )
+
+    cfg = GPTConfig(vocab_size=64, dim=32, nheads=4, nlayers=2, max_seq=32)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    tel = Telemetry(run="serve-mem", report_path=None)
+    eng = ServingEngine(params, cfg, num_slots=2, block_size=4,
+                        telemetry=tel)
+    assert pool_bytes(eng.cache) == expected_pool_bytes(
+        cfg, eng.num_blocks, eng.block_size)
+    summary = eng.serving_summary()
+    assert summary["kv_pool"]["pool_bytes"] == (
+        summary["kv_pool"]["pool_bytes_expected"])
+    tel.record_serving(summary)
+    report = tel.finalize(print_summary=False)
+    assert validate_runreport(report) == []
+    assert report["memory"]["kv_pool"]["accounting_match"] is True

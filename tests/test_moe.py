@@ -654,12 +654,22 @@ def test_gpt_moe_aux_trains(devices8):
 
 
 @pytest.mark.heavy
+@pytest.mark.slow
 def test_gpt_moe_interleaved_1f1b_matches_serial(devices8):
     """MoE x INTERLEAVED PP: the MoE GPT under the V=2 virtual-chunk 1F1B
     schedule (EP x MoE-DP x PP x V) — L=8 so each of the 4 slabs carries the
     same [dense, expert] pattern; aux ON through the stage-aux channel with
     the chunk index folded into its grads' recompute.  Golden vs the
-    per-(microbatch, data-shard) serial chunk mean, like the V=1 test."""
+    per-(microbatch, data-shard) serial chunk mean, like the V=1 test.
+
+    ``slow``: this single composition golden compiled for ~210 s of the
+    870 s tier-1 budget on the CPU sim (/tmp/_t1_durations.json, PR 6) —
+    a quarter of the whole suite for one test.  Its two factors stay
+    independently covered in the fast tier (MoE x PP:
+    ``test_gpt_moe_1f1b_matches_serial_microbatched``; the interleaved
+    schedule itself: ``test_pipeline.test_interleaved_1f1b_matches_serial``
+    over four (P, V, M) shapes), so the fast tier keeps the coverage and
+    the full/pre-commit tier keeps the composed golden."""
     from torchdistpackage_tpu.models import (
         GPTConfig,
         gpt_moe_loss,

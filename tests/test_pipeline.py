@@ -239,34 +239,58 @@ def _1f1b_value_and_grad(mesh, specs, M, pp=4):
     return vg
 
 
+@pytest.fixture(scope="module")
+def serial_1f1b_ref():
+    """Module-scope cache of the serial (loss, grads) reference per
+    microbatch count M — the (2, 4) and (4, 4) schedule combos share one
+    compiled serial program instead of re-deriving it per test (the PR-5
+    shared-bundle pattern; tier-1 budget, ROADMAP item 1)."""
+    cache = {}
+
+    def get(m):
+        if m not in cache:
+            _, stacked = _layers_and_stack()
+            x = jax.random.normal(jax.random.PRNGKey(1), (m, MBS, S, CFG.dim))
+            y = jax.random.normal(jax.random.PRNGKey(2), (m, MBS, S, CFG.dim))
+
+            def serial_loss(sp, xx, yy):
+                def one(i):
+                    def body(h, lp):
+                        return block_forward(lp, h, CFG), None
+
+                    h, _ = jax.lax.scan(body, xx[i], sp)
+                    return jnp.mean((h - yy[i]) ** 2)
+
+                return jnp.mean(jnp.stack([one(i) for i in range(m)]))
+
+            ref_loss, ref_grads = jax.jit(
+                jax.value_and_grad(serial_loss))(stacked, x, y)
+            cache[m] = {
+                "stacked": stacked, "x": x, "y": y,
+                "loss": float(ref_loss), "grads": jax.device_get(ref_grads),
+            }
+        return cache[m]
+
+    return get
+
+
 @pytest.mark.parametrize("pp,m", [(2, 4), (4, 4), (4, 9), (4, 2)])
 @pytest.mark.heavy
-def test_pipeline_1f1b_matches_serial(devices8, pp, m):
+def test_pipeline_1f1b_matches_serial(devices8, serial_1f1b_ref, pp, m):
     """The 1F1B schedule's (loss, grads) must equal serial AD exactly —
     including M not divisible by / smaller than schedule-derived constants."""
     tpc.setup_process_groups([("pipe", pp)], devices=devices8[:pp])
     mesh = tpc.get_view()
-    layers, stacked = _layers_and_stack()
+    ref = serial_1f1b_ref(m)
+    stacked, x, y = ref["stacked"], ref["x"], ref["y"]
     specs = stacked_param_specs(stacked, "pipe")
     sharded = jax.tree.map(
         lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), stacked, specs
     )
-    x = jax.random.normal(jax.random.PRNGKey(1), (m, MBS, S, CFG.dim))
-    y = jax.random.normal(jax.random.PRNGKey(2), (m, MBS, S, CFG.dim))
 
     loss, grads = jax.jit(_1f1b_value_and_grad(mesh, specs, m, pp))(sharded, x, y)
 
-    def serial_loss(sp, xx, yy):
-        def one(i):
-            def body(h, lp):
-                return block_forward(lp, h, CFG), None
-
-            h, _ = jax.lax.scan(body, xx[i], sp)
-            return jnp.mean((h - yy[i]) ** 2)
-
-        return jnp.mean(jnp.stack([one(i) for i in range(m)]))
-
-    ref_loss, ref_grads = jax.value_and_grad(serial_loss)(stacked, x, y)
+    ref_loss, ref_grads = ref["loss"], ref["grads"]
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
     for (path, gs), (_, gp) in zip(
         jax.tree_util.tree_flatten_with_path(ref_grads)[0],

@@ -1,7 +1,8 @@
 """Repo lint: no bare ``print(`` / ``time.time()`` in the package, no
-``os.environ["XLA_FLAGS"]`` writes outside ``dist/overlap.py``, every
-emitted event kind registered in ``obs.events.EVENT_KINDS``, and no
-unreviewed ``except: pass`` swallowing.
+``os.environ["XLA_FLAGS"]`` writes outside ``dist/overlap.py``, no
+``.memory_stats()`` reads outside ``obs/mem_ledger.py``, every emitted
+event kind registered in ``obs.events.EVENT_KINDS``, and no unreviewed
+``except: pass`` swallowing.
 
 Observability goes through ``utils.logging.master_print`` (rank-gated) or
 an obs sink — a bare print on a 256-host pod is 256 interleaved copies of
@@ -40,6 +41,9 @@ ALLOWLIST = {
     # torchdistpackage_tpu/__init__.py), so master_print (which needs
     # jax.process_index) is unavailable; it is single-process by nature.
     "tools/slurm_job_monitor.py",
+    # bench-round trend gate: same deal — a jax-free login-node/CI CLI
+    # over the checked-in BENCH_r0*.json artifacts.
+    "tools/bench_trend.py",
 }
 
 
@@ -166,6 +170,53 @@ def _repo_python_files():
             yield p
 
 
+# --------------------------------------------------- memory_stats ownership
+
+# The one module allowed to call ``.memory_stats()`` (package-relative).
+# Every memory number in the repo flows through obs/mem_ledger.live_memory
+# — one reader, one schema, one place the lint-enforced guards live.
+# Scattered raw reads were exactly how PR 6 found three call sites with
+# three different aggregation conventions.
+MEMORY_STATS_OWNER = "obs/mem_ledger.py"
+
+
+def _memory_stats_calls(path: pathlib.Path):
+    """Line numbers of ``<anything>.memory_stats(...)`` calls."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    return [
+        node.lineno
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "memory_stats"
+    ]
+
+
+def test_no_direct_memory_stats_calls():
+    offenders = {}
+    for path in sorted(PKG.rglob("*.py")):
+        rel = str(path.relative_to(PKG))
+        if rel == MEMORY_STATS_OWNER:
+            continue
+        lines = _memory_stats_calls(path)
+        if lines:
+            offenders[rel] = lines
+    assert not offenders, (
+        "direct .memory_stats() calls outside obs/mem_ledger.py — read "
+        "through obs.mem_ledger.live_memory()/device_capacity() so every "
+        f"memory number shares one schema and one guard: {offenders}"
+    )
+
+
+def test_memory_stats_owner_exists_and_reads():
+    owner = PKG / MEMORY_STATS_OWNER
+    assert owner.exists()
+    # the owner itself must actually hold the call the rule centralizes
+    assert _memory_stats_calls(owner), (
+        "obs/mem_ledger.py no longer calls memory_stats() — the ownership "
+        "rule is pointing at a stale module")
+
+
 # ----------------------------------------------------- event-kind registry
 
 # Call sites look like emit_event("kind", ...) / <something>.emit("kind",
@@ -231,6 +282,20 @@ def test_event_kinds_registered():
     assert not stale, f"EVENT_KINDS entries no call site emits: {sorted(stale)}"
 
 
+def test_mem_event_kinds_registered_and_emitted():
+    """The memory-observability kinds (PR 6) are in the registry AND
+    actually emitted by the obs package — ``mem_snapshot`` from
+    Telemetry's per-step sampler, ``oom_risk`` from both the live
+    crossing and the end-of-run verdict (mem_ledger.mem_report)."""
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+
+    assert {"mem_snapshot", "oom_risk"} <= EVENT_KINDS
+    emitted = set()
+    for path in sorted((PKG / "obs").rglob("*.py")):
+        emitted.update(k for _, k in _emit_call_kinds(path))
+    assert {"mem_snapshot", "oom_risk"} <= emitted, emitted
+
+
 def test_event_kind_pass_covers_serving():
     """The serving package (PR 5) is inside the AST pass's scan set: its
     lifecycle kinds are emitted nowhere else, so a scan that missed
@@ -258,12 +323,15 @@ SWALLOW_ALLOWLIST = {
     "dist/comm_bench.py": 2,
     "dist/overlap.py": 3,
     "obs/exporters.py": 3,
-    "obs/telemetry.py": 4,
+    # +1 in PR 6: the static-mem-ledger capture at compile time must
+    # never break the step it observes
+    "obs/telemetry.py": 5,
     "obs/trace.py": 1,
     "parallel/clip.py": 1,
     "parallel/data_parallel.py": 1,
     "tools/debug_nan.py": 1,
-    "tools/profiler.py": 2,
+    # -1 in PR 6: the memory_analysis probe migrated onto mem_ledger
+    "tools/profiler.py": 1,
     # the preemption handler: a telemetry failure inside a signal handler
     # must never break the grace window (intentional, see module)
     "utils/preemption.py": 1,

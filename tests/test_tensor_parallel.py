@@ -44,25 +44,39 @@ def _setup_tp(devices8, tp=4):
     return tpc.get_view()
 
 
-def _loss(params, x, axis=None, sp=False):
-    out = transformer_forward(params, x, CFG, axis=axis, sp=sp)
-    return jnp.mean(out**2)
-
-
 def _sp_out_spec(sp):
     # SP output stays seq-sharded (gather_output=False); shard_map reassembles
     return P(None, "tensor", None) if sp else P()
 
 
-@pytest.mark.parametrize("sp", [False, True])
-def test_tp_transformer_matches_serial(devices8, sp):
-    mesh = _setup_tp(devices8)
+@pytest.fixture(scope="module")
+def serial_golden():
+    """The serial reference, computed ONCE for the whole file as a single
+    ``value_and_grad(has_aux=True)`` program: forward output, loss, and
+    grads all come out of ONE compile (tier-1 budget: fwd+grad pairs fold
+    into one program, ROADMAP item 1)."""
     params = init_transformer_params(jax.random.PRNGKey(0), CFG)
     x = jax.random.normal(jax.random.PRNGKey(1), (B, S, CFG.dim))
 
-    # serial golden
-    serial_out = transformer_forward(params, x, CFG)
-    serial_loss, serial_grads = jax.value_and_grad(_loss)(params, x)
+    @jax.jit
+    def vg(p, xx):
+        def loss_with_out(pp):
+            out = transformer_forward(pp, xx, CFG)
+            return jnp.mean(out**2), out
+
+        return jax.value_and_grad(loss_with_out, has_aux=True)(p)
+
+    (loss, out), grads = vg(params, x)
+    return {
+        "params": params, "x": x, "out": np.asarray(out),
+        "loss": float(loss), "grads": jax.device_get(grads),
+    }
+
+
+@pytest.mark.parametrize("sp", [False, True])
+def test_tp_transformer_matches_serial(devices8, serial_golden, sp):
+    mesh = _setup_tp(devices8)
+    params, x = serial_golden["params"], serial_golden["x"]
 
     # TP: shard the *same global arrays* by spec; shard_map sees local shards
     specs = transformer_param_specs(CFG, axis="tensor")
@@ -71,21 +85,9 @@ def test_tp_transformer_matches_serial(devices8, sp):
     )
     x_sh = jax.device_put(x, NamedSharding(mesh, P()))
 
-    fwd = jax.jit(
-        shard_map(
-            functools.partial(
-                transformer_forward, cfg=CFG, axis="tensor", sp=sp, gather_output=False
-            ),
-            mesh=mesh,
-            in_specs=(specs, P()),
-            out_specs=_sp_out_spec(sp),
-        )
-    )
-    tp_out = fwd(sharded, x_sh)
-    np.testing.assert_allclose(np.asarray(tp_out), np.asarray(serial_out), rtol=2e-5, atol=2e-5)
-
-    # gradient parity straight through shard_map
-    def tp_loss(p, xx):
+    # forward + loss + grad parity from ONE compiled program: the shard_map
+    # forward's output rides out as value_and_grad aux
+    def tp_loss_with_out(p, xx):
         out = shard_map(
             functools.partial(
                 transformer_forward, cfg=CFG, axis="tensor", sp=sp, gather_output=False
@@ -94,11 +96,16 @@ def test_tp_transformer_matches_serial(devices8, sp):
             in_specs=(specs, P()),
             out_specs=_sp_out_spec(sp),
         )(p, xx)
-        return jnp.mean(out**2)
+        return jnp.mean(out**2), out
 
-    tp_loss_val, tp_grads = jax.jit(jax.value_and_grad(tp_loss))(sharded, x_sh)
-    np.testing.assert_allclose(float(tp_loss_val), float(serial_loss), rtol=1e-5)
-    flat_s, _ = jax.tree_util.tree_flatten_with_path(serial_grads)
+    (tp_loss_val, tp_out), tp_grads = jax.jit(
+        jax.value_and_grad(tp_loss_with_out, has_aux=True)
+    )(sharded, x_sh)
+    np.testing.assert_allclose(
+        np.asarray(tp_out), serial_golden["out"], rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        float(tp_loss_val), serial_golden["loss"], rtol=1e-5)
+    flat_s, _ = jax.tree_util.tree_flatten_with_path(serial_golden["grads"])
     flat_t, _ = jax.tree_util.tree_flatten_with_path(tp_grads)
     for (path, gs), (_, gt) in zip(flat_s, flat_t):
         np.testing.assert_allclose(

@@ -31,9 +31,15 @@ telemetry layer every train loop, example, and bench emits through:
   measured ``dist.comm_bench`` timings, and the RUNREPORT ``comm``
   section (modeled vs measured comm time, comm-bound vs compute-bound
   verdict, overlap headroom).
+- :mod:`.mem_ledger` — memory observability: the per-compiled-program
+  static buffer ledger from ``memory_analysis()`` (argument / output /
+  temp / donation-savings bytes, argument bytes attributed to pytree
+  leaves through the compiled input shardings), the repo's ONE
+  ``memory_stats()`` reader (``live_memory``), ``ok|tight|oom_risk``
+  headroom verdicts, and the planner-facing ``MemoryModel.estimate``.
 - :mod:`.trace` — Perfetto-loadable Chrome-trace export of the run
-  (spans, events, ledger counters) + ``XlaStepTrace``, a programmatic
-  ``jax.profiler`` capture bracketing a chosen step window.
+  (spans, events, ledger + HBM counters) + ``XlaStepTrace``, a
+  programmatic ``jax.profiler`` capture bracketing a chosen step window.
 
 Design constraints: ``obs`` is a LEAF subsystem — it imports nothing from
 the rest of the package at module scope (``utils.metrics`` shims over
@@ -80,6 +86,16 @@ from .comm_ledger import (
     ledger_from_hlo,
 )
 from .comm_model import CommModel, comm_report, fit_alpha_beta
+from .mem_ledger import (
+    MEM_LEDGER_SCHEMA,
+    MEM_VERDICTS,
+    MemoryModel,
+    device_capacity,
+    headroom_verdict,
+    live_memory,
+    mem_report,
+    static_ledger,
+)
 from .trace import (
     XlaStepTrace,
     build_trace,
@@ -121,6 +137,14 @@ __all__ = [
     "CommModel",
     "comm_report",
     "fit_alpha_beta",
+    "MEM_LEDGER_SCHEMA",
+    "MEM_VERDICTS",
+    "MemoryModel",
+    "device_capacity",
+    "headroom_verdict",
+    "live_memory",
+    "mem_report",
+    "static_ledger",
     "XlaStepTrace",
     "build_trace",
     "default_trace_path",

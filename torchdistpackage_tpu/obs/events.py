@@ -46,6 +46,15 @@ Resilience kinds (``torchdistpackage_tpu.resilience``, PR 4):
                     (step / config hash / code hash / RNG / param sum)
 ==================  =====================================================
 
+Memory kinds (``obs.mem_ledger`` + Telemetry, PR 6):
+
+==================  =====================================================
+``mem_snapshot``    periodic live/peak HBM sample from the one
+                    ``memory_stats`` reader (``mem_ledger.live_memory``)
+``oom_risk``        a live sample or the end-of-run memory verdict
+                    crossed the OOM-risk line (peak >= 95% of capacity)
+==================  =====================================================
+
 Serving kinds (``torchdistpackage_tpu.serving``, PR 5):
 
 ==================  =====================================================
@@ -90,6 +99,8 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     "desync_detected", "checkpoint_save_skipped",
     # serving (PR 5)
     "request_admitted", "prefill_chunk", "request_retired", "slots_snapshot",
+    # memory observability (PR 6)
+    "mem_snapshot", "oom_risk",
 })
 
 

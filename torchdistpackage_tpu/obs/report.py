@@ -19,6 +19,10 @@ RUNREPORT_SCHEMA = "tdp-runreport/v1"
 # the self-healing loop's end states (resilience/loop.py summary verdicts)
 RESILIENCE_VERDICTS = ("clean", "recovered", "preempted", "aborted")
 
+# the memory section's headroom verdicts (obs/mem_ledger.py owns the
+# thresholds; re-exported here next to the other verdict vocabularies)
+from .mem_ledger import MEM_VERDICTS  # noqa: E402
+
 # top-level key -> required python type (None = any); everything Telemetry
 # emits, and everything validate checks.
 _REQUIRED: Dict[str, type] = {
@@ -83,6 +87,7 @@ def validate_runreport(report: Any) -> List[str]:
             errs.append("comm section lacks ledger/verdict")
         elif comm["verdict"] not in ("comm-bound", "compute-bound", "unknown"):
             errs.append(f"comm verdict {comm['verdict']!r} invalid")
+    errs.extend(_validate_memory(report["memory"]))
     res = report.get("resilience")
     if res is not None:  # optional: present when a ResilientLoop drove the run
         if not isinstance(res, dict):
@@ -92,6 +97,43 @@ def validate_runreport(report: Any) -> List[str]:
         elif not isinstance(res.get("rollbacks"), int) or res["rollbacks"] < 0:
             errs.append("resilience.rollbacks missing/negative")
     errs.extend(_validate_serving(report.get("serving")))
+    return errs
+
+
+def _validate_memory(mem: Any) -> List[str]:
+    """The required ``memory`` section (obs/mem_ledger.py): per-program
+    static breakdown, modeled-vs-measured peak, headroom verdict."""
+    errs: List[str] = []
+    if mem.get("verdict") not in MEM_VERDICTS:
+        errs.append(f"memory verdict {mem.get('verdict')!r} invalid")
+    progs = mem.get("programs")
+    if not isinstance(progs, list):
+        errs.append("memory.programs missing/non-list")
+        progs = []
+    byte_keys = ("argument_bytes", "output_bytes", "temp_bytes",
+                 "alias_bytes", "generated_code_bytes",
+                 "peak_estimate_bytes")
+    for i, p in enumerate(progs):
+        if not isinstance(p, dict):
+            errs.append(f"memory.programs[{i}] is not a dict")
+            break
+        for k in byte_keys:
+            v = p.get(k)
+            if not isinstance(v, int) or v < 0:
+                errs.append(f"memory.programs[{i}].{k} missing/negative")
+                break
+    for k in ("modeled_peak_bytes", "measured_peak_bytes",
+              "capacity_bytes", "peak_frac", "headroom_frac"):
+        v = mem.get(k, None)
+        if v is not None and not isinstance(v, (int, float)):
+            errs.append(f"memory.{k} non-numeric")
+    kv = mem.get("kv_pool")
+    if kv is not None and kv.get("accounting_match") is False:
+        # the serving engine's shape math and the device buffer disagree —
+        # a real accounting bug, surfaced as a validation failure
+        errs.append(
+            f"memory.kv_pool accounting mismatch: expected "
+            f"{kv.get('pool_bytes_expected')} != actual {kv.get('pool_bytes')}")
     return errs
 
 
@@ -146,6 +188,12 @@ def render_summary_line(report: Dict[str, Any]) -> str:
     mem = report.get("memory", {})
     if mem.get("reported"):
         parts.append(f"peak_hbm={mem['peak_bytes_in_use'] / 1e9:.2f}GB")
+    if mem.get("verdict") and mem["verdict"] != "unknown":
+        frac = mem.get("headroom_frac")
+        parts.append(
+            f"mem={mem['verdict']}"
+            + (f"(headroom {frac:.0%})" if isinstance(frac, (int, float))
+               else ""))
     hosts = report.get("hosts", {})
     if hosts.get("straggler") is not None:
         parts.append(f"STRAGGLER=host{hosts['straggler']}")
@@ -227,8 +275,52 @@ def render_markdown(report: Dict[str, Any]) -> str:
         L.append("")
 
     mem = report.get("memory", {})
-    if mem.get("reported"):
-        L.append(f"Peak HBM in use: **{mem['peak_bytes_in_use'] / 1e9:.3f} GB**")
+    if mem.get("reported") or mem.get("programs"):
+        L.append("## Memory")
+        L.append("")
+        if mem.get("verdict"):
+            L.append(f"- headroom verdict: **{mem['verdict']}** "
+                     f"({mem.get('verdict_basis', '')})")
+        if mem.get("reported"):
+            L.append(
+                f"- measured peak HBM: "
+                f"**{mem['peak_bytes_in_use'] / 1e9:.3f} GB**"
+                + (f" of {mem['capacity_bytes'] / 1e9:.1f} GB capacity"
+                   if mem.get("capacity_bytes") else ""))
+        if mem.get("modeled_peak_bytes"):
+            L.append(f"- modeled (static ledger) peak: "
+                     f"{mem['modeled_peak_bytes'] / 1e9:.3f} GB")
+        kv = mem.get("kv_pool")
+        if kv:
+            match = kv.get("accounting_match")
+            L.append(
+                f"- serving KV pool: {kv.get('pool_bytes', 0) / 1e6:.2f} MB "
+                f"device buffer ("
+                + ("matches" if match else "MISMATCHES" if match is False
+                   else "vs") + " the engine's shape math)")
+        progs = mem.get("programs") or []
+        if progs:
+            L.append("")
+            L.append("| program | args | outputs | temps | gen code "
+                     "| donated | static peak |")
+            L.append("|---|---|---|---|---|---|---|")
+            for p in progs:
+                L.append(
+                    "| " + (p.get("label") or "?") + " | "
+                    + " | ".join(
+                        f"{p[k] / 1e6:.2f} MB"
+                        for k in ("argument_bytes", "output_bytes",
+                                  "temp_bytes", "generated_code_bytes",
+                                  "alias_bytes", "peak_estimate_bytes"))
+                    + " |")
+            lead = progs[0]
+            if lead.get("n_leaves"):
+                L.append("")
+                L.append(
+                    f"- argument attribution ({lead['label']}): "
+                    f"{lead['n_leaves']} leaves, "
+                    f"{lead['sharded_leaves']} sharded / "
+                    f"{lead['replicated_leaves']} replicated")
         L.append("")
 
     comp = report.get("compile", {})

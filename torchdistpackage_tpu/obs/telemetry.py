@@ -36,8 +36,13 @@ its 6N+12LSD hand formula; disagreement is printed, not hidden (remat
 recompute and non-matmul ops are IN the XLA count and NOT in the model-
 FLOPs count, so the two bracket the truth from opposite sides).
 
-Memory: ``device.memory_stats()`` is polled each step (guarded — the CPU
-sim reports nothing) and the run peak lands in the report.
+Memory: ``mem_ledger.live_memory()`` (the repo's one ``memory_stats()``
+reader) is polled each step (guarded — the CPU sim reports nothing) into
+a live/peak TIMELINE (``mem_snapshot`` events + a Perfetto counter
+track), and every AOT-compiled signature's ``memory_analysis()`` is
+parsed into a static buffer ledger (:mod:`.mem_ledger`) — the report's
+``memory`` section reconciles the two against device capacity into an
+``ok|tight|oom_risk`` headroom verdict.
 """
 
 from __future__ import annotations
@@ -109,26 +114,12 @@ def _abstract_signature(args: Tuple[Any, ...]) -> Tuple:
 
 def _local_memory_stats() -> Optional[Tuple[int, int]]:
     """(peak_bytes, live_bytes) summed over local devices; None when no
-    device reports (CPU sim)."""
-    import jax
+    device reports (CPU sim).  Thin shim over the repo's one
+    ``memory_stats()`` reader, :func:`.mem_ledger.live_memory`."""
+    from .mem_ledger import live_memory
 
-    peak = live = 0
-    seen = False
-    try:
-        devices = jax.local_devices()
-    except Exception:
-        return None
-    for d in devices:
-        try:
-            ms = d.memory_stats()
-        except Exception:
-            ms = None
-        if not ms:
-            continue
-        seen = True
-        peak += int(ms.get("peak_bytes_in_use", 0))
-        live += int(ms.get("bytes_in_use", 0))
-    return (peak, live) if seen else None
+    mem = live_memory()
+    return (mem["peak_bytes"], mem["live_bytes"]) if mem["reported"] else None
 
 
 class Telemetry:
@@ -160,6 +151,13 @@ class Telemetry:
     comm_ledger_enabled: parse the compiled step's HLO into the collective
         ledger (RUNREPORT ``comm`` section).  On by default; the parse
         happens once per run, at first compile.
+    mem_ledger_enabled: parse every compiled signature's
+        ``memory_analysis()`` into a static buffer ledger
+        (:mod:`.mem_ledger`; RUNREPORT ``memory`` section).  On by
+        default; same no-second-compile hook as the comm ledger.
+    mem_snapshot_every: emit a ``mem_snapshot`` event every N steps with
+        the live/peak HBM sample (0 = never; the per-step samples land on
+        the step records and the report timeline regardless).
     xla_trace: a :class:`~.trace.XlaStepTrace` — programmatic
         ``jax.profiler`` capture bracketing a window of wrapped steps.
     """
@@ -179,6 +177,8 @@ class Telemetry:
         mesh: Optional[Any] = None,
         comm_ledger_enabled: bool = True,
         xla_trace: Optional[Any] = None,
+        mem_ledger_enabled: bool = True,
+        mem_snapshot_every: int = 16,
     ) -> None:
         import jax
 
@@ -198,6 +198,14 @@ class Telemetry:
         self.mesh = mesh
         self.comm_ledger_enabled = comm_ledger_enabled
         self.comm_ledger: Optional[Dict[str, Any]] = None
+        self.mem_ledger_enabled = mem_ledger_enabled
+        self.mem_snapshot_every = mem_snapshot_every
+        #: static ledgers, one per AOT-compiled signature (mem_ledger)
+        self.mem_ledgers: List[Dict[str, Any]] = []
+        #: per-step live/peak HBM samples (the mem_snapshot timeline)
+        self.mem_timeline: List[Dict[str, Any]] = []
+        self._peak_frac = 0.0
+        self._oom_emitted = False
         self.xla_trace = xla_trace
         if event_log is None:
             event_log = EventLog()
@@ -307,6 +315,18 @@ class Telemetry:
         self._compiled[sig] = entry
         self.n_compiles += 1
         self.compile_time_s += dt
+        if compiled is not None and self.mem_ledger_enabled:
+            # same no-second-compile hook: the compiled program's static
+            # buffer ledger (args/outputs/temps/donation savings)
+            try:
+                from . import mem_ledger as _mem
+
+                led = _mem.static_ledger(
+                    compiled, label=f"sig{len(self._compiled) - 1}")
+                if led is not None:
+                    self.mem_ledgers.append(led)
+            except Exception:
+                pass
         if first:
             self.xla_cost = dict(cost)
             if compiled is not None and self.comm_ledger_enabled:
@@ -377,10 +397,36 @@ class Telemetry:
         if self.tokens_per_step and step_time > 0:
             rec["tok_per_sec"] = self.tokens_per_step / step_time
         if self.poll_memory:
-            mem = _local_memory_stats()
-            if mem is not None:
-                rec["peak_bytes_in_use"], rec["bytes_in_use"] = mem
-                self._peak_bytes = max(self._peak_bytes, mem[0])
+            from .mem_ledger import OOM_RISK_FRAC, live_memory
+
+            mem = live_memory()
+            if mem["reported"]:
+                rec["peak_bytes_in_use"] = mem["peak_bytes"]
+                rec["bytes_in_use"] = mem["live_bytes"]
+                self._peak_bytes = max(self._peak_bytes, mem["peak_bytes"])
+                if mem["peak_frac"] is not None:
+                    self._peak_frac = max(self._peak_frac, mem["peak_frac"])
+                self.mem_timeline.append({
+                    "step": rec["step"],
+                    "live_bytes": mem["live_bytes"],
+                    "peak_bytes": mem["peak_bytes"],
+                })
+                if (self.mem_snapshot_every
+                        and self._step_n % self.mem_snapshot_every == 0):
+                    self.events.emit(
+                        "mem_snapshot", step=rec["step"],
+                        live_bytes=mem["live_bytes"],
+                        peak_bytes=mem["peak_bytes"],
+                        peak_frac=mem["peak_frac"])
+                if (not self._oom_emitted and mem["peak_frac"] is not None
+                        and mem["peak_frac"] >= OOM_RISK_FRAC):
+                    # first crossing of the risk line lands on the
+                    # timeline AS IT HAPPENS, not only at finalize
+                    self._oom_emitted = True
+                    self.events.emit(
+                        "oom_risk", step=rec["step"],
+                        peak_frac=round(mem["peak_frac"], 4),
+                        basis="live memory_stats sample")
         self._last_fetch_end = t2
         self._step_n += 1
         if len(self.history) < self._history_max:
@@ -492,6 +538,33 @@ class Telemetry:
             except Exception:
                 comm = {}
 
+        from . import mem_ledger as _mem
+
+        try:
+            capacity = _mem.device_capacity()
+        except Exception:
+            capacity = None
+        kv_pool = None
+        if self.serving is not None and "kv_pool" in self.serving:
+            kv_pool = {
+                k: self.serving["kv_pool"].get(k)
+                for k in ("pool_bytes", "pool_bytes_expected", "num_blocks",
+                          "block_size", "dp_groups")
+                if k in self.serving["kv_pool"]
+            } or None
+        memory = _mem.mem_report(
+            programs=self.mem_ledgers,
+            measured_peak_bytes=self._peak_bytes or None,
+            measured_peak_frac=self._peak_frac or None,
+            capacity_bytes=capacity,
+            timeline=self.mem_timeline,
+            kv_pool=kv_pool,
+            emit=not self._oom_emitted,
+        )
+        # the two keys every pre-existing consumer reads stay put
+        memory["peak_bytes_in_use"] = self._peak_bytes
+        memory["reported"] = self._peak_bytes > 0
+
         if self.xla_trace is not None:
             self.xla_trace.close()
         self.events.emit("run_end", run=self.run, steps=self._step_n)
@@ -508,10 +581,7 @@ class Telemetry:
             "spans_mean_s": span_means,
             "throughput": throughput,
             "mfu": mfu,
-            "memory": {
-                "peak_bytes_in_use": self._peak_bytes,
-                "reported": self._peak_bytes > 0,
-            },
+            "memory": memory,
             "compile": {
                 "count": self.n_compiles,
                 "time_s": round(self.compile_time_s, 3),

@@ -123,6 +123,17 @@ def chrome_trace_events(
                 "tid": 0, "ts": us(end - r.get("step_time_s", 0.0)),
                 "args": {d: v["bytes"] for d, v in per_dim.items()},
             })
+        if "bytes_in_use" in r:
+            # the HBM timeline as a Perfetto counter track: live bytes per
+            # step (and the high-water mark), from mem_ledger.live_memory
+            out.append({
+                "ph": "C", "name": "hbm_bytes", "pid": process, "tid": 0,
+                "ts": us(end),
+                "args": {
+                    "live": r["bytes_in_use"],
+                    "peak": r.get("peak_bytes_in_use", r["bytes_in_use"]),
+                },
+            })
     for e in ev_list:
         if "t_mono" not in e:
             continue

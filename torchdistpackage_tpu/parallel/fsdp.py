@@ -455,15 +455,17 @@ def reload_to_device(tree: PyTree, donate: bool = True) -> PyTree:
 def memory_report(label: str = "") -> dict:
     """Per-device HBM usage — analogue of the reference's memory reporting
     (fsdp2_offload_test.py:117-120).  Returns {} when the backend exposes no
-    memory stats (CPU sim)."""
-    stats = {}
-    for d in jax.local_devices():
-        s = d.memory_stats()
-        if s:
-            stats[str(d)] = {
-                "bytes_in_use": s.get("bytes_in_use", 0),
-                "peak_bytes_in_use": s.get("peak_bytes_in_use", 0),
-            }
+    memory stats (CPU sim).  Reads through ``obs.mem_ledger.live_memory``,
+    the repo's one ``memory_stats()`` call site (lint-enforced)."""
+    from ..obs.mem_ledger import live_memory
+
+    stats = {
+        row["device"]: {
+            "bytes_in_use": row["bytes_in_use"],
+            "peak_bytes_in_use": row["peak_bytes_in_use"],
+        }
+        for row in live_memory()["per_device"]
+    }
     if label and stats:
         from ..utils.logging import master_print
 

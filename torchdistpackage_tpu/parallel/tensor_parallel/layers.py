@@ -704,10 +704,12 @@ _HBM_SAVED_RESIDUAL_NAMES = _FLASH_RESIDUAL_NAMES[1:]  # ("flash_lse",)
 
 def _device_hbm_bytes() -> Optional[int]:
     """Per-device memory capacity, or None when the backend doesn't report
-    one (the CPU sim)."""
+    one (the CPU sim).  Reads through ``obs.mem_ledger.device_capacity``
+    — the one ``memory_stats()`` call site (lint-enforced)."""
     try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-        return stats.get("bytes_limit")
+        from ...obs.mem_ledger import device_capacity
+
+        return device_capacity()
     except Exception:
         return None
 

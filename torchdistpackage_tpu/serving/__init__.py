@@ -22,12 +22,14 @@ from .paged_cache import (
     NULL_BLOCK,
     BlockAllocator,
     block_size_of,
+    expected_pool_bytes,
     gather_kv,
     init_paged_kv,
     paged_attention,
     paged_forward,
     paged_forward_moe,
     paged_write,
+    pool_bytes,
 )
 
 __all__ = [
@@ -36,10 +38,12 @@ __all__ = [
     "NULL_BLOCK",
     "BlockAllocator",
     "block_size_of",
+    "expected_pool_bytes",
     "gather_kv",
     "init_paged_kv",
     "paged_attention",
     "paged_forward",
     "paged_forward_moe",
     "paged_write",
+    "pool_bytes",
 ]

@@ -62,9 +62,11 @@ from ..obs.aggregate import percentiles
 from ..obs.events import EventLog, default_event_log
 from .paged_cache import (
     BlockAllocator,
+    expected_pool_bytes,
     init_paged_kv,
     paged_forward,
     paged_forward_moe,
+    pool_bytes,
 )
 
 # slot lifecycle
@@ -625,6 +627,13 @@ class ServingEngine:
                 "mean_utilization": (self._util_sum / self._occ_ticks
                                      if self._occ_ticks else 0.0),
                 "peak_utilization": peak_util,
+                # the obs memory section cross-checks these two: the
+                # device buffer actually held vs what the shape math says
+                # init_paged_kv should have allocated
+                "pool_bytes": pool_bytes(self.cache),
+                "pool_bytes_expected": expected_pool_bytes(
+                    self.cfg, self.dp * self.num_blocks, self.block_size,
+                    quantized=self.kv_quant),
             },
             "decode_steps": self.stats["decode_steps"],
             "prefill_chunks": self.stats["prefill_chunks"],

@@ -98,6 +98,39 @@ def block_size_of(cache: Dict[str, Any]) -> int:
     return (k[0] if isinstance(k, tuple) else k).shape[3]
 
 
+def pool_bytes(cache: Dict[str, Any]) -> int:
+    """Total bytes of the pool's device buffers (k + v, quantized pairs
+    included) — what the allocator's blocks actually cost in HBM.  The
+    obs ``memory`` section cross-checks this against
+    :func:`expected_pool_bytes`' shape math."""
+    import numpy as np
+
+    return int(sum(
+        int(np.prod(leaf.shape, dtype=np.int64)) * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(cache)
+    ))
+
+
+def expected_pool_bytes(
+    cfg: GPTConfig, num_blocks: int, block_size: int, axis_size: int = 1,
+    quantized: bool = False,
+) -> int:
+    """What :func:`init_paged_kv` SHOULD allocate, from shape math alone:
+    ``2 * L * num_blocks * Hkv/axis_size * block_size * hd`` entries in
+    ``cfg.dtype`` (int8 + f32 per-vector scale when ``quantized``).  The
+    independent half of the pool-accounting cross-check."""
+    hkv = cfg.block.kv_head_count // axis_size
+    entries = cfg.nlayers * num_blocks * hkv * block_size
+    hd = cfg.block.head_dim
+    if quantized:
+        per_kv = entries * hd * 1 + entries * 4  # int8 q + f32 scale
+    else:
+        import jax.numpy as jnp
+
+        per_kv = entries * hd * jnp.dtype(cfg.dtype).itemsize
+    return 2 * per_kv  # k and v
+
+
 def _scatter_positions(tables: jnp.ndarray, pos: jnp.ndarray, block_size: int):
     """Map absolute per-slot positions [B, S] -> (block ids [B*S], in-block
     offsets [B*S]) through the block tables.  Positions past a table's

@@ -1,0 +1,157 @@
+"""Bench trajectory: compare the checked-in ``BENCH_r0*.json`` rounds.
+
+Every driver round leaves a ``BENCH_r0N.json`` artifact behind (``{"n",
+"tail", "parsed"}`` — the bench harness's stdout tail holds one JSON line
+per measured metric).  Nothing consumed that trajectory until now: a
+slow regression could ride through five rounds unchallenged as long as
+each round individually "worked".  This tool is the first consumer —
+
+    python -m torchdistpackage_tpu.tools.bench_trend [--dir REPO]
+        [--threshold 0.05] [--glob 'BENCH_r*.json']
+
+parses every round, groups the metric lines per series (``metric`` key:
+gpt-125m-train-throughput, gpt-1b-train-throughput, ...), prints the
+per-round values with round-over-round deltas, and exits NONZERO with a
+loud ``REGRESSION`` warning when the newest round lost more than
+``--threshold`` (default 5%) against the best earlier round of the same
+series.  Stale lines (``"stale": true`` — the accelerator was
+unreachable and the harness replayed the last-good record) are shown but
+never counted as fresh evidence in either direction.
+
+Deliberately jax-free (a login-node / CI gate tool, like
+``slurm_job_monitor``), hence the bare prints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Tuple
+
+#: JSON-line keys treated as secondary metrics worth trending alongside
+#: the headline value (shown when present; only ``value`` gates).
+AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac")
+
+
+def _metric_lines(tail: str) -> List[Dict[str, Any]]:
+    """Every parseable JSON object in a round's stdout tail that looks
+    like a bench line (has metric + numeric value)."""
+    out = []
+    for ln in tail.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and isinstance(
+                rec.get("value"), (int, float)) and rec.get("metric"):
+            out.append(rec)
+    return out
+
+
+def load_rounds(paths: List[str]) -> List[Tuple[int, List[Dict[str, Any]]]]:
+    """[(round_number, [metric lines...])], sorted by round."""
+    rounds = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench_trend: skipping unreadable {p}: {e}",
+                  file=sys.stderr)
+            continue
+        lines = _metric_lines(doc.get("tail", "") or "")
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and isinstance(
+                parsed.get("value"), (int, float)) and parsed.get("metric"):
+            # the driver's own pick of the headline line; dedup by identity
+            if not any(l.get("metric") == parsed["metric"]
+                       and l.get("value") == parsed["value"] for l in lines):
+                lines.append(parsed)
+        n = doc.get("n")
+        if not isinstance(n, int):
+            # fall back to the digits in the filename (BENCH_r07.json -> 7)
+            digits = "".join(c for c in os.path.basename(p) if c.isdigit())
+            n = int(digits) if digits else len(rounds)
+        rounds.append((n, lines))
+    return sorted(rounds)
+
+
+def trend(
+    rounds: List[Tuple[int, List[Dict[str, Any]]]], threshold: float = 0.05
+) -> Tuple[List[str], List[str]]:
+    """(report_lines, regression_warnings) over the per-metric series."""
+    series: Dict[str, List[Tuple[int, Dict[str, Any]]]] = {}
+    for n, lines in rounds:
+        for rec in lines:
+            series.setdefault(rec["metric"], []).append((n, rec))
+    report: List[str] = []
+    warnings: List[str] = []
+    for metric in sorted(series):
+        rows = series[metric]
+        report.append(f"{metric}:")
+        prev_val = None
+        for n, rec in rows:
+            val = rec["value"]
+            stale = rec.get("stale")
+            delta = (
+                f" ({(val - prev_val) / prev_val:+.1%})"
+                if (prev_val and not stale) else "")
+            aux = " ".join(
+                f"{k}={rec[k]}" for k in AUX_KEYS if k in rec)
+            report.append(
+                f"  r{n:02d}  {val:>12,.1f}{delta}"
+                + ("  [STALE]" if stale else "")
+                + (f"  {aux}" if aux else "")
+                + f"  {rec.get('config', '')}")
+            if not stale:
+                prev_val = val
+        fresh = [(n, r["value"]) for n, r in rows if not r.get("stale")]
+        if len(fresh) >= 2:
+            best_prior = max(v for _, v in fresh[:-1])
+            last_n, last = fresh[-1]
+            if best_prior > 0 and (best_prior - last) / best_prior > threshold:
+                warnings.append(
+                    f"REGRESSION {metric}: r{last_n:02d} = {last:,.1f} is "
+                    f"{(best_prior - last) / best_prior:.1%} below the best "
+                    f"earlier round ({best_prior:,.1f}) — past the "
+                    f"{threshold:.0%} gate")
+    return report, warnings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m torchdistpackage_tpu.tools.bench_trend",
+        description="Per-metric deltas across the checked-in bench rounds; "
+                    "nonzero exit + loud warning on >threshold regressions.")
+    ap.add_argument("--dir", default=None,
+                    help="repo dir holding the round files (default: the "
+                         "package checkout root)")
+    ap.add_argument("--glob", default="BENCH_r*.json",
+                    help="round-file pattern (default BENCH_r*.json)")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="relative loss vs the best earlier round that "
+                         "trips the regression gate (default 0.05)")
+    args = ap.parse_args(argv)
+    root = args.dir or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    paths = sorted(_glob.glob(os.path.join(root, args.glob)))
+    if not paths:
+        print(f"bench_trend: no files match {args.glob} under {root}",
+              file=sys.stderr)
+        return 2
+    report, warnings = trend(load_rounds(paths), threshold=args.threshold)
+    for ln in report:
+        print(ln)
+    for w in warnings:
+        print(f"\n!!! {w}", file=sys.stderr)
+    return 1 if warnings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

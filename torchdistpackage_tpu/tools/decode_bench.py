@@ -96,6 +96,22 @@ def bench_decode(jax, jnp, cfg, params, B, ctx, steps=64, reps=5,
     return best, prefill_samples, decode_samples
 
 
+def _mem_cols():
+    """``{peak_hbm_bytes, mem_headroom_frac}`` for the JSON lines — the
+    max per-device measured peak and its headroom against capacity, via
+    the one memory_stats reader (obs.mem_ledger).  {} on the CPU sim."""
+    from ..obs.mem_ledger import live_memory
+
+    live = live_memory()
+    if not live["reported"]:
+        return {}
+    cols = {"peak_hbm_bytes": max(
+        r["peak_bytes_in_use"] for r in live["per_device"])}
+    if live["peak_frac"]:
+        cols["mem_headroom_frac"] = round(1.0 - live["peak_frac"], 4)
+    return cols
+
+
 def _phase_lines(B, ctx, variant, prefill_s, decode_s):
     """obs-schema ``decode-latency`` records (ms percentiles per phase)."""
     from ..obs import percentiles
@@ -220,6 +236,7 @@ def bench_serve(jax, jnp, cfg, params, tel, *, n_requests, num_slots,
         # through, the engine issued exactly one signature per phase
         "decode_signatures": summary["decode_signatures"],
         "prefill_signatures": summary["prefill_signatures"],
+        **_mem_cols(),
     }), flush=True)
     summary["sequential_tok_s"] = seq_tok_s
     tel.record_serving(summary)
@@ -374,6 +391,7 @@ def main(argv=None):
             "bf16_tok_s": round(r_bf, 1),
             "int8_tok_s": round(r_q, 1),
             "speedup": round(r_q / r_bf, 3),
+            **_mem_cols(),
         }), flush=True)
 
     tel.record_counters(decode_latency=latency_cells)

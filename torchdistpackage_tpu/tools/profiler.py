@@ -64,9 +64,10 @@ def _tree_bytes(tree: PyTree) -> int:
 
 def _cost(compiled) -> Tuple[float, float, int]:
     """(flops, bytes_accessed, temp_bytes) from XLA analyses; zeros when the
-    backend doesn't report them."""
+    backend doesn't report them.  The memory half reads the shared static
+    ledger (``obs.mem_ledger.static_ledger``) instead of poking
+    ``memory_analysis`` directly — one parser for the whole repo."""
     flops = bytes_accessed = 0.0
-    temp = 0
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -75,11 +76,10 @@ def _cost(compiled) -> Tuple[float, float, int]:
         bytes_accessed = float(ca.get("bytes accessed", 0.0))
     except Exception:
         pass
-    try:
-        ma = compiled.memory_analysis()
-        temp = int(getattr(ma, "temp_size_in_bytes", 0))
-    except Exception:
-        pass
+    from ..obs.mem_ledger import static_ledger
+
+    led = static_ledger(compiled)
+    temp = int(led["temp_bytes"]) if led else 0
     return flops, bytes_accessed, temp
 
 
