@@ -291,11 +291,18 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
     """One timed measurement; returns (tokens_per_sec_chip, global_batch,
     flops_per_token, xla_flops_per_token, comm_ledger, mem).
 
-    ``mem`` carries the run's memory evidence (obs.mem_ledger):
-    ``peak_hbm_bytes`` (max per-device measured peak) and
-    ``mem_headroom_frac`` (1 - peak/capacity on the hottest device) when
-    the backend reports memory stats, plus ``mem_modeled_peak_bytes``
-    from the compiled step's static buffer ledger — {} on the CPU sim.
+    ``mem`` carries the run's memory AND numerics evidence columns merged
+    straight onto the JSON line: ``peak_hbm_bytes`` (max per-device
+    measured peak) and ``mem_headroom_frac`` (1 - peak/capacity on the
+    hottest device) when the backend reports memory stats, plus
+    ``mem_modeled_peak_bytes`` from the compiled step's static buffer
+    ledger ({} on the CPU sim); ``grad_norm_final`` — the global grad
+    norm of the LAST timed step, computed inside the same compiled
+    program (obs.numerics.global_grad_norm, shared with clip) so a bench
+    round also certifies the math was alive, not just fast; and
+    ``dtype_flop_frac`` — the compiled step's matmul-FLOP mix per dtype
+    from the HLO dtype ledger (bf16 vs f32 vs int8 — the precision
+    evidence, printed as a table on stderr).
 
     ``comm_ledger`` is the HLO collective ledger of the compiled step
     (``obs.comm_ledger``) — None when AOT compilation was unavailable.
@@ -371,11 +378,15 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
     # donate params/opt-state: relaxes buffer lifetimes so XLA updates in
     # place instead of holding input AND output copies of ~1.6 GB of
     # params+moments — a pure lifetime annotation, no semantic change
+    from torchdistpackage_tpu.obs.numerics import global_grad_norm
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        # numerics evidence rides in the same program: one extra scalar
+        gnorm = global_grad_norm(grads)
         updates, state = opt.update(grads, state, params)
-        return jax.tree.map(jnp.add, params, updates), state, loss
+        return jax.tree.map(jnp.add, params, updates), state, loss, gnorm
 
     k1, k2 = jax.random.split(jax.random.PRNGKey(1))
     global_batch = batch_size * n_chips
@@ -391,10 +402,12 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
     # per-chip token count.
     from torchdistpackage_tpu.obs import compiled_cost, ledger_from_compiled
     from torchdistpackage_tpu.obs import mem_ledger as _mem
+    from torchdistpackage_tpu.obs import numerics as _numerics
 
     xla_flops_per_token = None
     ledger = None
     mem_led = None
+    dtype_led = None
     run_step = step
     try:
         compiled = step.lower(params, state, batch).compile()
@@ -405,8 +418,11 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
         # the same no-second-compile hook feeds the comm ledger: which
         # collectives the step runs, over which axes, moving which bytes
         ledger = ledger_from_compiled(compiled, mesh=mesh)
-        # ... and the static memory ledger (args/temps/donation savings)
+        # ... the static memory ledger (args/temps/donation savings) ...
         mem_led = _mem.static_ledger(compiled, label="train_step")
+        # ... and the per-dtype HLO ledger (bf16 vs f32 vs int8 mix)
+        dtype_led = _numerics.dtype_ledger_from_compiled(
+            compiled, label="train_step")
         run_step = compiled
     except Exception as e:
         print(f"bench: AOT compile/cost-analysis unavailable ({e!r}); "
@@ -418,14 +434,15 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
     # dependency chain (params feed the next step), so fetching the final
     # loss bounds the whole run.
     for _ in range(warmup):
-        params, state, loss = run_step(params, state, batch)
+        params, state, loss, gnorm = run_step(params, state, batch)
     float(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, state, loss = run_step(params, state, batch)
+        params, state, loss, gnorm = run_step(params, state, batch)
     float(loss)
     dt = time.perf_counter() - t0
+    grad_norm_final = float(gnorm)
 
     if trace:
         # opt-in Perfetto host trace of the SAME step: a short
@@ -439,8 +456,8 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
                             poll_memory=False)
             tstep = tel.wrap_step(step)
             for i in range(3):
-                params, state, loss = tstep(params, state, batch)
-                tel.end_step(step=i, loss=loss)
+                params, state, loss, gnorm = tstep(params, state, batch)
+                tel.end_step(step=i, loss=loss, grad_norm=gnorm)
             tel.finalize(write=False, print_summary=False)
             export_trace(tel, trace)
             print(f"bench: wrote Perfetto trace to {trace}", file=sys.stderr)
@@ -460,6 +477,13 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
     if mem_led is not None:
         mem["mem_modeled_peak_bytes"] = mem_led["peak_estimate_bytes"]
         print(_mem.render_table(mem_led), file=sys.stderr)
+    # numerics evidence: the final step's global grad norm (a NaN/0 here
+    # means the measured throughput trained garbage) + the dtype FLOP mix
+    mem["grad_norm_final"] = round(grad_norm_final, 6)
+    if dtype_led is not None:
+        if dtype_led.get("flop_frac"):
+            mem["dtype_flop_frac"] = dtype_led["flop_frac"]
+        print(_numerics.render_dtype_table(dtype_led), file=sys.stderr)
 
     return (global_batch * cfg.max_seq * steps / dt / n_chips, global_batch,
             flops_per_token, xla_flops_per_token, ledger, mem)

@@ -108,9 +108,15 @@ def main():
     assert result.summary["faults_injected"] == 1, result.summary
     assert sorted(result.losses) == list(range(TOTAL_STEPS)), sorted(result.losses)
     assert all(math.isfinite(v) for v in result.losses.values())
-    # timeline carries the full story: injection, rollback, recovery
+    # timeline carries the full story: injection, ALERT, rollback,
+    # recovery — the chaos NaN shows up as a numerics_alert BEFORE the
+    # loop decides to roll back (cause precedes action on the timeline)
     kinds = [e["kind"] for e in tel.events.as_list()]
     assert "fault_injected" in kinds and "rollback" in kinds, kinds
+    alert = tel.events.of_kind("numerics_alert")[0]
+    assert alert["reason"] == "nonfinite_loss", alert
+    assert alert["t_mono"] < tel.events.of_kind("rollback")[0]["t_mono"]
+    assert report["numerics"]["alerts"]["count"] >= 1, report["numerics"]
     assert report["resilience"]["verdict"] == "recovered", report["resilience"]
     rollback = tel.events.of_kind("rollback")[0]
     master_print(

@@ -74,7 +74,11 @@ def main():
     dp = DataParallel()
     params = dp.broadcast_params(params, param_specs=specs)
     opt_state = opt.init(params)
-    step = dp.make_train_step(loss_fn, opt, param_specs=specs, grad_accum_iters=2)
+    # numerics=True fuses grad/param/update norms + update ratio into the
+    # SAME compiled step (docs/numerics.md) — the RUNREPORT gains the
+    # numerics timeline, alert thresholds, and the HLO dtype ledger
+    step = dp.make_train_step(loss_fn, opt, param_specs=specs,
+                              grad_accum_iters=2, numerics=True)
 
     B, S = 4 * max(1, ndev // tp), 32
 
@@ -95,17 +99,26 @@ def main():
     # comm ledger + RUNREPORT comm section come for free: the ledger maps
     # the compiled step's collectives onto tpc's ('data', 'tensor') mesh;
     # set TDP_TRACE=/path/trace.json for the Perfetto timeline
+    # toy scale note: adam's early |update|/|param| at tiny param norms
+    # sits far above a real run's band — widen that one threshold rather
+    # than silence the alert machinery (docs/numerics.md)
     tel = Telemetry(run="train_tp_dp", tokens_per_step=B * S,
-                    mesh=tpc.get_view())
+                    mesh=tpc.get_view(),
+                    numerics_thresholds={"update_ratio_high": 1.0})
     step = tel.wrap_step(step)
     # double-buffered host->HBM transfers overlap the previous step's compute
     batches = prefetch_to_sharding(host_batches(10), dp.mesh, P("data"))
     for i, batch in enumerate(batches):
-        params, opt_state, loss = step(params, opt_state, batch)
-        rec = tel.end_step(step=i, loss=loss)
+        params, opt_state, loss, nstats = step(params, opt_state, batch)
+        rec = tel.end_step(step=i, loss=loss, numerics=nstats)
         if i in (0, 4, 9):
-            print(f"iter {i}: loss={rec['loss']:.5f}")
-    tel.finalize()
+            print(f"iter {i}: loss={rec['loss']:.5f} "
+                  f"gnorm={rec['grad_norm']:.4f} "
+                  f"upd/param={rec['update_ratio']:.2e}")
+    report = tel.finalize()
+    # a healthy toy run: finite norms on every step, zero numerics alerts
+    assert report["numerics"]["alerts"]["count"] == 0, report["numerics"]
+    assert report["numerics"]["summary"]["grad_norm_final"] > 0
     print(f"10 iters in {time.perf_counter()-t0:.2f}s — OK")
     return 0
 

@@ -45,7 +45,18 @@ def _reset_tpc():
 # compiles it triggered, duration-sorted — so "which tests are eating the
 # budget, and is it compile time?" is one file-read instead of an
 # instrumented rerun.
+#
+# The report also ASSERTS the budget (PR 7): a full-suite run (>=
+# T1_FULL_SUITE_MIN collected tests — partial/-k runs are exempt) whose
+# wall clock exceeds T1_BUDGET_S prints a loud over-budget banner and
+# flags `over_budget` in the JSON; with TDP_T1_BUDGET_ENFORCE=1 it also
+# fails the session — so PR 6's reclaimed headroom can't silently erode
+# one "small" PR at a time.
 
+T1_BUDGET_S = 700.0
+T1_FULL_SUITE_MIN = 300  # below this many tests it's a targeted run
+
+_SESSION_T0 = time.perf_counter()
 _COMPILES = {"n": 0, "secs": 0.0}
 
 
@@ -75,9 +86,17 @@ def pytest_runtest_protocol(item, nextitem):
 
 
 def pytest_sessionfinish(session, exitstatus):
+    import sys
+
     rows = sorted(_DURATIONS.items(), key=lambda kv: -kv[1]["duration_s"])
+    wall_s = round(time.perf_counter() - _SESSION_T0, 1)
+    full_run = len(rows) >= T1_FULL_SUITE_MIN
+    over = full_run and wall_s > T1_BUDGET_S
     doc = {
         "total_s": round(sum(v["duration_s"] for _, v in rows), 1),
+        "wall_s": wall_s,
+        "budget_s": T1_BUDGET_S,
+        "over_budget": over,
         "total_compiles": _COMPILES["n"],
         "total_compile_s": round(_COMPILES["secs"], 1),
         "n_tests": len(rows),
@@ -88,6 +107,15 @@ def pytest_sessionfinish(session, exitstatus):
             json.dump(doc, f, indent=1)
     except OSError:
         pass  # read-only /tmp: the suite result matters more than the log
+    if over:
+        print(
+            f"\n!!! TIER-1 OVER BUDGET: {wall_s:.0f}s of the "
+            f"{T1_BUDGET_S:.0f}s wall budget ({len(rows)} tests, "
+            f"{_COMPILES['secs']:.0f}s compiling) — trim per "
+            f"{T1_DURATIONS_PATH} before landing more tests",
+            file=sys.stderr)
+        if os.environ.get("TDP_T1_BUDGET_ENFORCE") and exitstatus == 0:
+            session.exitstatus = 1
 
 
 @pytest.fixture

@@ -33,7 +33,11 @@ EXAMPLES = sorted(
 # as a valid Perfetto-loadable Chrome trace.
 OBS_EXAMPLES = {
     "train_llama.py": {},
-    "train_tp_dp.py": {"comm": "dp", "memory": True},
+    # ``numerics`` probes the PR-7 section: train_tp_dp fuses
+    # numerics_stats into its compiled step (healthy run: timeline + dtype
+    # ledger, zero alerts); train_resilient's chaos NaN spike must appear
+    # as a numerics_alert BEFORE the rollback event on the timeline
+    "train_tp_dp.py": {"comm": "dp", "memory": True, "numerics": "healthy"},
     "train_pipeline.py": {"counter": "pipeline", "field": "bubble_fraction"},
     "train_interleaved_pipeline.py": {
         "counter": "pipeline", "field": "bubble_fraction"},
@@ -48,7 +52,8 @@ OBS_EXAMPLES = {
     # self-healing loop (PR 4): chaos NaN spike -> rollback -> recovered;
     # the report must carry the resilience verdict AND the fault/rollback
     # events on its timeline
-    "train_resilient.py": {"comm": "dp", "resilience": "recovered"},
+    "train_resilient.py": {"comm": "dp", "resilience": "recovered",
+                           "numerics": "alert_before_rollback"},
     # continuous-batching engine (PR 5): the report must carry the serving
     # section (TTFT/TPOT, tokens/s, occupancy, pool) with the compile-once
     # evidence, plus the request lifecycle events
@@ -159,6 +164,28 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
             assert any(r["shard_count"] >= 8 for r in sharded), (
                 script, "expected a fully FSDP-sharded leaf on the "
                 "8-device sim", sorted({r['shard_count'] for r in sharded}))
+
+    if probe.get("numerics"):
+        num = report["numerics"]
+        if probe["numerics"] == "healthy":
+            # in-step stats flowed: per-step timeline with finite norms,
+            # a dtype ledger from the compiled step, zero alerts
+            assert num["timeline"], (script, "empty numerics timeline")
+            assert num["summary"]["grad_norm_final"] > 0, num["summary"]
+            assert num["alerts"]["count"] == 0, (script, num["alerts"])
+            assert num["dtype_ledgers"], (script, "no dtype ledger")
+            per = num["dtype_ledgers"][0]["per_dtype"]
+            assert any(b["flops"] > 0 for b in per.values()), per
+        if probe["numerics"] == "alert_before_rollback":
+            # the chaos NaN spike surfaces as a numerics_alert, and it
+            # lands on the timeline BEFORE the rollback decision
+            assert num["alerts"]["by_reason"].get("nonfinite_loss"), num
+            ev = report["events"]
+            alert_t = min(e["t_mono"] for e in ev
+                          if e["kind"] == "numerics_alert")
+            rollback_t = min(e["t_mono"] for e in ev
+                             if e["kind"] == "rollback")
+            assert alert_t < rollback_t, (script, alert_t, rollback_t)
 
     if probe.get("comm"):
         # the comm section must ledger this example's parallelism dimension

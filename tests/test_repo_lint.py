@@ -44,6 +44,9 @@ ALLOWLIST = {
     # bench-round trend gate: same deal — a jax-free login-node/CI CLI
     # over the checked-in BENCH_r0*.json artifacts.
     "tools/bench_trend.py",
+    # A/B run-parity diff CLI (PR 7): jax-free gate over RUNREPORT/JSONL
+    # artifacts on disk, same login-node deal as bench_trend.
+    "tools/parity_diff.py",
 }
 
 
@@ -296,6 +299,26 @@ def test_mem_event_kinds_registered_and_emitted():
     assert {"mem_snapshot", "oom_risk"} <= emitted, emitted
 
 
+def test_numerics_event_kinds_registered_and_emitted():
+    """The numerics-observability kinds (PR 7) are in the registry AND
+    emitted where the feature lives: ``numerics_alert`` from Telemetry's
+    threshold checks and from the resilience loop (BEFORE its rollback),
+    ``nan_block_located`` from the migrated tools/debug_nan.py walk."""
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+
+    assert {"numerics_alert", "nan_block_located"} <= EVENT_KINDS
+    obs_kinds, loop_kinds, nan_kinds = set(), set(), set()
+    for path in sorted((PKG / "obs").rglob("*.py")):
+        obs_kinds.update(k for _, k in _emit_call_kinds(path))
+    loop_kinds.update(
+        k for _, k in _emit_call_kinds(PKG / "resilience" / "loop.py"))
+    nan_kinds.update(
+        k for _, k in _emit_call_kinds(PKG / "tools" / "debug_nan.py"))
+    assert "numerics_alert" in obs_kinds, obs_kinds
+    assert "numerics_alert" in loop_kinds, loop_kinds
+    assert {"nan_block_located", "nan_watchdog"} <= nan_kinds, nan_kinds
+
+
 def test_event_kind_pass_covers_serving():
     """The serving package (PR 5) is inside the AST pass's scan set: its
     lifecycle kinds are emitted nowhere else, so a scan that missed
@@ -324,8 +347,9 @@ SWALLOW_ALLOWLIST = {
     "dist/overlap.py": 3,
     "obs/exporters.py": 3,
     # +1 in PR 6: the static-mem-ledger capture at compile time must
-    # never break the step it observes
-    "obs/telemetry.py": 5,
+    # never break the step it observes; +1 in PR 7: same rule for the
+    # per-dtype HLO ledger parse at the same hook
+    "obs/telemetry.py": 6,
     "obs/trace.py": 1,
     "parallel/clip.py": 1,
     "parallel/data_parallel.py": 1,

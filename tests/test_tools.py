@@ -1,6 +1,7 @@
 """Tests for the tools layer: profiler, NaN hunting, surgery/int8, SLURM
-monitor (subprocess-mocked)."""
+monitor (subprocess-mocked), and the bench-round trend gate."""
 
+import pathlib
 import subprocess
 from unittest import mock
 
@@ -142,15 +143,92 @@ def test_nan_guard_raises_inside_jit():
 
 
 def test_find_nan_block():
+    from torchdistpackage_tpu.obs.events import (
+        EventLog,
+        set_default_event_log,
+    )
+
     blocks = [
         ("ok", lambda x: x + 1),
         ("bad", lambda x: jnp.log(x - 10.0)),  # negative -> nan
         ("after", lambda x: x * 2),
     ]
-    name, _ = find_nan_block(blocks, jnp.ones((4,)))
-    assert name == "bad"
-    name, out = find_nan_block(blocks[:1], jnp.ones((4,)))
-    assert name is None and float(out[0]) == 2.0
+    log = EventLog()
+    set_default_event_log(log)
+    try:
+        name, _ = find_nan_block(blocks, jnp.ones((4,)))
+        assert name == "bad"
+        # the hit is a structured timeline record, not just a return value
+        ev = log.of_kind("nan_block_located")
+        assert len(ev) == 1 and ev[0]["block"] == "bad" and ev[0]["index"] == 1
+        assert ev[0]["n_bad"] == 1 and "bad" in ev[0]["bad_paths"][0]
+        name, out = find_nan_block(blocks[:1], jnp.ones((4,)))
+        assert name is None and float(out[0]) == 2.0
+        assert len(log.of_kind("nan_block_located")) == 1  # clean walk: quiet
+    finally:
+        set_default_event_log(None)
+
+
+def test_check_tensors_emit_lands_on_timeline():
+    from torchdistpackage_tpu.obs.events import (
+        EventLog,
+        set_default_event_log,
+    )
+
+    log = EventLog()
+    set_default_event_log(log)
+    try:
+        bad = check_tensors(
+            {"g": jnp.array([1.0, jnp.inf])}, name="grads", emit=True)
+        assert bad
+        ev = log.of_kind("nan_watchdog")
+        assert len(ev) == 1 and ev[0]["source"] == "check_tensors"
+        assert ev[0]["fn"] == "grads" and ev[0]["n_bad"] == 1
+        # healthy scans stay quiet even with emit on
+        check_tensors({"g": jnp.ones((2,))}, emit=True)
+        assert len(log.of_kind("nan_watchdog")) == 1
+    finally:
+        set_default_event_log(None)
+
+
+# -------------------------------------------------------- bench trend gate
+
+
+def test_bench_trend_gates_checked_in_rounds(capsys):
+    """Tier-1 gate over the repo's own BENCH_r0*.json artifacts: the
+    checked-in trajectory must hold no >5% regression (a round that loses
+    throughput now FAILS the suite instead of riding through unchallenged
+    — the promotion ISSUE 7 asked for)."""
+    from torchdistpackage_tpu.tools.bench_trend import main
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    assert list(repo.glob("BENCH_r0*.json")), "no bench rounds checked in"
+    rc = main(["--dir", str(repo)])
+    captured = capsys.readouterr()
+    assert rc == 0, f"bench trend regression:\n{captured.err}"
+    assert "train-throughput" in captured.out
+
+
+def test_bench_trend_regression_detection_and_numerics_columns(tmp_path):
+    """The gate actually bites (a forged losing round exits nonzero) and
+    the PR-7 ``grad_norm_final`` numerics column renders next to the
+    throughput it certifies."""
+    import json as _json
+
+    from torchdistpackage_tpu.tools.bench_trend import AUX_KEYS, main, trend
+
+    assert "grad_norm_final" in AUX_KEYS
+    line = {"metric": "m", "value": 100.0, "unit": "tok/s",
+            "grad_norm_final": 0.37, "mfu": 0.4, "config": "c"}
+    rounds = [(1, [line]), (2, [dict(line, value=90.0)])]
+    report, warnings = trend(rounds, threshold=0.05)
+    assert any("REGRESSION" in w for w in warnings)
+    assert any("grad_norm_final=0.37" in ln for ln in report)
+    for n, lines in rounds:
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+            _json.dumps({"n": n, "tail": "\n".join(
+                _json.dumps(l) for l in lines)}))
+    assert main(["--dir", str(tmp_path)]) == 1
 
 
 # ------------------------------------------------------------- surgery/int8

@@ -37,9 +37,20 @@ telemetry layer every train loop, example, and bench emits through:
   leaves through the compiled input shardings), the repo's ONE
   ``memory_stats()`` reader (``live_memory``), ``ok|tight|oom_risk``
   headroom verdicts, and the planner-facing ``MemoryModel.estimate``.
+- :mod:`.numerics` — numerics observability: the jittable
+  ``numerics_stats`` fused into the train step (per-layer-group grad/
+  param/update norms, update ratio, non-finite counts, low-precision
+  range fractions), the per-dtype HLO FLOP/byte ledger (what actually
+  runs in bf16 vs f32 vs int8), threshold-driven ``numerics_alert``
+  events, and the RUNREPORT ``numerics`` section.
+- :mod:`.parity` — A/B run-parity: compare two runs' record streams /
+  RUNREPORTs into an ``exact|bounded|diverged`` verdict with per-step
+  drift curves and per-leaf param divergence (``tools/parity_diff.py``
+  is the CLI).
 - :mod:`.trace` — Perfetto-loadable Chrome-trace export of the run
-  (spans, events, ledger + HBM counters) + ``XlaStepTrace``, a
-  programmatic ``jax.profiler`` capture bracketing a chosen step window.
+  (spans, events, ledger + HBM + grad-norm counters) + ``XlaStepTrace``,
+  a programmatic ``jax.profiler`` capture bracketing a chosen step
+  window.
 
 Design constraints: ``obs`` is a LEAF subsystem — it imports nothing from
 the rest of the package at module scope (``utils.metrics`` shims over
@@ -96,6 +107,25 @@ from .mem_ledger import (
     mem_report,
     static_ledger,
 )
+from .numerics import (
+    DEFAULT_THRESHOLDS,
+    DTYPE_LEDGER_SCHEMA,
+    NUMERICS_SCHEMA,
+    check_alerts,
+    dtype_ledger_from_compiled,
+    dtype_ledger_from_hlo,
+    global_grad_norm,
+    numerics_report,
+    numerics_stats,
+)
+from .parity import (
+    PARITY_SCHEMA,
+    PARITY_VERDICTS,
+    compare_streams,
+    param_divergence,
+    parity_section,
+    stream_of,
+)
 from .trace import (
     XlaStepTrace,
     build_trace,
@@ -145,6 +175,21 @@ __all__ = [
     "live_memory",
     "mem_report",
     "static_ledger",
+    "DEFAULT_THRESHOLDS",
+    "DTYPE_LEDGER_SCHEMA",
+    "NUMERICS_SCHEMA",
+    "check_alerts",
+    "dtype_ledger_from_compiled",
+    "dtype_ledger_from_hlo",
+    "global_grad_norm",
+    "numerics_report",
+    "numerics_stats",
+    "PARITY_SCHEMA",
+    "PARITY_VERDICTS",
+    "compare_streams",
+    "param_divergence",
+    "parity_section",
+    "stream_of",
     "XlaStepTrace",
     "build_trace",
     "default_trace_path",

@@ -55,6 +55,20 @@ Memory kinds (``obs.mem_ledger`` + Telemetry, PR 6):
                     crossed the OOM-risk line (peak >= 95% of capacity)
 ==================  =====================================================
 
+Numerics kinds (``obs.numerics`` + Telemetry, PR 7):
+
+==================  =====================================================
+``numerics_alert``  a step's training-dynamics stats crossed a health
+                    threshold (grad explosion/vanishing, update ratio out
+                    of band, non-finite loss/grads); emitted on entering
+                    the bad state by ``Telemetry.end_step`` and by
+                    ``ResilientLoop`` BEFORE it decides to roll back —
+                    the alert precedes the ``rollback`` on the timeline
+``nan_block_located``  ``tools.debug_nan.find_nan_block`` walked the
+                    model and found the first block producing non-finite
+                    values (record carries the block name + bad paths)
+==================  =====================================================
+
 Serving kinds (``torchdistpackage_tpu.serving``, PR 5):
 
 ==================  =====================================================
@@ -101,6 +115,8 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     "request_admitted", "prefill_chunk", "request_retired", "slots_snapshot",
     # memory observability (PR 6)
     "mem_snapshot", "oom_risk",
+    # numerics observability (PR 7)
+    "numerics_alert", "nan_block_located",
 })
 
 

@@ -23,6 +23,9 @@ RESILIENCE_VERDICTS = ("clean", "recovered", "preempted", "aborted")
 # thresholds; re-exported here next to the other verdict vocabularies)
 from .mem_ledger import MEM_VERDICTS  # noqa: E402
 
+# the A/B run-parity verdicts (obs/parity.py; numerics.parity sub-section)
+from .parity import PARITY_VERDICTS  # noqa: E402
+
 # top-level key -> required python type (None = any); everything Telemetry
 # emits, and everything validate checks.
 _REQUIRED: Dict[str, type] = {
@@ -37,6 +40,7 @@ _REQUIRED: Dict[str, type] = {
     "throughput": dict,
     "mfu": dict,
     "memory": dict,
+    "numerics": dict,
     "compile": dict,
     "hosts": dict,
     "comm": dict,
@@ -88,6 +92,7 @@ def validate_runreport(report: Any) -> List[str]:
         elif comm["verdict"] not in ("comm-bound", "compute-bound", "unknown"):
             errs.append(f"comm verdict {comm['verdict']!r} invalid")
     errs.extend(_validate_memory(report["memory"]))
+    errs.extend(_validate_numerics(report["numerics"]))
     res = report.get("resilience")
     if res is not None:  # optional: present when a ResilientLoop drove the run
         if not isinstance(res, dict):
@@ -134,6 +139,59 @@ def _validate_memory(mem: Any) -> List[str]:
         errs.append(
             f"memory.kv_pool accounting mismatch: expected "
             f"{kv.get('pool_bytes_expected')} != actual {kv.get('pool_bytes')}")
+    return errs
+
+
+def _validate_numerics(num: Any) -> List[str]:
+    """The required ``numerics`` section (obs/numerics.py): timeline
+    summary, alert roll-up, per-dtype HLO ledgers, optional A/B parity."""
+    errs: List[str] = []
+    alerts = num.get("alerts")
+    if not isinstance(alerts, dict) or not isinstance(
+            alerts.get("count"), int) or alerts["count"] < 0:
+        errs.append("numerics.alerts.count missing/negative")
+    elif alerts["count"] > 0 and not alerts.get("by_reason"):
+        errs.append("numerics.alerts.by_reason empty with count > 0")
+    if not isinstance(num.get("timeline"), list):
+        errs.append("numerics.timeline missing/non-list")
+    else:
+        for i, t in enumerate(num["timeline"]):
+            if not isinstance(t, dict) or "step" not in t:
+                errs.append(f"numerics.timeline[{i}] lacks step")
+                break
+    leds = num.get("dtype_ledgers")
+    if not isinstance(leds, list):
+        errs.append("numerics.dtype_ledgers missing/non-list")
+        leds = []
+    for i, led in enumerate(leds):
+        per = led.get("per_dtype") if isinstance(led, dict) else None
+        if not isinstance(per, dict):
+            errs.append(f"numerics.dtype_ledgers[{i}].per_dtype missing")
+            break
+        for dt, b in per.items():
+            if not all(isinstance(b.get(k), int) and b[k] >= 0
+                       for k in ("bytes", "ops", "flops")):
+                errs.append(
+                    f"numerics.dtype_ledgers[{i}].per_dtype[{dt!r}] "
+                    f"lacks bytes/ops/flops")
+                break
+    summ = num.get("summary")
+    if not isinstance(summ, dict):
+        errs.append("numerics.summary missing/non-dict")
+    else:
+        for k in ("grad_norm_final", "update_ratio_final"):
+            v = summ.get(k)
+            if v is not None and not isinstance(v, (int, float)):
+                errs.append(f"numerics.summary.{k} non-numeric")
+    par = num.get("parity")
+    if par is not None:
+        if not isinstance(par, dict):
+            errs.append(f"numerics.parity is {type(par).__name__}")
+        elif par.get("verdict") not in PARITY_VERDICTS:
+            errs.append(
+                f"numerics.parity verdict {par.get('verdict')!r} invalid")
+        elif not isinstance(par.get("streams"), list):
+            errs.append("numerics.parity.streams missing/non-list")
     return errs
 
 
@@ -194,6 +252,16 @@ def render_summary_line(report: Dict[str, Any]) -> str:
             f"mem={mem['verdict']}"
             + (f"(headroom {frac:.0%})" if isinstance(frac, (int, float))
                else ""))
+    num = report.get("numerics", {})
+    gn = num.get("summary", {}).get("grad_norm_final")
+    if isinstance(gn, (int, float)):
+        parts.append(f"gnorm={gn:.3g}")
+    if num.get("alerts", {}).get("count"):
+        reasons = ",".join(sorted(num["alerts"]["by_reason"]))
+        parts.append(f"NUMERICS={num['alerts']['count']}alert({reasons})")
+    par = num.get("parity")
+    if par and par.get("verdict") and par["verdict"] != "unknown":
+        parts.append(f"parity={par['verdict']}")
     hosts = report.get("hosts", {})
     if hosts.get("straggler") is not None:
         parts.append(f"STRAGGLER=host{hosts['straggler']}")
@@ -321,6 +389,56 @@ def render_markdown(report: Dict[str, Any]) -> str:
                     f"{lead['n_leaves']} leaves, "
                     f"{lead['sharded_leaves']} sharded / "
                     f"{lead['replicated_leaves']} replicated")
+        L.append("")
+
+    num = report.get("numerics", {})
+    if (num.get("timeline") or num.get("dtype_ledgers")
+            or num.get("alerts", {}).get("count")):
+        L.append("## Numerics")
+        L.append("")
+        summ = num.get("summary", {})
+        if "grad_norm_final" in summ:
+            L.append(
+                f"- grad norm: final **{summ['grad_norm_final']:.4g}**, "
+                f"mean {summ.get('grad_norm_mean', 0):.4g}, "
+                f"max {summ.get('grad_norm_max', 0):.4g}")
+        if "update_ratio_final" in summ:
+            L.append(f"- update ratio |Δp|/|p|: final "
+                     f"{summ['update_ratio_final']:.3g}, mean "
+                     f"{summ.get('update_ratio_mean', 0):.3g}")
+        alerts = num.get("alerts", {})
+        if alerts.get("count"):
+            first = alerts.get("first", {})
+            L.append(
+                f"- **{alerts['count']} numerics alert(s)**: "
+                + ", ".join(f"{r}×{n}"
+                            for r, n in sorted(alerts["by_reason"].items()))
+                + (f" — first at step {first.get('step')}"
+                   f" ({first.get('reason')})" if first else ""))
+        else:
+            L.append("- no numerics alerts")
+        for led in (num.get("dtype_ledgers") or [])[:1]:
+            per = led.get("per_dtype") or {}
+            if per:
+                L.append("")
+                L.append("| dtype | ops | buffer bytes | matmul FLOPs |")
+                L.append("|---|---|---|---|")
+                for dt, b in per.items():
+                    L.append(f"| {dt} | {b['ops']} | {b['bytes']:,} | "
+                             + (f"{b['flops']:.3e} |" if b['flops']
+                                else "- |"))
+        par = num.get("parity")
+        if par:
+            L.append("")
+            L.append(f"- A/B parity ({' vs '.join(par.get('labels', []))}): "
+                     f"**{par.get('verdict')}**")
+            for c in par.get("streams", []):
+                mrd = c.get("max_rel_delta")
+                L.append(
+                    f"  - {c.get('key')}: {c.get('verdict')} over "
+                    f"{c.get('n_common')} steps"
+                    + (f", max rel delta {mrd:.3g}"
+                       if isinstance(mrd, (int, float)) else ""))
         L.append("")
 
     comp = report.get("compile", {})

@@ -43,6 +43,15 @@ track), and every AOT-compiled signature's ``memory_analysis()`` is
 parsed into a static buffer ledger (:mod:`.mem_ledger`) — the report's
 ``memory`` section reconciles the two against device capacity into an
 ``ok|tight|oom_risk`` headroom verdict.
+
+Numerics: pass the in-step :func:`~.numerics.numerics_stats` dict to
+``end_step(..., numerics=stats)`` and Telemetry promotes it to a
+per-step timeline (grad/param/update norms, update ratio, non-finite
+counts, low-precision range fractions), runs the
+:func:`~.numerics.check_alerts` thresholds (``numerics_alert`` events on
+entering a bad state), exports ``grad_norm`` / ``update_ratio`` Perfetto
+counter tracks, and parses every AOT-compiled signature's HLO into a
+per-dtype FLOP/byte ledger — the report's validated ``numerics`` section.
 """
 
 from __future__ import annotations
@@ -112,6 +121,25 @@ def _abstract_signature(args: Tuple[Any, ...]) -> Tuple:
     return (str(treedef), tuple(sig))
 
 
+def _host_numerics(stats: Dict[str, Any]) -> Dict[str, Any]:
+    """Fetch a (possibly nested) dict of device scalars to host floats —
+    one device_get for the whole tree, so the numerics stats cost a
+    single transfer alongside the loss."""
+    import jax
+
+    host = jax.device_get(stats)
+
+    def conv(node):
+        if isinstance(node, dict):
+            return {k: conv(v) for k, v in node.items()}
+        try:
+            return float(node)
+        except (TypeError, ValueError):
+            return node
+
+    return conv(host)
+
+
 def _local_memory_stats() -> Optional[Tuple[int, int]]:
     """(peak_bytes, live_bytes) summed over local devices; None when no
     device reports (CPU sim).  Thin shim over the repo's one
@@ -158,6 +186,14 @@ class Telemetry:
     mem_snapshot_every: emit a ``mem_snapshot`` event every N steps with
         the live/peak HBM sample (0 = never; the per-step samples land on
         the step records and the report timeline regardless).
+    numerics_thresholds: overrides for the ``numerics_alert`` thresholds
+        (:data:`~.numerics.DEFAULT_THRESHOLDS`) applied to every
+        ``end_step(..., numerics=...)`` record — and to the loss scalar
+        itself, so a non-finite loss alerts even without in-step stats.
+    dtype_ledger_enabled: parse every compiled signature's HLO into the
+        per-dtype FLOP/byte ledger (:func:`~.numerics.dtype_ledger_from_hlo`;
+        RUNREPORT ``numerics`` section).  Same no-second-compile hook as
+        the comm/mem ledgers.
     xla_trace: a :class:`~.trace.XlaStepTrace` — programmatic
         ``jax.profiler`` capture bracketing a window of wrapped steps.
     """
@@ -179,6 +215,8 @@ class Telemetry:
         xla_trace: Optional[Any] = None,
         mem_ledger_enabled: bool = True,
         mem_snapshot_every: int = 16,
+        numerics_thresholds: Optional[Dict[str, float]] = None,
+        dtype_ledger_enabled: bool = True,
     ) -> None:
         import jax
 
@@ -206,6 +244,14 @@ class Telemetry:
         self.mem_timeline: List[Dict[str, Any]] = []
         self._peak_frac = 0.0
         self._oom_emitted = False
+        self.numerics_thresholds = dict(numerics_thresholds or {})
+        self.dtype_ledger_enabled = dtype_ledger_enabled
+        #: per-dtype HLO ledgers, one per AOT-compiled signature (numerics)
+        self.dtype_ledgers: List[Dict[str, Any]] = []
+        #: per-step numerics samples (the training-dynamics timeline)
+        self.numerics_timeline: List[Dict[str, Any]] = []
+        self._alert_active: set = set()
+        self.parity: Optional[Dict[str, Any]] = None
         self.xla_trace = xla_trace
         if event_log is None:
             event_log = EventLog()
@@ -327,16 +373,35 @@ class Telemetry:
                     self.mem_ledgers.append(led)
             except Exception:
                 pass
+        # HLO text rendered ONCE per signature, shared by the comm ledger
+        # (first signature) and the per-dtype ledger (every signature)
+        hlo_text = None
+        if compiled is not None and (
+                self.comm_ledger_enabled or self.dtype_ledger_enabled):
+            try:
+                hlo_text = compiled.as_text()
+            except Exception:
+                hlo_text = None
+            if not isinstance(hlo_text, str) or not hlo_text:
+                hlo_text = None
+        if hlo_text is not None and self.dtype_ledger_enabled:
+            try:
+                from . import numerics as _numerics
+
+                self.dtype_ledgers.append(_numerics.dtype_ledger_from_hlo(
+                    hlo_text, label=f"sig{len(self._compiled) - 1}"))
+            except Exception:
+                pass
         if first:
             self.xla_cost = dict(cost)
-            if compiled is not None and self.comm_ledger_enabled:
+            if hlo_text is not None and self.comm_ledger_enabled:
                 # same no-second-compile hook that captures cost_analysis:
                 # parse the compiled step's collectives into the comm ledger
                 try:
                     from . import comm_ledger as _ledger
 
-                    self.comm_ledger = _ledger.ledger_from_compiled(
-                        compiled, mesh=self.mesh)
+                    self.comm_ledger = _ledger.ledger_from_hlo(
+                        hlo_text, mesh=self.mesh)
                 except Exception:
                     self.comm_ledger = None
         else:
@@ -353,11 +418,24 @@ class Telemetry:
 
     # ------------------------------------------------------------ recording
 
-    def end_step(self, step: Optional[int] = None, **scalars: Any) -> Dict[str, Any]:
+    def end_step(
+        self,
+        step: Optional[int] = None,
+        *,
+        numerics: Optional[Dict[str, Any]] = None,
+        **scalars: Any,
+    ) -> Dict[str, Any]:
         """Close the step opened by the wrapped call: block on its outputs
         (device span), fetch the passed scalars (fetch span), build the
         record, feed the sinks.  Returns the record with host floats — use
-        ``rec["loss"]`` instead of a second ``float(loss)``."""
+        ``rec["loss"]`` instead of a second ``float(loss)``.
+
+        ``numerics``: the in-step :func:`~.numerics.numerics_stats` dict
+        (device scalars).  It is fetched with the other scalars (same
+        fetch span), lands on the record as ``rec["numerics"]`` (with
+        ``grad_norm`` / ``update_ratio`` promoted to top-level floats for
+        sinks and the trace counter tracks), extends the numerics
+        timeline, and runs the alert thresholds."""
         import jax
 
         t0 = time.perf_counter()
@@ -378,6 +456,11 @@ class Telemetry:
                 rec[k] = float(v)
             except (TypeError, ValueError):
                 rec[k] = v
+        if numerics is not None:
+            rec["numerics"] = _host_numerics(numerics)
+            for k in ("grad_norm", "update_ratio", "nonfinite_grads"):
+                if k in rec["numerics"]:
+                    rec[k] = rec["numerics"][k]
         t2 = time.perf_counter()
         spans = dict(self._pending_spans)
         self._pending_spans = {}
@@ -427,6 +510,25 @@ class Telemetry:
                         "oom_risk", step=rec["step"],
                         peak_frac=round(mem["peak_frac"], 4),
                         basis="live memory_stats sample")
+        if numerics is not None:
+            self.numerics_timeline.append({
+                "step": rec["step"],
+                **{k: v for k, v in rec["numerics"].items() if k != "groups"},
+                **({"loss": rec["loss"]}
+                   if isinstance(rec.get("loss"), float) else {}),
+            })
+        # threshold checks over the host record (covers the plain-loss
+        # path too: a non-finite loss alerts without in-step stats);
+        # alerts fire on ENTERING a bad state, not every step inside it
+        from . import numerics as _numerics
+
+        alerts = _numerics.check_alerts(rec, self.numerics_thresholds)
+        for a in alerts:
+            if a["reason"] not in self._alert_active:
+                self.events.emit(
+                    "numerics_alert", step=rec["step"],
+                    source="telemetry", **a)
+        self._alert_active = {a["reason"] for a in alerts}
         self._last_fetch_end = t2
         self._step_n += 1
         if len(self.history) < self._history_max:
@@ -450,6 +552,12 @@ class Telemetry:
         ``resilience`` section (``ResilientLoop.run`` calls this when a
         Telemetry is wired in; validated by ``validate_runreport``)."""
         self.resilience = dict(summary)
+
+    def record_parity(self, section: Dict[str, Any]) -> None:
+        """Attach an A/B :func:`~.parity.parity_section` to the report's
+        ``numerics.parity`` sub-section (``exact|bounded|diverged``
+        verdict; validated by ``validate_runreport``)."""
+        self.parity = dict(section)
 
     def record_serving(self, summary: Dict[str, Any]) -> None:
         """Attach a ``ServingEngine.serving_summary()`` as the report's
@@ -565,6 +673,16 @@ class Telemetry:
         memory["peak_bytes_in_use"] = self._peak_bytes
         memory["reported"] = self._peak_bytes > 0
 
+        from . import numerics as _numerics
+
+        numerics_sec = _numerics.numerics_report(
+            timeline=self.numerics_timeline,
+            dtype_ledgers=self.dtype_ledgers,
+            events=self.events.as_list(),
+            parity=self.parity,
+            thresholds=self.numerics_thresholds,
+        )
+
         if self.xla_trace is not None:
             self.xla_trace.close()
         self.events.emit("run_end", run=self.run, steps=self._step_n)
@@ -582,6 +700,7 @@ class Telemetry:
             "throughput": throughput,
             "mfu": mfu,
             "memory": memory,
+            "numerics": numerics_sec,
             "compile": {
                 "count": self.n_compiles,
                 "time_s": round(self.compile_time_s, 3),

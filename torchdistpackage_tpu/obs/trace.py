@@ -3,7 +3,8 @@
 RUNREPORT summarizes a run; this renders it as something a human can
 *scrub*: every step's host spans (data / dispatch / device / fetch) as
 complete events on per-phase tracks, the :mod:`.events` timeline as
-instant events, per-step comm-ledger byte counters, all in the Chrome
+instant events, per-step counter tracks (comm-ledger bytes, HBM bytes,
+and the numerics ``grad_norm`` / ``update_ratio``), all in the Chrome
 trace-event JSON format that ``chrome://tracing`` and
 https://ui.perfetto.dev load directly.
 
@@ -123,6 +124,14 @@ def chrome_trace_events(
                 "tid": 0, "ts": us(end - r.get("step_time_s", 0.0)),
                 "args": {d: v["bytes"] for d, v in per_dim.items()},
             })
+        for counter in ("grad_norm", "update_ratio"):
+            # the numerics timeline as Perfetto counter tracks: scrub the
+            # run and watch the gradient norm / update ratio move
+            if isinstance(r.get(counter), (int, float)):
+                out.append({
+                    "ph": "C", "name": counter, "pid": process, "tid": 0,
+                    "ts": us(end), "args": {counter: r[counter]},
+                })
         if "bytes_in_use" in r:
             # the HBM timeline as a Perfetto counter track: live bytes per
             # step (and the high-water mark), from mem_ledger.live_memory

@@ -33,18 +33,18 @@ PyTree = Any
 
 def global_grad_norm(grads: PyTree) -> jnp.ndarray:
     """True global L2 norm of a (possibly mixed-sharded) grad pytree — traced,
-    call inside shard_map after grad reduction."""
-    # group local squared-sums by varying-axis set so each distinct set costs
-    # ONE scalar psum (vs one per leaf — hundreds of 4-byte all-reduces)
-    by_axes: dict = {}
-    for g in jax.tree.leaves(grads):
-        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
-        axes = tuple(sorted(_vma(sq)))
-        by_axes[axes] = by_axes.get(axes, 0.0) + sq
-    total = jnp.zeros((), dtype=jnp.float32)
-    for axes, sq in by_axes.items():
-        total = total + (jax.lax.psum(sq, axes) if axes else sq)
-    return jnp.sqrt(total)
+    call inside shard_map after grad reduction.
+
+    Delegates to ``obs.numerics.global_grad_norm`` — the ONE grouped
+    squared-sum reduction (per distinct varying-axis set, one scalar psum
+    each) that clipping and the numerics monitoring stats share, so a
+    step doing both compiles one reduction (XLA CSEs the identical
+    subgraphs) and the clipped trajectory is bitwise-unchanged vs the
+    pre-fold implementation (tests/test_numerics_obs.py parity-tests
+    this against an inline copy of the old algorithm)."""
+    from ..obs.numerics import global_grad_norm as _shared_impl
+
+    return _shared_impl(grads)
 
 
 def clip_grads_by_global_norm(grads: PyTree, max_norm: float) -> Tuple[PyTree, jnp.ndarray]:

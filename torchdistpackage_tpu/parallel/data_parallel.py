@@ -432,6 +432,7 @@ class DataParallel:
         donate: bool = True,
         value_and_grad_fn: Optional[Callable] = None,
         accum_reduce: str = "final",
+        numerics: bool = False,
     ):
         """Build a jitted SPMD train step.
 
@@ -457,6 +458,15 @@ class DataParallel:
           scan.  Exact for the mean/sum reductions (linear); trades
           ``iters``× the reduction traffic for the overlap and composes
           with ``overlap.configure()``'s async-collective presets.
+        - ``numerics=True``: fuse ``obs.numerics.numerics_stats`` over the
+          reduced grads / pre-update params / optimizer updates INTO the
+          compiled step — the step returns ``(params, opt_state, loss,
+          stats)`` where ``stats`` is a dict of f32 scalars (global +
+          per-layer-group norms, update ratio, non-finite counts,
+          low-precision range fractions) to hand to
+          ``Telemetry.end_step(..., numerics=stats)``.  One program, no
+          extra dispatch; donation is unaffected (the stats read the
+          values the step already holds).
         """
         if (loss_fn is None) == (value_and_grad_fn is None):
             raise ValueError("pass exactly one of loss_fn / value_and_grad_fn")
@@ -507,7 +517,17 @@ class DataParallel:
             if dax:
                 loss = _reduce_loss(loss, dax, self.reduce_op)
             updates, opt_state = optimizer.update(grads, opt_state, params)
+            if numerics:
+                # monitoring rides in the SAME compiled program as
+                # training: norms over the reduced grads, the pre-update
+                # params and the optimizer updates (update_ratio =
+                # |update|/|param|), sharing the clip reduction
+                from ..obs.numerics import numerics_stats
+
+                nstats = numerics_stats(grads, params=params, updates=updates)
             params = jax.tree.map(jnp.add, params, updates)
+            if numerics:
+                return params, opt_state, loss, nstats
             return params, opt_state, loss
 
         # The shard_map specs depend on the pytree structure of the arguments,
@@ -537,11 +557,16 @@ class DataParallel:
                 # in_spec would then feed full-size moments to sharded grads
                 opt_specs = _opt_state_specs(
                     opt_state, params, in_param_specs, spec_of)
+                # the numerics stats dict is all psum-reduced scalars —
+                # replicated, so a P() prefix spec covers the subtree
+                out_specs = (
+                    (in_param_specs, opt_specs, P(), P()) if numerics
+                    else (in_param_specs, opt_specs, P()))
                 sm = shard_map(
                     step,
                     mesh=mesh,
                     in_specs=(in_param_specs, opt_specs, in_batch_specs),
-                    out_specs=(in_param_specs, opt_specs, P()),
+                    out_specs=out_specs,
                 )
                 cache[key] = jax.jit(sm, donate_argnums=(0, 1) if donate else ())
             return cache[key](params, opt_state, batch)

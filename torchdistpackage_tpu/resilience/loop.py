@@ -231,6 +231,15 @@ class ResilientLoop:
 
                 verdict = self.monitor.check(loss_f, grad_norm)
                 if verdict != "ok":
+                    # the numerics alert lands on the timeline BEFORE the
+                    # recovery decision (rollback / abort), so the report
+                    # reads cause -> action in order: the chaos NaN spike
+                    # shows up as a numerics_alert first, then the rollback
+                    emit_event(
+                        "numerics_alert", step=step,
+                        reason=("nonfinite_loss" if verdict == "nonfinite"
+                                else "loss_spike"),
+                        value=loss_f, source="divergence_monitor")
                     if rollbacks >= self.max_rollbacks:
                         emit_event(
                             "resilience_abort", step=step, reason=verdict,

@@ -33,7 +33,11 @@ from typing import Any, Dict, List, Tuple
 
 #: JSON-line keys treated as secondary metrics worth trending alongside
 #: the headline value (shown when present; only ``value`` gates).
-AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac")
+#: ``grad_norm_final`` is the PR-7 numerics column: a round whose
+#: throughput held but whose final grad norm went to 0/NaN measured a
+#: run that trained garbage — visible here, next to the tokens/s.
+AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
+            "grad_norm_final")
 
 
 def _metric_lines(tail: str) -> List[Dict[str, Any]]:

@@ -16,6 +16,15 @@ drop into pdb at the first offender.  TPU-native equivalents:
   block producing non-finite values (the "which layer?" question the
   reference answers with its per-module hooks).
 - :func:`check_model_params` (debug_nan.py:55-60) — param-tree scan.
+
+Every detection lands on the structured obs event timeline (registered
+``EVENT_KINDS`` entries, covered by the repo-lint event-kind pass) instead
+of evaporating on stderr: ``nan_guard`` trips emit ``nan_watchdog`` (with
+the offending leaf count), ``find_nan_block`` emits ``nan_block_located``
+naming the first bad block, and ``check_tensors(emit=True)`` reports its
+host-side findings as ``nan_watchdog`` too — so "when did the numerics
+die, and where" is answerable from the RUNREPORT timeline alongside the
+``numerics_alert`` threshold events (obs/numerics.py).
 """
 
 from __future__ import annotations
@@ -39,11 +48,19 @@ def enable_nan_debug(enable: bool = True) -> None:
 from ..utils.tree import key_str as _key_str
 
 
-def check_tensors(tree: PyTree, name: str = "tensors", raise_on_bad: bool = False) -> List[str]:
+def check_tensors(
+    tree: PyTree,
+    name: str = "tensors",
+    raise_on_bad: bool = False,
+    emit: bool = False,
+) -> List[str]:
     """Scan a (host or device) pytree; return key-paths of non-finite leaves.
 
     Analogue of ``check_tensors`` (debug_nan.py:3-21) minus the pdb drop —
-    pass ``raise_on_bad=True`` to fail fast instead.
+    pass ``raise_on_bad=True`` to fail fast instead.  ``emit=True``
+    additionally lands the finding on the obs event timeline as a
+    ``nan_watchdog`` record (source ``check_tensors``) so ad-hoc host-side
+    scans show up next to the in-jit guard trips.
     """
     bad: List[str] = []
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
@@ -52,6 +69,11 @@ def check_tensors(tree: PyTree, name: str = "tensors", raise_on_bad: bool = Fals
             n_nan = int(np.isnan(arr).sum())
             n_inf = int(np.isinf(arr).sum())
             bad.append(f"{name}/{_key_str(path)} (nan={n_nan}, inf={n_inf})")
+    if bad and emit:
+        from ..obs.events import emit_event
+
+        emit_event("nan_watchdog", fn=name, source="check_tensors",
+                   bad_paths=bad[:8], n_bad=len(bad))
     if bad and raise_on_bad:
         raise FloatingPointError(f"non-finite values in {name}: {bad}")
     return bad
@@ -84,13 +106,16 @@ def nan_guard(fn: Callable = None, *, name: Optional[str] = None) -> Callable:
             flags = leaf_flags(out)
             if flags:
                 def report(*host_flags):
-                    if any(bool(h) for h in host_flags):
+                    n_bad = sum(1 for h in host_flags if bool(h))
+                    if n_bad:
                         try:
                             # land the trip on the run timeline before the
                             # raise unwinds the step (obs event, not print)
                             from ..obs.events import emit_event
 
-                            emit_event("nan_watchdog", fn=label)
+                            emit_event("nan_watchdog", fn=label,
+                                       source="nan_guard", n_bad=n_bad,
+                                       n_leaves=len(host_flags))
                         except Exception:
                             pass
                         raise FloatingPointError(
@@ -112,9 +137,18 @@ def find_nan_block(
 ) -> Tuple[Optional[str], PyTree]:
     """Run ``[(name, fn), ...]`` sequentially; return (first offending block
     name or None, last output).  The "walk the model, stop at the first bad
-    layer" workflow of the reference's hooks, for block-decomposed models."""
-    for name, fn in blocks:
+    layer" workflow of the reference's hooks, for block-decomposed models.
+
+    A hit emits ``nan_block_located`` on the obs timeline — the answer to
+    "which layer?" becomes a structured record (block name, index, bad
+    leaf paths) instead of a return value someone has to print."""
+    for i, (name, fn) in enumerate(blocks):
         x = fn(x)
-        if check_tensors(x, name=name):
+        bad = check_tensors(x, name=name)
+        if bad:
+            from ..obs.events import emit_event
+
+            emit_event("nan_block_located", block=name, index=i,
+                       bad_paths=bad[:8], n_bad=len(bad))
             return name, x
     return None, x
