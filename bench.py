@@ -191,12 +191,25 @@ def _measure() -> None:
         from torchdistpackage_tpu.dist import overlap as _overlap
 
         _overlap.configure(preset="auto")
+    # --grad-compress {off,int8,auto}: run the step through a DataParallel
+    # mesh so the grad reduction is an explicit, ledgered collective (the
+    # A/B's comm_bytes_per_dim delta is the headline).  On an explicit
+    # JAX_PLATFORMS=cpu run there is only one device and no collective to
+    # measure — bootstrap the 8-device sim (must precede backend init).
+    gc = _flag_value(sys.argv, "--grad-compress")
+    if gc not in (None, "off", "int8", "auto"):
+        raise SystemExit(
+            f"--grad-compress must be 'off', 'int8' or 'auto', got {gc!r}")
+    if gc and os.environ.get("JAX_PLATFORMS") == "cpu":
+        from torchdistpackage_tpu.dist.overlap import cpu_sim
+
+        cpu_sim(8)
     import jax.numpy as jnp
 
     main(jax, jnp, ab="--ab" in sys.argv, only=_only_index(sys.argv),
          big="--big" in sys.argv, long="--long" in sys.argv,
          moe="--moe" in sys.argv, trace=_flag_value(sys.argv, "--trace"),
-         overlap=ov)
+         overlap=ov, grad_compress=gc)
 
 
 def _load_baselines(path: str) -> dict:
@@ -287,7 +300,7 @@ def _last_good_accel_line(baselines: dict, reason: str = "unreachable"):
 
 
 def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None,
-                trace=None):
+                trace=None, grad_compress=None):
     """One timed measurement; returns (tokens_per_sec_chip, global_batch,
     flops_per_token, xla_flops_per_token, comm_ledger, mem).
 
@@ -380,13 +393,29 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
     # params+moments — a pure lifetime annotation, no semantic change
     from torchdistpackage_tpu.obs.numerics import global_grad_norm
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        # numerics evidence rides in the same program: one extra scalar
-        gnorm = global_grad_norm(grads)
-        updates, state = opt.update(grads, state, params)
-        return jax.tree.map(jnp.add, params, updates), state, loss, gnorm
+    if grad_compress is not None:
+        # --grad-compress arm: the step runs through DataParallel so the
+        # grad reduction is an EXPLICIT shard_map collective the ledger
+        # can attribute (the plain-jit replicated step has no dp
+        # collective to compress).  'off' takes the identical DP path
+        # with the exact pmean — the paired baseline.  compress_min_size
+        # is lowered so the tiny CPU-sim config's leaves qualify.
+        from torchdistpackage_tpu.parallel.data_parallel import DataParallel
+
+        dp = DataParallel(
+            mesh=mesh,
+            grad_compress=None if grad_compress == "off" else grad_compress,
+            compress_min_size=4096,
+        )
+        step = dp.make_train_step(loss_fn, opt, numerics=True)
+    else:
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            # numerics evidence rides in the same program: one extra scalar
+            gnorm = global_grad_norm(grads)
+            updates, state = opt.update(grads, state, params)
+            return jax.tree.map(jnp.add, params, updates), state, loss, gnorm
 
     k1, k2 = jax.random.split(jax.random.PRNGKey(1))
     global_batch = batch_size * n_chips
@@ -442,7 +471,10 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
         params, state, loss, gnorm = run_step(params, state, batch)
     float(loss)
     dt = time.perf_counter() - t0
-    grad_norm_final = float(gnorm)
+    # the DP (--grad-compress) step returns the fused numerics-stats dict
+    # in the gnorm slot; the plain step returns the bare scalar
+    grad_norm_final = float(
+        gnorm["grad_norm"] if isinstance(gnorm, dict) else gnorm)
 
     if trace:
         # opt-in Perfetto host trace of the SAME step: a short
@@ -491,7 +523,7 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
 
 def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
          long: bool = False, moe: bool = False, trace=None,
-         overlap=None) -> None:
+         overlap=None, grad_compress=None) -> None:
     from torchdistpackage_tpu.models import GPTConfig
 
     # Backend probe with CPU fallback: an accelerator backend that errors at
@@ -576,7 +608,7 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
         )
         tps, global_batch, fpt, fpt_xla, ledger, mem = _run_config(
             jax, jnp, run_cfg, batch_size, steps, warmup, remat,
-            xent_chunk=xent_chunk, trace=trace)
+            xent_chunk=xent_chunk, trace=trace, grad_compress=grad_compress)
         # remat: False | True | 'flash' | 'flash_offload' (save the flash
         # kernel's residuals — in HBM or pinned_host — so the backward skips
         # the Pallas fwd re-run; scan_blocks docstring)
@@ -589,12 +621,15 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
             f"{f' {dispatch}' if dispatch else ''}"
         )
         metric = f"gpt-{size_tag}-train-throughput"
-        # --overlap A/B pairing: the on and off runs are DIFFERENT configs
-        # for baseline recording (a flag change must not overwrite the
-        # other's first-measurement record) but share config_hash — the
-        # join key that pairs the two JSON rows of one A/B.
-        config_str = (
-            f"{base_config_str} ov-{overlap}" if overlap else base_config_str)
+        # --overlap / --grad-compress A/B pairing: each arm is a DIFFERENT
+        # config for baseline recording (a flag change must not overwrite
+        # the other's first-measurement record) but the arms share
+        # config_hash — the join key that pairs the JSON rows of one A/B.
+        config_str = base_config_str
+        if overlap:
+            config_str = f"{config_str} ov-{overlap}"
+        if grad_compress:
+            config_str = f"{config_str} gc-{grad_compress}"
         _record_baseline(baselines, baseline_path, backend, config_str, tps,
                          chip=chip, metric=metric)
         best = _best_recorded(baselines, backend, tps, metric=metric)
@@ -607,12 +642,15 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
             "chip": chip,
             "backend": backend,
         }
-        if overlap:
+        if overlap or grad_compress:
             import hashlib
 
-            line["overlap"] = overlap
             line["config_hash"] = hashlib.sha1(
                 f"{metric}|{base_config_str}".encode()).hexdigest()[:12]
+        if grad_compress:
+            line["grad_compress"] = grad_compress
+        if overlap:
+            line["overlap"] = overlap
             try:
                 from torchdistpackage_tpu.dist.overlap import active
 
@@ -889,6 +927,12 @@ if __name__ == "__main__":
         # forward the overlap A/B arm to the measurement children (the
         # child applies/validates the XLA preset before backend init)
         long_flag = (*long_flag, "--overlap", _ov)
+    _gc = _flag_value(sys.argv, "--grad-compress")
+    if _gc:
+        # forward the grad-compression arm (the child routes the step
+        # through DataParallel(grad_compress=...) so the reduction is a
+        # ledgered collective)
+        long_flag = (*long_flag, "--grad-compress", _gc)
     if on_cpu:
         ok = _run_child({}, cpu_timeout, long_flag)
     else:

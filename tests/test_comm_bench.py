@@ -14,6 +14,44 @@ def test_bench_all_ops(devices8):
         assert row["axis_size"] == 4
 
 
+def test_int8_ring_arms_flow_through_schema(devices8):
+    """The compressed-collective bench arms (PR 8): same harness, same
+    obs comm-record schema, plus the compressed/base_op/elem_bytes fields
+    CommModel.calibrate's compressed fit keys on."""
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    rows = sweep_collectives(
+        "data", sizes=(1 << 14,),
+        ops=("int8_all_reduce", "int8_reduce_scatter", "int8_all_gather"),
+        verbose=False)
+    assert len(rows) == 3
+    for row in rows:
+        assert row["schema"] == "tdp-comm-record/v1"
+        assert row["compressed"] is True
+        assert row["base_op"] in ("all_reduce", "reduce_scatter", "all_gather")
+        assert row["elem_bytes"] == 2  # bf16 default payload dtype
+        assert row["time_s"] > 0 and row["busbw_GBps"] > 0
+
+
+def test_calibrate_fits_compressed_busbw(devices8):
+    """CommModel.calibrate(compressed_ops=...) fits a separate per-axis
+    alpha/beta from the int8 arms' measurements, and predict_compressed
+    then scores on the 'calibrated-int8' basis."""
+    from torchdistpackage_tpu.obs import CommModel
+
+    tpc.setup_process_groups([("data", 8)], devices=devices8)
+    model = CommModel.calibrate(
+        axes=("data",), sizes=(1 << 14,), ops=("all_reduce", "ppermute"),
+        iters=2, warmup=1,
+        compressed_ops=("int8_all_reduce", "int8_reduce_scatter"))
+    qc = model.compressed_axis_costs["data"]
+    assert qc.kind == "calibrated-int8"
+    assert qc.alpha_s >= 0 and qc.beta_Bps > 0
+    pred = model.predict_compressed("reduce_scatter", 1 << 16, 8,
+                                    axes=("data",))
+    assert pred["basis"] == "calibrated-int8"
+    assert pred["compressed_s"] > 0
+
+
 def test_busbw_factors(devices8):
     tpc.setup_process_groups([("data", 8)], devices=devices8)
     r = bench_collective("all_reduce", "data", nbytes=1 << 16, iters=2)

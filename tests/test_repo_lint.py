@@ -319,6 +319,20 @@ def test_numerics_event_kinds_registered_and_emitted():
     assert {"nan_block_located", "nan_watchdog"} <= nan_kinds, nan_kinds
 
 
+def test_compress_policy_event_kind_registered_and_emitted():
+    """The quantized-collectives kind (PR 8) is in the registry AND
+    emitted where the auto policy lives: ``compress_policy`` fires from
+    both ``DataParallel`` and ``ZeroOptimizer`` when
+    ``grad_compress='auto'`` builds a step (the RUNREPORT ``compression``
+    section reads the records — obs.comm_model.compression_report)."""
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+
+    assert "compress_policy" in EVENT_KINDS
+    for rel in ("parallel/data_parallel.py", "parallel/zero.py"):
+        kinds = {k for _, k in _emit_call_kinds(PKG / rel)}
+        assert "compress_policy" in kinds, (rel, kinds)
+
+
 def test_event_kind_pass_covers_serving():
     """The serving package (PR 5) is inside the AST pass's scan set: its
     lifecycle kinds are emitted nowhere else, so a scan that missed

@@ -231,6 +231,20 @@ def test_bench_trend_regression_detection_and_numerics_columns(tmp_path):
     assert main(["--dir", str(tmp_path)]) == 1
 
 
+def test_bench_trend_comm_bytes_column():
+    """The PR-8 wire-bytes column: a line carrying ``comm_bytes_per_dim``
+    renders its TOTAL in the aux trail, so a compressed collective
+    silently re-inflating shows up in the trend."""
+    from torchdistpackage_tpu.tools.bench_trend import AUX_KEYS, trend
+
+    assert "comm_bytes_per_dim" in AUX_KEYS
+    line = {"metric": "m", "value": 100.0, "unit": "tok/s",
+            "comm_bytes_per_dim": {"dp": 1_000_000, "tp": 500_000},
+            "config": "c"}
+    report, _ = trend([(1, [line])], threshold=0.05)
+    assert any("comm_bytes=1,500,000" in ln for ln in report)
+
+
 # ------------------------------------------------------------- surgery/int8
 
 

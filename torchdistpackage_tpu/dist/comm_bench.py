@@ -23,6 +23,16 @@ HLO ledger aggregates and the alpha-beta model calibrates against
 (``obs.comm_model.CommModel.calibrate``), so measurement, calibration, and
 reporting round-trip through one schema.  ``test_collection`` can stream
 them to any obs sink (``JsonlSink`` et al.) instead of ad-hoc dicts.
+
+Int8-ring arms (PR 8): ``int8_all_reduce`` / ``int8_reduce_scatter`` /
+``int8_all_gather`` time the quantized rings of ``dist/compressed.py``
+through the same harness.  Their records keep ``bytes`` at the ORIGINAL
+payload (directly comparable to the exact arm's row; effective busbw
+above the link rate IS the compression win) and add ``compressed`` /
+``base_op`` / ``elem_bytes`` — the fields
+``CommModel.calibrate(compressed_ops=...)`` uses to refit alpha/beta
+against the compressed wire bytes, grounding
+``predict_compressed`` in measurement (quant FLOPs included).
 """
 
 from __future__ import annotations
@@ -45,6 +55,14 @@ _BUSBW_FACTOR = {
     "reduce_scatter": lambda n: (n - 1) / n,
     "all_to_all": lambda n: (n - 1) / n,
     "ppermute": lambda n: 1.0,
+    # int8-ring arms (dist/compressed.py): busbw uses the base op's factor
+    # over the ORIGINAL payload — an EFFECTIVE bus bandwidth directly
+    # comparable to the exact arm's row (the wire moves ~4x fewer bytes,
+    # so effective busbw above the link rate is the compression win;
+    # CommModel.calibrate refits against the compressed wire bytes).
+    "int8_all_reduce": lambda n: 2 * (n - 1) / n,
+    "int8_reduce_scatter": lambda n: (n - 1) / n,
+    "int8_all_gather": lambda n: (n - 1) / n,
 }
 
 
@@ -104,6 +122,29 @@ def bench_collective(
         body = lambda x: jax.lax.ppermute(x, axis, perm)
         in_spec, out_spec = P(axis), P(axis)
         shape = (count,)
+    # --- int8-ring arms (dist/compressed.py): same harness, quantized
+    # wire.  bytes on the record stays the ORIGINAL payload (nccl-tests
+    # convention, comparable to the exact arm); calibration derives the
+    # compressed wire bytes from it (obs.comm_model.compressed_wire_bytes
+    # via the record's elem_bytes).
+    elif op == "int8_all_reduce":
+        from .compressed import int8_ring_pmean
+
+        body = lambda x: int8_ring_pmean(x, axis) * n  # sum, mirrors psum
+        in_spec, out_spec = P(), P()
+        shape = (count,)
+    elif op == "int8_reduce_scatter":
+        from .compressed import int8_ring_reduce_scatter
+
+        body = lambda x: int8_ring_reduce_scatter(x, axis, 0)
+        in_spec, out_spec = P(), P(axis)
+        shape = (count,)
+    elif op == "int8_all_gather":
+        from .compressed import int8_ring_all_gather
+
+        body = lambda x: int8_ring_all_gather(x, axis, 0)
+        in_spec, out_spec = P(axis), P(axis)
+        shape = (count,)
     else:
         raise ValueError(f"unknown collective {op!r}")
 
@@ -112,6 +153,10 @@ def bench_collective(
     t = _timeit(fn, x, warmup=warmup, iters=iters)
     size = x.size * elem
     algbw = size / t / 1e9
+    extra = (
+        {"compressed": True, "base_op": op[len("int8_"):], "elem_bytes": elem}
+        if op.startswith("int8_") else {}
+    )
     return comm_record(
         op=op,
         axis=axis,
@@ -120,6 +165,7 @@ def bench_collective(
         time_s=t,
         algbw_GBps=algbw,
         busbw_GBps=algbw * _BUSBW_FACTOR[op](n),
+        **extra,
     )
 
 

@@ -96,7 +96,15 @@ from .comm_ledger import (
     ledger_from_compiled,
     ledger_from_hlo,
 )
-from .comm_model import CommModel, comm_report, fit_alpha_beta
+from .comm_model import (
+    COMPRESSION_SCHEMA,
+    CommModel,
+    comm_report,
+    compressed_ledger_bytes,
+    compressed_wire_bytes,
+    compression_report,
+    fit_alpha_beta,
+)
 from .mem_ledger import (
     MEM_LEDGER_SCHEMA,
     MEM_VERDICTS,

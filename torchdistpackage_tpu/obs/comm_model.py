@@ -83,6 +83,83 @@ _HLO_OP = {
     "collective-permute": "ppermute",
 }
 
+# ------------------------------------------------- compressed-ring costing
+# The int8 ring collectives (dist/compressed.py) carry 1 byte/elem payload
+# plus one f32 scale per COMPRESS_GROUP elements.  obs is a leaf subsystem
+# (imports nothing from the package), so the group size is mirrored here;
+# tests/test_compression.py pins the two constants together.
+
+COMPRESS_GROUP = 256
+
+COMPRESSION_SCHEMA = "tdp-compression/v1"
+
+#: ops the int8 rings implement (model-op spelling)
+_COMPRESSIBLE_OPS = ("all_reduce", "reduce_scatter", "all_gather")
+
+#: comm_bench's int8 arm names -> the exact op each one replaces
+INT8_BENCH_OPS = {
+    "int8_all_reduce": "all_reduce",
+    "int8_reduce_scatter": "reduce_scatter",
+    "int8_all_gather": "all_gather",
+}
+
+
+def compressed_payload_bytes(
+    payload_bytes: float, elem_bytes: int = 4, group: int = COMPRESS_GROUP
+) -> float:
+    """Quantized logical payload: 1 byte/elem + the f32 scale sideband."""
+    elems = payload_bytes / max(1, elem_bytes)
+    return elems * (1.0 + 4.0 / group)
+
+
+def compressed_wire_bytes(
+    op: str, payload_bytes: float, n: int,
+    elem_bytes: int = 4, group: int = COMPRESS_GROUP,
+) -> float:
+    """Per-link bytes the int8 ring serializes for a full ``payload_bytes``
+    collective (the compressed analogue of :func:`wire_bytes`):
+
+    - ``reduce_scatter`` / ``all_gather`` — one ring pass: ``(n-1)/n``
+      of the quantized payload;
+    - ``all_reduce`` (the ``int8_ring_pmean`` decomposition) — ring pass
+      + invariance-typed int8 psum gather: ``3(n-1)/n`` (the psum leg is
+      an all-reduce of the quantized payload, ``2(n-1)/n``).
+    """
+    op = _HLO_OP.get(op, op)
+    if op not in _COMPRESSIBLE_OPS:
+        raise ValueError(f"no int8 ring for {op!r}")
+    if n <= 1:
+        return 0.0
+    q = compressed_payload_bytes(payload_bytes, elem_bytes, group)
+    factor = 3.0 if op == "all_reduce" else 1.0
+    return factor * q * (n - 1) / n
+
+
+def compressed_ledger_bytes(
+    op: str, payload_bytes: float, n: int,
+    elem_bytes: int = 4, group: int = COMPRESS_GROUP,
+) -> float:
+    """Bytes the HLO comm ledger counts for one int8 ring collective —
+    per-INSTRUCTION operand payloads of the unrolled rings (s8 chunks +
+    f32 scales), the apples-to-apples prediction for the ledger's
+    measured per-axis bytes (RUNREPORT ``compression`` section):
+
+    - ring pass: n-1 ppermutes of a 1/n quantized chunk = ``(n-1)/n * q``;
+    - ``all_reduce`` adds the masked psum of the full quantized payload
+      (counted once, by the ledger's payload convention) = ``+ q``.
+
+    The exact arm's ledger bytes are simply ``payload_bytes`` for all
+    three ops (all-gather: operand x group size = the full payload).
+    """
+    op = _HLO_OP.get(op, op)
+    if op not in _COMPRESSIBLE_OPS:
+        raise ValueError(f"no int8 ring for {op!r}")
+    if n <= 1:
+        return 0.0
+    q = compressed_payload_bytes(payload_bytes, elem_bytes, group)
+    extra = q if op == "all_reduce" else 0.0
+    return q * (n - 1) / n + extra
+
 
 def steps_for(op: str, n: int) -> int:
     return int(_STEPS[op](max(2, n))) if n > 1 else 0
@@ -164,11 +241,18 @@ class CommModel:
         default: Optional[AxisCost] = None,
         chip: str = "unknown",
         source: str = "table",
+        compressed_axis_costs: Optional[Dict[str, AxisCost]] = None,
     ) -> None:
         self.axis_costs = dict(axis_costs)
         self.default = default or AxisCost(1e-6, 100e9, "table")
         self.chip = chip
         self.source = source
+        #: per-axis alpha/beta fitted from the int8-ring bench arms
+        #: (``calibrate(compressed_ops=...)``) — the effective parameters
+        #: of the QUANTIZED rings, quant/dequant FLOPs folded into the
+        #: measured bandwidth.  Empty -> predictions fall back to the
+        #: exact-axis parameters (table optimism: same link, fewer bytes).
+        self.compressed_axis_costs = dict(compressed_axis_costs or {})
 
     # ------------------------------------------------------------- builders
 
@@ -218,6 +302,7 @@ class CommModel:
         ops: Sequence[str] = ("all_reduce", "all_gather", "ppermute"),
         iters: int = 5,
         warmup: int = 1,
+        compressed_ops: Sequence[str] = (),
     ) -> "CommModel":
         """Measure alpha/beta per mesh axis with ``bench_collective``.
 
@@ -225,6 +310,15 @@ class CommModel:
         sample; the per-axis fit is :func:`fit_alpha_beta`.  Axes of size 1
         are skipped (nothing to time).  This is a collective — call it on
         every process of a multi-host job.
+
+        ``compressed_ops``: additionally time the int8-ring arms (names
+        from :data:`INT8_BENCH_OPS`, e.g. ``("int8_all_reduce",
+        "int8_reduce_scatter")``) and fit a SEPARATE per-axis alpha/beta
+        against their *compressed* wire bytes — so
+        :meth:`predict_compressed` scores the quantized rings from
+        measurement (quant/dequant cost folded into the fitted busbw)
+        instead of assuming the exact link parameters at a quarter of the
+        bytes.
         """
         from ..dist.comm_bench import bench_collective
         from ..dist.topology import tpc
@@ -233,6 +327,7 @@ class CommModel:
             mesh = tpc.get_view()
         names = [str(a) for a in (axes if axes is not None else mesh.axis_names)]
         costs: Dict[str, AxisCost] = {}
+        q_costs: Dict[str, AxisCost] = {}
         for axis in names:
             n = int(mesh.shape[axis])
             if n <= 1:
@@ -251,6 +346,24 @@ class CommModel:
                     ))
             alpha, beta = fit_alpha_beta(samples)
             costs[axis] = AxisCost(alpha, beta, kind="calibrated")
+            q_samples: List[Tuple[float, float, float]] = []
+            for op in compressed_ops:
+                base = INT8_BENCH_OPS[op]
+                for nbytes in sizes:
+                    row = bench_collective(
+                        op, axis, nbytes=nbytes, mesh=mesh,
+                        warmup=warmup, iters=iters,
+                    )
+                    q_samples.append((
+                        float(steps_for(base, n)),
+                        compressed_wire_bytes(
+                            base, row["bytes"], n,
+                            elem_bytes=row.get("elem_bytes", 4)),
+                        row["time_s"],
+                    ))
+            if q_samples:
+                qa, qb = fit_alpha_beta(q_samples)
+                q_costs[axis] = AxisCost(qa, qb, kind="calibrated-int8")
         try:
             import jax
 
@@ -258,7 +371,8 @@ class CommModel:
         except Exception:
             chip = "unknown"
         default = next(iter(costs.values()), None)
-        return cls(costs, default=default, chip=chip, source="calibrated")
+        return cls(costs, default=default, chip=chip, source="calibrated",
+                   compressed_axis_costs=q_costs)
 
     # ------------------------------------------------------------ prediction
 
@@ -272,6 +386,80 @@ class CommModel:
             beta_Bps=min(c.beta_Bps for c in known),
             kind=known[0].kind,
         )
+
+    def _compressed_cost_for(self, axes: Sequence[str]) -> Tuple[AxisCost, str]:
+        """(link params for the int8 rings over ``axes``, basis tag).
+        Calibrated compressed parameters win; otherwise the exact-axis
+        parameters serve (same link, quarter the bytes — optimistic: the
+        quant FLOPs are then unmodeled, which is exactly what
+        ``calibrate(compressed_ops=...)`` exists to fix)."""
+        known = [self.compressed_axis_costs[a] for a in axes
+                 if a in self.compressed_axis_costs]
+        if known:
+            return AxisCost(
+                alpha_s=max(c.alpha_s for c in known),
+                beta_Bps=min(c.beta_Bps for c in known),
+                kind=known[0].kind,
+            ), "calibrated-int8"
+        return self._cost_for(axes), "exact-params"
+
+    def predict_compressed(
+        self,
+        op: str,
+        payload_bytes: float,
+        n: int,
+        axes: Sequence[str] = (),
+        elem_bytes: int = 4,
+        group: int = COMPRESS_GROUP,
+    ) -> Dict[str, Any]:
+        """Score the int8 ring against the exact collective for one
+        payload — the ``grad_compress='auto'`` decision primitive.
+
+        The quantized ring keeps the exact op's LATENCY term (same hop
+        count — requantization doesn't change the ring length) while the
+        bytes quarter (``compressed_wire_bytes``); quant/dequant FLOPs
+        don't shrink either, and enter the prediction only through
+        calibrated compressed parameters (:meth:`calibrate` with
+        ``compressed_ops``) — table-based predictions are optimistic for
+        latency-bound payloads, which is why callers keep a
+        ``min_size`` floor on top (``dist.compressed.auto_compress_policy``).
+
+        Returns ``{exact_s, compressed_s, speedup, compress,
+        wire_bytes_exact, wire_bytes_compressed, ledger_bytes_exact,
+        ledger_bytes_compressed, basis}``.
+        """
+        op = _HLO_OP.get(op, op)
+        if op not in _COMPRESSIBLE_OPS:
+            raise ValueError(
+                f"no int8 ring for {op!r}; compressible: {_COMPRESSIBLE_OPS}")
+        exact_s = self.predict(op, payload_bytes, n, axes=axes)
+        out: Dict[str, Any] = {
+            "op": op, "n": int(n), "axes": list(axes),
+            "payload_bytes": payload_bytes,
+            "exact_s": exact_s,
+            "wire_bytes_exact": wire_bytes(op, payload_bytes, n),
+            "ledger_bytes_exact": payload_bytes if n > 1 else 0.0,
+        }
+        if n <= 1:
+            out.update(compressed_s=0.0, wire_bytes_compressed=0.0,
+                       ledger_bytes_compressed=0.0, speedup=1.0,
+                       compress=False, basis="single-member axis")
+            return out
+        q_wire = compressed_wire_bytes(op, payload_bytes, n, elem_bytes, group)
+        c, basis = self._compressed_cost_for(axes)
+        t = steps_for(op, n) * c.alpha_s
+        if math.isfinite(c.beta_Bps) and c.beta_Bps > 0:
+            t += q_wire / c.beta_Bps
+        out.update(
+            compressed_s=t,
+            wire_bytes_compressed=q_wire,
+            ledger_bytes_compressed=compressed_ledger_bytes(
+                op, payload_bytes, n, elem_bytes, group),
+            speedup=(exact_s / t) if t > 0 else float("inf"),
+            compress=t < exact_s,
+            basis=basis,
+        )
+        return out
 
     def predict(
         self,
@@ -428,3 +616,61 @@ def comm_report(
         out["verdict"] = "unknown"
         out["verdict_basis"] = "no measured step time"
     return out
+
+
+def compression_report(
+    mode: str,
+    policy_events: Sequence[Dict[str, Any]] = (),
+    ledger: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """The RUNREPORT ``compression`` section: the compress-policy choices
+    next to predicted-vs-ledger-measured wire bytes per axis.
+
+    ``policy_events``: ``compress_policy`` event records (or bare
+    ``{leaves: [...]}`` dicts) as emitted by ``DataParallel`` /
+    ``ZeroOptimizer`` when ``grad_compress='auto'`` builds a step — each
+    leaf row carries its choice and both ledger-convention byte
+    predictions (``CommModel.predict_compressed``).  ``ledger``: the
+    compiled step's comm ledger; measured bytes aggregate its collectives
+    by the axis set they span.  The measured number covers the WHOLE
+    step's traffic on that axis (loss reductions, param gathers ride the
+    same axis), so ``rel_err`` is a reconciliation aid, not a bound —
+    ``Telemetry.record_compression`` attaches the section and
+    ``validate_runreport`` checks its structure."""
+    leaves: List[Dict[str, Any]] = []
+    for ev in policy_events:
+        leaves.extend(ev.get("leaves") or [])
+    predicted: Dict[str, float] = {}
+    for l in leaves:
+        key = "+".join(l.get("axes") or []) or "?"
+        b = (l["ledger_bytes_compressed"] if l.get("compress")
+             else l["ledger_bytes_exact"])
+        predicted[key] = predicted.get(key, 0.0) + float(b)
+    measured: Dict[str, int] = {}
+    for c in (ledger or {}).get("collectives", []):
+        key = "+".join(c.get("axes") or []) or "?"
+        measured[key] = measured.get(key, 0) + int(c.get("bytes", 0))
+    rows: List[Dict[str, Any]] = []
+    for key in sorted(set(predicted) | set(measured)):
+        pred = predicted.get(key)
+        meas = measured.get(key)
+        row: Dict[str, Any] = {"axes": key}
+        if pred is not None:
+            row["predicted_bytes"] = int(round(pred))
+        if meas is not None:
+            row["measured_bytes"] = meas
+        if pred and meas is not None:
+            row["rel_err"] = round((meas - pred) / pred, 4)
+        rows.append(row)
+    return {
+        "schema": COMPRESSION_SCHEMA,
+        "mode": str(mode),
+        "policy": {
+            "n_leaves": len(leaves),
+            "n_compressed": sum(1 for l in leaves if l.get("compress")),
+            # the artifact keeps a bounded table; full records live on the
+            # event timeline
+            "leaves": [dict(l) for l in leaves[:64]],
+        },
+        "per_axis": rows,
+    }

@@ -69,6 +69,17 @@ Numerics kinds (``obs.numerics`` + Telemetry, PR 7):
                     values (record carries the block name + bad paths)
 ==================  =====================================================
 
+Compression kinds (``dist/compressed.py`` + the parallel families, PR 8):
+
+==================  =====================================================
+``compress_policy`` ``grad_compress='auto'`` scored each grad leaf's
+                    collective through ``CommModel.predict_compressed``
+                    while building a train step; the record carries the
+                    per-leaf compress/exact choices with both predictions
+                    (the ``compression`` RUNREPORT section reads it —
+                    ``obs.comm_model.compression_report``)
+==================  =====================================================
+
 Serving kinds (``torchdistpackage_tpu.serving``, PR 5):
 
 ==================  =====================================================
@@ -117,6 +128,8 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     "mem_snapshot", "oom_risk",
     # numerics observability (PR 7)
     "numerics_alert", "nan_block_located",
+    # quantized collectives (PR 8)
+    "compress_policy",
 })
 
 

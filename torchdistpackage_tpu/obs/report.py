@@ -102,6 +102,38 @@ def validate_runreport(report: Any) -> List[str]:
         elif not isinstance(res.get("rollbacks"), int) or res["rollbacks"] < 0:
             errs.append("resilience.rollbacks missing/negative")
     errs.extend(_validate_serving(report.get("serving")))
+    errs.extend(_validate_compression(report.get("compression")))
+    return errs
+
+
+def _validate_compression(comp: Any) -> List[str]:
+    """The optional ``compression`` section (obs/comm_model.py
+    ``compression_report``): mode, per-leaf policy roll-up, and
+    predicted-vs-ledger-measured bytes per axis."""
+    if comp is None:
+        return []
+    if not isinstance(comp, dict):
+        return [f"compression is {type(comp).__name__}, expected dict"]
+    errs: List[str] = []
+    if not isinstance(comp.get("mode"), str) or not comp["mode"]:
+        errs.append("compression.mode missing")
+    pol = comp.get("policy")
+    if not isinstance(pol, dict) or not isinstance(
+            pol.get("n_leaves"), int) or not isinstance(
+            pol.get("n_compressed"), int):
+        errs.append("compression.policy lacks n_leaves/n_compressed")
+    rows = comp.get("per_axis")
+    if not isinstance(rows, list):
+        errs.append("compression.per_axis missing/non-list")
+        rows = []
+    for i, r in enumerate(rows):
+        if not isinstance(r, dict) or not r.get("axes"):
+            errs.append(f"compression.per_axis[{i}] lacks axes")
+            break
+        for k in ("predicted_bytes", "measured_bytes"):
+            v = r.get(k)
+            if v is not None and (not isinstance(v, (int, float)) or v < 0):
+                errs.append(f"compression.per_axis[{i}].{k} invalid")
     return errs
 
 
@@ -276,6 +308,12 @@ def render_summary_line(report: Dict[str, Any]) -> str:
         parts.append(
             f"RESILIENCE={res['verdict']}"
             f"(rollbacks {res.get('rollbacks', 0)})")
+    cmpx = report.get("compression")
+    if cmpx:
+        pol = cmpx.get("policy", {})
+        parts.append(
+            f"compress={cmpx.get('mode', '?')}"
+            f"({pol.get('n_compressed', 0)}/{pol.get('n_leaves', 0)} leaves)")
     srv = report.get("serving")
     if srv and isinstance(srv.get("tokens_per_sec"), (int, float)):
         tail = ""
@@ -493,6 +531,28 @@ def render_markdown(report: Dict[str, Any]) -> str:
                 f"| {dim} | {st['ops']} | {st['bytes']:,} | "
                 + (f"{t * 1e3:.3f} ms |" if isinstance(t, (int, float))
                    else "- |"))
+        L.append("")
+
+    cmpx = report.get("compression")
+    if cmpx:
+        L.append("## Compression")
+        L.append("")
+        pol = cmpx.get("policy", {})
+        L.append(f"- mode: **{cmpx.get('mode', '?')}** — "
+                 f"{pol.get('n_compressed', 0)}/{pol.get('n_leaves', 0)} "
+                 f"grad leaves on the int8 ring")
+        rows = cmpx.get("per_axis") or []
+        if rows:
+            L.append("")
+            L.append("| axes | predicted bytes | ledger-measured bytes |")
+            L.append("|---|---|---|")
+            for r in rows:
+                pred = r.get("predicted_bytes")
+                meas = r.get("measured_bytes")
+                L.append(
+                    f"| {r['axes']} | "
+                    + (f"{pred:,} | " if isinstance(pred, int) else "- | ")
+                    + (f"{meas:,} |" if isinstance(meas, int) else "- |"))
         L.append("")
 
     res = report.get("resilience")

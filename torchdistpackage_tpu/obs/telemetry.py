@@ -252,6 +252,7 @@ class Telemetry:
         self.numerics_timeline: List[Dict[str, Any]] = []
         self._alert_active: set = set()
         self.parity: Optional[Dict[str, Any]] = None
+        self.compression: Optional[Dict[str, Any]] = None
         self.xla_trace = xla_trace
         if event_log is None:
             event_log = EventLog()
@@ -559,6 +560,13 @@ class Telemetry:
         verdict; validated by ``validate_runreport``)."""
         self.parity = dict(section)
 
+    def record_compression(self, section: Dict[str, Any]) -> None:
+        """Attach an :func:`~.comm_model.compression_report` section as the
+        report's optional ``compression`` section (the quantized-collective
+        policy next to predicted-vs-ledger-measured wire bytes per axis;
+        validated by ``validate_runreport``)."""
+        self.compression = dict(section)
+
     def record_serving(self, summary: Dict[str, Any]) -> None:
         """Attach a ``ServingEngine.serving_summary()`` as the report's
         optional ``serving`` section (TTFT/TPOT percentiles, aggregate
@@ -715,6 +723,8 @@ class Telemetry:
             report["resilience"] = self.resilience
         if self.serving is not None:
             report["serving"] = self.serving
+        if self.compression is not None:
+            report["compression"] = self.compression
         if extra:
             report.update(extra)
         if self._is_master:

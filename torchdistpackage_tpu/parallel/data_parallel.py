@@ -137,6 +137,7 @@ def reduce_gradients(
     grad_reduce_overrides: Optional[Dict[str, Tuple[str, ...]]] = None,
     compress: Optional[str] = None,
     compress_min_size: int = 65536,
+    compress_policy: Optional[Dict[str, bool]] = None,
 ) -> PyTree:
     """Reduce a gradient pytree over the data axes (traced; call inside
     shard_map).  Analogue of ``NaiveDDP.reduce_gradients``
@@ -161,6 +162,12 @@ def reduce_gradients(
     bounded quantization noise; small leaves, sum-op axes and override
     leaves keep the exact reduction.  The ring is vma-legal
     (invariance-typed output), so compression composes with TP/PP meshes.
+
+    ``compress_policy``: per-leaf choices keyed by the '/'-joined leaf
+    path (``{name: bool}``) — when given it REPLACES the size threshold
+    (the ``grad_compress='auto'`` path: ``DataParallel`` derives the
+    policy from ``CommModel.predict_compressed`` per leaf and passes it
+    here; leaves absent from the dict stay exact).
 
     ``reduce_op`` may be a single op or a per-axis dict ``{axis: op}``
     (unlisted axes default to 'mean').  Per-axis 'sum' is for objectives
@@ -194,11 +201,14 @@ def reduce_gradients(
         if not matched:
             mean_axes = tuple(a for a in vaxes if op_of(a) == "mean")
             sum_axes = tuple(a for a in vaxes if op_of(a) == "sum")
-            if (
-                compress == "int8"
-                and mean_axes
-                and g.size >= compress_min_size
-            ):
+            use_ring = False
+            if compress in ("int8", "auto") and mean_axes:
+                use_ring = (
+                    bool(compress_policy.get(name, False))
+                    if compress_policy is not None
+                    else g.size >= compress_min_size
+                )
+            if use_ring:
                 from ..dist.compressed import int8_ring_pmean
 
                 for a in mean_axes:  # nested means == joint mean (equal sizes)
@@ -378,14 +388,18 @@ class DataParallel:
         grad_reduce_overrides: Optional[Dict[str, Tuple[str, ...]]] = None,
         grad_compress: Optional[str] = None,
         compress_min_size: int = 65536,
+        comm_model: Optional[Any] = None,
     ) -> None:
         self.mesh = mesh if mesh is not None else tpc.get_view()
         self.axis = axis
         _validate_reduce_op(reduce_op)
         self.reduce_op = reduce_op
         self.grad_reduce_overrides = dict(grad_reduce_overrides or {})
-        if grad_compress not in (None, "int8"):
-            raise ValueError(f"unknown grad_compress {grad_compress!r}")
+        if grad_compress not in (None, "int8", "auto"):
+            raise ValueError(
+                f"unknown grad_compress {grad_compress!r}; DataParallel "
+                f"supports None, 'int8' or 'auto' ('int8_ef' needs the "
+                f"persistent residual state only ZeroOptimizer carries)")
         data_axes = (axis,) if isinstance(axis, str) else tuple(axis)
         if grad_compress is not None and not any(
             _axis_op(reduce_op, a) == "mean" for a in data_axes
@@ -396,6 +410,10 @@ class DataParallel:
             )
         self.grad_compress = grad_compress
         self.compress_min_size = compress_min_size
+        # 'auto' scores each leaf's reduction through this model's
+        # predict_compressed (None -> the per-generation table model for
+        # the mesh); pass CommModel.calibrate(...) for measured decisions
+        self.comm_model = comm_model
 
     # ------------------------------------------------------------- placement
 
@@ -481,60 +499,106 @@ class DataParallel:
         if accum_reduce not in ("final", "microbatch"):
             raise ValueError(
                 f"accum_reduce must be 'final' or 'microbatch', got {accum_reduce!r}")
+        # grad_compress x accum_reduce='microbatch' is SUPPORTED (validated
+        # here on purpose — the combination used to ride through
+        # unexamined): the quantized ring replaces the per-microbatch
+        # pmean inside the accumulation scan, and averaging the
+        # per-microbatch quantized means is the same estimator at the same
+        # noise bound (quantization error averages like the grads do;
+        # parity-tested in tests/test_compression.py).
         mesh = self.mesh
         axis = self.axis
         data_axes = (axis,) if isinstance(axis, str) else tuple(axis)
 
-        def reduce_fn(grads):
-            return reduce_gradients(
-                grads, axis, self.reduce_op, self.grad_reduce_overrides,
-                compress=self.grad_compress,
-                compress_min_size=self.compress_min_size,
-            )
+        def make_reduce_fn(policy):
+            def reduce_fn(grads):
+                return reduce_gradients(
+                    grads, axis, self.reduce_op, self.grad_reduce_overrides,
+                    compress=self.grad_compress,
+                    compress_min_size=self.compress_min_size,
+                    compress_policy=policy,
+                )
+            return reduce_fn
 
         in_scan = accum_reduce == "microbatch" and value_and_grad_fn is None
 
-        def step(params, opt_state, batch):
-            # Keep grads local over the data axes (one explicit reduce below).
-            p_local = pvary_params(params, data_axes)
-            if value_and_grad_fn is not None:
-                loss, grads = value_and_grad_fn(p_local, batch)
-            else:
-                loss, grads = local_value_and_grad(
-                    loss_fn, p_local, batch, grad_accum_iters,
-                    reduce_fn=reduce_fn if in_scan else None,
-                )
-            grads, other = normalize_model_axis_grads(loss, grads, mesh, data_axes)
-            # grad_compress='int8' swaps the large-leaf pmean for the
-            # quantized ring — vma-legal (see dist/compressed.py), so the
-            # SAME step body serves pure-DP and TP/PP-composed meshes.
-            # (normalize after an in-scan reduce is exact: it only scales.)
-            if not in_scan:
-                grads = reduce_fn(grads)
-            if other:
-                loss = jax.lax.pmean(loss, other)
-            dax = _vaxes(loss, data_axes)
-            if dax:
-                loss = _reduce_loss(loss, dax, self.reduce_op)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            if numerics:
-                # monitoring rides in the SAME compiled program as
-                # training: norms over the reduced grads, the pre-update
-                # params and the optimizer updates (update_ratio =
-                # |update|/|param|), sharing the clip reduction
-                from ..obs.numerics import numerics_stats
+        def make_step(policy):
+            reduce_fn = make_reduce_fn(policy)
 
-                nstats = numerics_stats(grads, params=params, updates=updates)
-            params = jax.tree.map(jnp.add, params, updates)
-            if numerics:
-                return params, opt_state, loss, nstats
-            return params, opt_state, loss
+            def step(params, opt_state, batch):
+                # Keep grads local over the data axes (one explicit reduce
+                # below).
+                p_local = pvary_params(params, data_axes)
+                if value_and_grad_fn is not None:
+                    loss, grads = value_and_grad_fn(p_local, batch)
+                else:
+                    loss, grads = local_value_and_grad(
+                        loss_fn, p_local, batch, grad_accum_iters,
+                        reduce_fn=reduce_fn if in_scan else None,
+                    )
+                grads, other = normalize_model_axis_grads(
+                    loss, grads, mesh, data_axes)
+                # grad_compress='int8'/'auto' swaps the chosen leaves' pmean
+                # for the quantized ring — vma-legal (see dist/compressed.py),
+                # so the SAME step body serves pure-DP and TP/PP-composed
+                # meshes.  (normalize after an in-scan reduce is exact: it
+                # only scales.)
+                if not in_scan:
+                    grads = reduce_fn(grads)
+                if other:
+                    loss = jax.lax.pmean(loss, other)
+                dax = _vaxes(loss, data_axes)
+                if dax:
+                    loss = _reduce_loss(loss, dax, self.reduce_op)
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                if numerics:
+                    # monitoring rides in the SAME compiled program as
+                    # training: norms over the reduced grads, the pre-update
+                    # params and the optimizer updates (update_ratio =
+                    # |update|/|param|), sharing the clip reduction
+                    from ..obs.numerics import numerics_stats
+
+                    nstats = numerics_stats(
+                        grads, params=params, updates=updates)
+                params = jax.tree.map(jnp.add, params, updates)
+                if numerics:
+                    return params, opt_state, loss, nstats
+                return params, opt_state, loss
+
+            return step
+
+        def policy_for(params):
+            """The 'auto' per-leaf compress/exact choices — decided on the
+            HOST from static leaf shapes via CommModel.predict_compressed,
+            recorded as a structured ``compress_policy`` event (once per
+            compiled signature)."""
+            if self.grad_compress != "auto":
+                return None
+            from ..dist.compressed import auto_compress_policy
+            from ..obs.events import emit_event
+
+            mean_axes = tuple(
+                a for a in data_axes if _axis_op(self.reduce_op, a) == "mean")
+            leaves = [
+                (_key_str(path), jnp.shape(x), jnp.dtype(x.dtype).itemsize)
+                for path, x in jax.tree_util.tree_flatten_with_path(params)[0]
+            ]
+            policy, records = auto_compress_policy(
+                leaves, "all_reduce", mean_axes, mesh,
+                model=self.comm_model, min_size=self.compress_min_size)
+            emit_event(
+                "compress_policy", family="data_parallel", mode="auto",
+                op="all_reduce", axes=list(mean_axes),
+                n_leaves=len(records),
+                n_compressed=sum(1 for r in records if r["compress"]),
+                leaves=records)
+            return policy
 
         # The shard_map specs depend on the pytree structure of the arguments,
         # which we only see at first call — build and cache the jitted fn then.
         cache = {}
 
-        def jitted(params, opt_state, batch):
+        def jit_for(params, opt_state, batch):
             key = step_cache_key(params, opt_state, batch)
             if key not in cache:
                 def spec_of(x):
@@ -563,12 +627,19 @@ class DataParallel:
                     (in_param_specs, opt_specs, P(), P()) if numerics
                     else (in_param_specs, opt_specs, P()))
                 sm = shard_map(
-                    step,
+                    make_step(policy_for(params)),
                     mesh=mesh,
                     in_specs=(in_param_specs, opt_specs, in_batch_specs),
                     out_specs=out_specs,
                 )
                 cache[key] = jax.jit(sm, donate_argnums=(0, 1) if donate else ())
-            return cache[key](params, opt_state, batch)
+            return cache[key]
 
+        def jitted(params, opt_state, batch):
+            return jit_for(params, opt_state, batch)(params, opt_state, batch)
+
+        # AOT hook: callers that need the compiled executable's artifacts
+        # (Telemetry's ledgers, bench.py's cost analysis) lower through the
+        # same cache — `hasattr(step, "lower")` is the Telemetry contract.
+        jitted.lower = lambda p, s, b: jit_for(p, s, b).lower(p, s, b)
         return jitted

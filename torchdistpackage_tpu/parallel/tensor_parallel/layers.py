@@ -128,6 +128,18 @@ class TransformerConfig:
     # off, or when the TP axis has size 1.
     collective_matmul: bool = False
     cm_min_bytes: int = 1 << 20
+    # Quantized SP boundaries (opt-in, SP mode only): the block-boundary
+    # activation all-gather AND the row-parallel close's reduce-scatter
+    # ride the int8 rings (dist/compressed.py — 1 byte/elem + ~1.5% scale
+    # sideband on the wire vs 4 for f32; the rings' custom VJPs quantize
+    # the matching backward collectives too).  Falls back to the exact
+    # collective when the gathered activation is smaller than
+    # ``compress_min_bytes`` (scale sideband + ring latency dominate tiny
+    # payloads), when sp is off, or when the TP axis has size 1.
+    # Orthogonal to ``collective_matmul``: where the cm ring applies it
+    # wins (the decomposed boundary has no fused collective to quantize).
+    ag_compress: "str | None" = None
+    compress_min_bytes: int = 1 << 16
 
     def __post_init__(self):
         if self.sliding_window is not None:
@@ -140,6 +152,9 @@ class TransformerConfig:
             if self.sliding_window < 1:
                 raise ValueError(
                     f"sliding_window must be >= 1, got {self.sliding_window}")
+        if self.ag_compress not in (None, "int8"):
+            raise ValueError(
+                f"ag_compress must be None or 'int8', got {self.ag_compress!r}")
         if self.norm not in ("layer", "rms"):
             raise ValueError(f"norm must be 'layer' or 'rms', got {self.norm!r}")
         if self.act not in ("gelu", "swiglu"):
@@ -548,13 +563,34 @@ def mlp_partial(p: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _close_row_parallel(
-    y: jnp.ndarray, bias: jnp.ndarray, axis: Optional[str], sp: bool
+    y: jnp.ndarray, bias: jnp.ndarray, axis: Optional[str], sp: bool,
+    compress: Optional[str] = None,
 ) -> jnp.ndarray:
     """Finish a row-parallel layer: reduce partial sums over TP (into SP
-    layout if requested) and add the output bias exactly once."""
+    layout if requested) and add the output bias exactly once.
+    ``compress='int8'`` quantizes the SP reduce-scatter's wire (the non-SP
+    psum stays exact — its invariance typing has no ring analogue
+    cheaper than the pmean decomposition, and activations in non-SP mode
+    are replicated anyway)."""
     if axis is not None:
-        y = scatter_to_sp(y, axis) if sp else reduce_from_tp(y, axis)
+        y = (scatter_to_sp(y, axis, compress=compress) if sp
+             else reduce_from_tp(y, axis))
     return y + bias
+
+
+def _sp_compress(cfg: TransformerConfig, x: jnp.ndarray,
+                 axis: Optional[str], sp: bool) -> Optional[str]:
+    """Static (trace-time) decision for a quantized SP boundary: 'int8'
+    when opted in, SP is on over a real TP axis, and the FULL (gathered)
+    activation clears ``compress_min_bytes`` — else None (exact
+    collective).  ``x`` is the boundary's sequence-sharded view."""
+    if cfg.ag_compress != "int8" or axis is None or not sp:
+        return None
+    n = axis_size(axis)
+    if n <= 1:
+        return None
+    full_bytes = x.size * n * jnp.dtype(x.dtype).itemsize
+    return "int8" if full_bytes >= cfg.compress_min_bytes else None
 
 
 # ------------------------------------------------- collective-matmul paths
@@ -811,24 +847,29 @@ def block_forward(
         k_attn, k_mlp = jax.random.split(dropout_key)
     use_cm = _use_cm(cfg, x, axis, sp)
     h = layer_norm(x, p["ln1"], cfg.norm_eps)
+    # quantized SP boundaries (cfg.ag_compress): the entering all-gather
+    # and the closing reduce-scatter carry int8 payloads; their custom
+    # VJPs quantize the backward's mirror collectives too
+    qc = _sp_compress(cfg, h, axis, sp)
     if use_cm:
         # ring path: gather⊕QKV-matmul and WO-matmul⊕scatter decomposed;
         # the ring already reduced over TP, so only the bias remains
         y = attention_partial_cm(p["attn"], h, cfg, axis, rope=rope)
         y = y + p["attn"]["bo"]
     else:
-        full = gather_from_sp(h, axis) if (axis and sp) else h
+        full = gather_from_sp(h, axis, compress=qc) if (axis and sp) else h
         y = attention_partial(p["attn"], full, cfg, rope=rope)
-        y = _close_row_parallel(y, p["attn"]["bo"], axis, sp)
+        y = _close_row_parallel(y, p["attn"]["bo"], axis, sp, compress=qc)
     x = x + dropout(y, cfg.dropout_rate, k_attn)
 
     h = layer_norm(x, p["ln2"], cfg.norm_eps)
+    qc = _sp_compress(cfg, h, axis, sp)
     if use_cm:
         z = mlp_partial_cm(p["mlp"], h, axis) + p["mlp"]["b2"]
     else:
-        full = gather_from_sp(h, axis) if (axis and sp) else h
+        full = gather_from_sp(h, axis, compress=qc) if (axis and sp) else h
         z = mlp_partial(p["mlp"], full)
-        z = _close_row_parallel(z, p["mlp"]["b2"], axis, sp)
+        z = _close_row_parallel(z, p["mlp"]["b2"], axis, sp, compress=qc)
     return x + dropout(z, cfg.dropout_rate, k_mlp)
 
 

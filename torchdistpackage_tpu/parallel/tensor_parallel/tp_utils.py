@@ -63,15 +63,40 @@ def reduce_from_tp(x: jnp.ndarray, axis: Optional[str] = None) -> jnp.ndarray:
     return jax.lax.psum(x, axis or _TP_AXIS)
 
 
-def gather_from_sp(x: jnp.ndarray, axis: Optional[str] = None, seq_dim: int = 1) -> jnp.ndarray:
+def gather_from_sp(
+    x: jnp.ndarray, axis: Optional[str] = None, seq_dim: int = 1,
+    compress: Optional[str] = None,
+) -> jnp.ndarray:
     """SP -> full: fwd all-gather along the sequence dim, bwd reduce-scatter
-    (`_GatherFromSequenceParallelRegion`, tp_utils.py:126-149)."""
+    (`_GatherFromSequenceParallelRegion`, tp_utils.py:126-149).
+
+    ``compress='int8'``: the gather rides the quantized ring
+    (``dist.compressed.int8_ring_all_gather`` — 1 int8 byte/elem + scale
+    sideband on the wire), and its custom VJP makes the backward's
+    activation-grad reduce-scatter ride the int8 wire too.  Opt in via
+    ``TransformerConfig(ag_compress='int8')`` (layers.py decides per
+    boundary against ``compress_min_bytes``)."""
+    if compress == "int8":
+        from ...dist.compressed import int8_ring_all_gather
+
+        return int8_ring_all_gather(x, axis or _TP_AXIS, seq_dim)
     return jax.lax.all_gather(x, axis or _TP_AXIS, axis=seq_dim, tiled=True)
 
 
-def scatter_to_sp(x: jnp.ndarray, axis: Optional[str] = None, seq_dim: int = 1) -> jnp.ndarray:
+def scatter_to_sp(
+    x: jnp.ndarray, axis: Optional[str] = None, seq_dim: int = 1,
+    compress: Optional[str] = None,
+) -> jnp.ndarray:
     """Full -> SP: fwd reduce-scatter along the sequence dim, bwd all-gather
-    (`_ReduceScatterToSequenceParallelRegion`, tp_utils.py:110-123)."""
+    (`_ReduceScatterToSequenceParallelRegion`, tp_utils.py:110-123).
+
+    ``compress='int8'``: the row-parallel partial sums reduce through the
+    quantized ring (``dist.compressed.int8_ring_reduce_scatter``), with the
+    backward's all-gather quantized via the custom VJP."""
+    if compress == "int8":
+        from ...dist.compressed import int8_ring_reduce_scatter
+
+        return int8_ring_reduce_scatter(x, axis or _TP_AXIS, seq_dim)
     return jax.lax.psum_scatter(x, axis or _TP_AXIS, scatter_dimension=seq_dim, tiled=True)
 
 

@@ -112,6 +112,8 @@ class ZeroOptimizer:
         grad_reduce_overrides: Optional[dict] = None,
         grad_compress: Optional[str] = None,
         compress_min_size: int = 65536,
+        comm_model: Optional[Any] = None,
+        gather_compress: Union[str, None] = "follow",
     ) -> None:
         self.inner = inner
         self.mesh = mesh if mesh is not None else tpc.get_view()
@@ -152,10 +154,33 @@ class ZeroOptimizer:
         # cross-node psum over the remaining grad_reduce_axes rides the int8
         # ring too) on leaves >= compress_min_size elements.  Small and
         # override (MoE expert) leaves keep the exact path.
-        if grad_compress not in (None, "int8"):
-            raise ValueError(f"unknown grad_compress {grad_compress!r}")
+        # 'int8_ef' additionally carries a per-leaf error-feedback residual
+        # in the optimizer state (state['ef']): each step compresses
+        # grad + residual and persists the quantization error, so the lossy
+        # reduction's bias cancels over steps (dist.compressed.ef_compress).
+        # 'auto' decides per leaf from CommModel.predict_compressed and
+        # records a compress_policy event at step build.
+        if grad_compress not in (None, "int8", "int8_ef", "auto"):
+            raise ValueError(
+                f"unknown grad_compress {grad_compress!r}; ZeroOptimizer "
+                f"supports None, 'int8', 'int8_ef' or 'auto'")
         self.grad_compress = grad_compress
         self.compress_min_size = compress_min_size
+        self.comm_model = comm_model
+        # The updated masters travel BACK as a param all-gather every step
+        # (the regroup below) — as many bytes as the grad reduction itself,
+        # so compression that stops at grads caps out around 1.6x on the
+        # axis.  'follow' (default) re-gathers the COMPRESSED leaves through
+        # the invariance-typed int8 masked-psum gather
+        # (dist.compressed.int8_psum_all_gather) whenever grad_compress is
+        # active: the wire carries quantized params, masters stay full
+        # precision (noise does not accumulate — QAT-style), and the parity
+        # harness bounds the drift.  Pass None to keep the exact bf16/f32
+        # re-gather.
+        if gather_compress not in (None, "int8", "follow"):
+            raise ValueError(
+                f"unknown gather_compress {gather_compress!r}")
+        self.gather_compress = gather_compress
 
     # ----------------------------------------------------------------- specs
 
@@ -216,10 +241,24 @@ class ZeroOptimizer:
 
         return jax.tree.map(put, params, p_specs)
 
+    def _ef_specs(self, p_specs: PyTree) -> PyTree:
+        """Specs for the error-feedback residuals: per-DEVICE-of-the-data-
+        group values of the leaf's LOCAL (TP-sharded) shape — stored with a
+        leading dim of the data-group size sharded over
+        ``grad_reduce_axes`` (local view: ``[1, *local_leaf]``)."""
+        axes = tuple(self.grad_reduce_axes)
+        return jax.tree.map(
+            lambda s: P(axes, *tuple(s)), p_specs,
+            is_leaf=lambda x: isinstance(x, P))
+
     def init(self, params: PyTree) -> PyTree:
         """Create sharded fp32 masters + inner optimizer state
-        (zero_optim.py:159-174 analogue, sharded by construction)."""
-        _, zero_specs, _ = self._specs_for(params)
+        (zero_optim.py:159-174 analogue, sharded by construction).  With
+        ``grad_compress='int8_ef'`` the state additionally carries ``ef``
+        — one zero-initialized f32 residual per leaf (full leaf shape per
+        data-group member; the input-side error-feedback memory
+        :meth:`reduce_grads_to_shard` updates every step)."""
+        p_specs, zero_specs, _ = self._specs_for(params)
         mdt = self.master_dtype
 
         master = jax.jit(
@@ -237,11 +276,68 @@ class ZeroOptimizer:
                 out_specs=self._state_specs_from(params, zero_specs),
             )
         )(master)
-        return {"master": master, "inner": inner_state}
+        state = {"master": master, "inner": inner_state}
+        if self.grad_compress == "int8_ef":
+            ndev = 1
+            for a in self.grad_reduce_axes:
+                ndev *= int(self.mesh.shape[a])
+            ef = jax.tree.map(
+                lambda x, s: jax.device_put(
+                    jnp.zeros((ndev,) + tuple(jnp.shape(x)), jnp.float32),
+                    NamedSharding(self.mesh, P(tuple(self.grad_reduce_axes),
+                                               *tuple(s)))),
+                params, p_specs,
+            )
+            state["ef"] = ef
+        return state
 
     # ------------------------------------------------------------ traced core
 
-    def reduce_grads_to_shard(self, grads_local: PyTree, shard_dims: PyTree) -> PyTree:
+    def _compress_decisions(self, params: PyTree, shard_dims: PyTree):
+        """Host-side per-leaf compress/exact choices (shapes are static):
+        ``(policy {name: bool}, auto records or None)``.  Override (MoE
+        expert) and replicated (no divisible dim) leaves never compress;
+        'int8'/'int8_ef' apply the size threshold; 'auto' scores the
+        shard-axis reduce-scatter through ``CommModel.predict_compressed``
+        (``dist.compressed.auto_compress_policy``)."""
+        from .data_parallel import _key_str
+
+        if self.grad_compress is None:
+            return {}, None
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        d_flat = jax.tree_util.tree_leaves(shard_dims)
+        itemsize = jnp.dtype(self.master_dtype).itemsize
+        policy: dict = {}
+        eligible = []
+        for (path, x), d in zip(flat, d_flat):
+            name = _key_str(path)
+            matched = any(tok in name for tok in self.grad_reduce_overrides)
+            if matched or d < 0:
+                policy[name] = False
+                continue
+            eligible.append((name, tuple(jnp.shape(x)), itemsize))
+        if self.grad_compress == "auto":
+            from ..dist.compressed import auto_compress_policy
+
+            pol, records = auto_compress_policy(
+                eligible, "reduce_scatter", (self.shard_axis,), self.mesh,
+                model=self.comm_model, min_size=self.compress_min_size)
+            policy.update(pol)
+            return policy, records
+        for name, shape, _ in eligible:
+            size = 1
+            for s in shape:
+                size *= int(s)
+            policy[name] = size >= self.compress_min_size
+        return policy, None
+
+    def reduce_grads_to_shard(
+        self,
+        grads_local: PyTree,
+        shard_dims: PyTree,
+        policy: Optional[dict] = None,
+        ef: Optional[PyTree] = None,
+    ):
         """Traced: mean-reduce grads over ``grad_reduce_axes`` delivering only
         the owner shard (fused psum_scatter; the reference's reduce-to-owner,
         zero_optim.py:203).
@@ -250,13 +346,24 @@ class ZeroOptimizer:
         axes only, still normalized by the FULL data-group size — the MoE-DP
         expert semantics (see :func:`..data_parallel.reduce_gradients`).
 
-        ``grad_compress='int8'``: large non-override leaves replace the f32
-        ``psum_scatter`` with :func:`...dist.compressed.int8_ring_reduce_scatter`
-        (1 int8 byte/elem on the wire vs 4 — the reduction only ever moves
-        grads TOWARD their owner, so no gather leg exists to pay for), and
-        any remaining cross-axes (hybrid's ``data_inter`` — the DCN leg)
-        ride :func:`...dist.compressed.int8_ring_pmean`."""
+        ``grad_compress``: compressed leaves (``policy`` — per-leaf choices
+        from :meth:`_compress_decisions`; derived from the size threshold
+        when None) replace the f32 ``psum_scatter`` with
+        :func:`...dist.compressed.int8_ring_reduce_scatter` (1 int8
+        byte/elem on the wire vs 4 — the reduction only ever moves grads
+        TOWARD their owner, so no gather leg exists to pay for), and any
+        remaining cross-axes (hybrid's ``data_inter`` — the DCN leg) ride
+        :func:`...dist.compressed.int8_ring_pmean`.
+
+        ``ef`` (the 'int8_ef' path): a per-leaf residual tree — each
+        compressed leaf reduces ``Q(grad + residual)`` and the new
+        residual (the quantization error, ``dist.compressed.ef_compress``)
+        is returned: ``(grads_shard, new_ef)`` instead of the bare tree.
+        """
         from .data_parallel import _key_str
+
+        if policy is None:
+            policy, _ = self._compress_decisions(grads_local, shard_dims)
 
         n = axis_size(self.shard_axis)
         total = n
@@ -264,7 +371,16 @@ class ZeroOptimizer:
             if a != self.shard_axis:
                 total *= axis_size(a)
 
-        def to_owner(path, g, d):
+        flat = jax.tree_util.tree_flatten_with_path(grads_local)
+        paths_leaves, treedef = flat
+        d_flat = jax.tree_util.tree_leaves(shard_dims)
+        e_flat = (
+            jax.tree_util.tree_leaves(ef) if ef is not None
+            else [None] * len(d_flat)
+        )
+
+        out_leaves, ef_leaves = [], []
+        for (path, g), d, e in zip(paths_leaves, d_flat, e_flat):
             g = g.astype(self.master_dtype)
             axes = self.grad_reduce_axes
             matched = False
@@ -275,23 +391,28 @@ class ZeroOptimizer:
                     matched = True
                     break
             other = tuple(a for a in axes if a != self.shard_axis)
-            compress = (
-                self.grad_compress == "int8"
-                and not matched
-                and g.size >= self.compress_min_size
-            )
+            compress = bool(policy.get(name, False))
             if d < 0:  # replicated leaf
                 vaxes = _vaxes(g, axes)
                 if matched:
                     # override semantics: full-group mean (EP overcount)
-                    return (jax.lax.psum(g, vaxes) if vaxes else g) / total
-                return jax.lax.pmean(g, vaxes) if vaxes else g
+                    g = (jax.lax.psum(g, vaxes) if vaxes else g) / total
+                else:
+                    g = jax.lax.pmean(g, vaxes) if vaxes else g
+                out_leaves.append(g)
+                ef_leaves.append(e)
+                continue
             if compress:
                 from ..dist.compressed import (
+                    ef_compress,
                     int8_ring_pmean,
                     int8_ring_reduce_scatter,
                 )
 
+                if e is not None:
+                    # input-side error feedback: compress grad + carried
+                    # residual, persist this step's quantization error
+                    g, e = ef_compress(g + e)
                 g = int8_ring_reduce_scatter(g, self.shard_axis, d)
             else:
                 g = jax.lax.psum_scatter(
@@ -305,9 +426,13 @@ class ZeroOptimizer:
                         g = int8_ring_pmean(g, a) * axis_size(a)
                 else:
                     g = jax.lax.psum(g, o)
-            return g / total
+            out_leaves.append(g / total)
+            ef_leaves.append(e)
 
-        return jax.tree_util.tree_map_with_path(to_owner, grads_local, shard_dims)
+        out = jax.tree_util.tree_unflatten(treedef, out_leaves)
+        if ef is None:
+            return out
+        return out, jax.tree_util.tree_unflatten(treedef, ef_leaves)
 
     def apply_gradients(
         self,
@@ -365,21 +490,53 @@ class ZeroOptimizer:
         if accum_reduce not in ("final", "microbatch"):
             raise ValueError(
                 f"accum_reduce must be 'final' or 'microbatch', got {accum_reduce!r}")
+        if (
+            self.grad_compress == "int8_ef"
+            and accum_reduce == "microbatch"
+            and grad_accum_iters > 1
+        ):
+            # the residual is one-per-STEP state; the microbatch path
+            # reduces inside the accumulation scan where the reduce_fn is
+            # stateless — silently dropping the feedback would defeat the
+            # mode, so the combination is rejected by name
+            raise ValueError(
+                "grad_compress='int8_ef' does not compose with "
+                "accum_reduce='microbatch': the error-feedback residual "
+                "updates once per step, but 'microbatch' reduces inside "
+                "the accumulation scan; use accum_reduce='final' or "
+                "grad_compress='int8'")
         mesh = self.mesh
         data_axes = self.grad_reduce_axes
+        ef_mode = self.grad_compress == "int8_ef"
 
         cache = {}
 
-        def jitted(params, state, batch):
-            from .data_parallel import step_cache_key
+        def jit_for(params, state, batch):
+            from .data_parallel import _key_str, step_cache_key
 
             key = step_cache_key(params, state, batch)
             if key not in cache:
                 p_specs, zero_specs, shard_dims = self._specs_for(params)
+                policy, records = self._compress_decisions(params, shard_dims)
+                if records is not None:
+                    # the 'auto' decision trail: one structured event per
+                    # compiled signature (the compression RUNREPORT section
+                    # reads it — obs.comm_model.compression_report)
+                    from ..obs.events import emit_event
+
+                    emit_event(
+                        "compress_policy", family="zero", mode="auto",
+                        op="reduce_scatter", axes=[self.shard_axis],
+                        n_leaves=len(records),
+                        n_compressed=sum(
+                            1 for r in records if r["compress"]),
+                        leaves=records)
                 state_specs = {
                     "master": zero_specs,
                     "inner": self._state_specs_from(params, zero_specs),
                 }
+                if ef_mode:
+                    state_specs["ef"] = self._ef_specs(p_specs)
                 in_batch_specs = (
                     batch_spec
                     if batch_spec is not None
@@ -407,18 +564,29 @@ class ZeroOptimizer:
                             loss_fn, p_local, batch, grad_accum_iters,
                             reduce_fn=(
                                 (lambda g: self.reduce_grads_to_shard(
-                                    g, shard_dims))
+                                    g, shard_dims, policy=policy))
                                 if in_scan else None
                             ),
                         )
                     grads, other = normalize_model_axis_grads(
                         loss, grads, mesh, data_axes
                     )
-                    g_shard = (
-                        grads if in_scan
-                        else self.reduce_grads_to_shard(grads, shard_dims)
-                    )
+                    new_ef = None
+                    if in_scan:
+                        g_shard = grads
+                    elif ef_mode:
+                        # residual leaves are [1, *local_leaf] per device
+                        # (leading dim = the data-group member)
+                        e_loc = jax.tree.map(lambda r: r[0], state["ef"])
+                        g_shard, new_ef = self.reduce_grads_to_shard(
+                            grads, shard_dims, policy=policy, ef=e_loc)
+                    else:
+                        g_shard = self.reduce_grads_to_shard(
+                            grads, shard_dims, policy=policy)
                     master, new_state = self.apply_gradients(g_shard, state)
+                    if ef_mode:
+                        new_state["ef"] = jax.tree.map(
+                            lambda r: r[None], new_ef)
 
                     if other:
                         loss = jax.lax.pmean(loss, other)
@@ -434,21 +602,90 @@ class ZeroOptimizer:
                     out_specs=(zero_specs, state_specs, P()),
                 )
 
+                # --- the param re-gather: which leaves ride the int8 wire
+                # back.  The masters' return trip moves as many bytes as
+                # the grad reduction, so ``gather_compress`` (default
+                # 'follow') re-gathers the COMPRESSED leaves through the
+                # invariance-typed int8 masked-psum gather; masters stay
+                # full precision (quantization noise does not accumulate).
+                gather_mode = (
+                    self.gather_compress if self.gather_compress != "follow"
+                    else ("int8" if self.grad_compress is not None else None))
+                flat_paths = jax.tree_util.tree_flatten_with_path(params)
+                (pl, treedef) = flat_paths
+                d_flat = jax.tree_util.tree_leaves(shard_dims)
+                mask_leaves = [
+                    gather_mode == "int8"
+                    and policy.get(_key_str(path), False)
+                    and d >= 0
+                    for (path, _), d in zip(pl, d_flat)
+                ]
+                gmask = jax.tree_util.tree_unflatten(treedef, mask_leaves)
+                dtype_tree = jax.tree.map(lambda x: x.dtype, params)
+                regather_sm = None
+                if any(mask_leaves):
+                    regather_specs = jax.tree_util.tree_unflatten(
+                        treedef,
+                        [
+                            ps if m else zs
+                            for m, ps, zs in zip(
+                                mask_leaves,
+                                treedef.flatten_up_to(p_specs),
+                                treedef.flatten_up_to(zero_specs),
+                            )
+                        ],
+                    )
+
+                    def regather_body(m_tree):
+                        from ..dist.compressed import int8_psum_all_gather
+
+                        def g1(m, d, msk, dt):
+                            m = m.astype(dt)
+                            if msk:
+                                return int8_psum_all_gather(
+                                    m, self.shard_axis, d)
+                            return m
+
+                        return jax.tree.map(
+                            g1, m_tree, shard_dims, gmask, dtype_tree)
+
+                    regather_sm = shard_map(
+                        regather_body,
+                        mesh=mesh,
+                        in_specs=(zero_specs,),
+                        out_specs=regather_specs,
+                    )
+
                 def step(params, state, batch):
                     master, new_state, loss = sm(params, state, batch)
                     # cast to training dtype on the shard, then reshard to the
                     # param placement — XLA emits the (bf16) all-gather, the
                     # analogue of the reference's param broadcast
-                    # (zero_optim.py:280-287) as one overlappable collective.
-                    def regroup(m, p, zs, ps):
+                    # (zero_optim.py:280-287) as one overlappable collective;
+                    # compressed leaves instead ride the explicit int8
+                    # masked-psum gather built above.
+                    gathered = (
+                        regather_sm(master) if regather_sm is not None
+                        else master)
+
+                    def regroup(m, p, zs, ps, msk):
+                        if msk:
+                            return m  # already full + param-placed (int8)
                         m = m.astype(p.dtype)
                         m = jax.lax.with_sharding_constraint(m, NamedSharding(mesh, zs))
                         return jax.lax.with_sharding_constraint(m, NamedSharding(mesh, ps))
 
-                    new_params = jax.tree.map(regroup, master, params, zero_specs, p_specs)
+                    new_params = jax.tree.map(
+                        regroup, gathered, params, zero_specs, p_specs, gmask)
                     return new_params, new_state, loss
 
                 cache[key] = jax.jit(step, donate_argnums=(0, 1) if donate else ())
-            return cache[key](params, state, batch)
+            return cache[key]
 
+        def jitted(params, state, batch):
+            return jit_for(params, state, batch)(params, state, batch)
+
+        # AOT hook (the Telemetry/bench contract): lower the SAME cached
+        # jit so ledgers/cost analysis see exactly the step being run
+        jitted.lower = lambda p, s, b: jit_for(p, s, b).lower(p, s, b)
         return jitted

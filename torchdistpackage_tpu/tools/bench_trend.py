@@ -36,8 +36,18 @@ from typing import Any, Dict, List, Tuple
 #: ``grad_norm_final`` is the PR-7 numerics column: a round whose
 #: throughput held but whose final grad norm went to 0/NaN measured a
 #: run that trained garbage — visible here, next to the tokens/s.
+#: ``comm_bytes_per_dim`` (PR 8) is the wire-bytes column: it renders as
+#: the TOTAL across dimensions (``comm_bytes=``), so a regression that
+#: re-inflates a compressed collective's bytes shows up in the trend next
+#: to the throughput it would eventually cost.
 AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
-            "grad_norm_final")
+            "grad_norm_final", "comm_bytes_per_dim")
+
+
+def _aux_str(key: str, val: Any) -> str:
+    if key == "comm_bytes_per_dim" and isinstance(val, dict):
+        return f"comm_bytes={sum(v for v in val.values() if isinstance(v, (int, float))):,.0f}"
+    return f"{key}={val}"
 
 
 def _metric_lines(tail: str) -> List[Dict[str, Any]]:
@@ -107,7 +117,7 @@ def trend(
                 f" ({(val - prev_val) / prev_val:+.1%})"
                 if (prev_val and not stale) else "")
             aux = " ".join(
-                f"{k}={rec[k]}" for k in AUX_KEYS if k in rec)
+                _aux_str(k, rec[k]) for k in AUX_KEYS if k in rec)
             report.append(
                 f"  r{n:02d}  {val:>12,.1f}{delta}"
                 + ("  [STALE]" if stale else "")

@@ -15,6 +15,10 @@ files (one JSON step record per line).  The tool prints:
   per-dtype HLO ledger SHIFT between the arms — the evidence that e.g.
   an int8 arm actually runs int8 (s8 bytes appear) rather than silently
   upcasting;
+- when both inputs are RUNREPORTs with a ``comm`` section, the per-AXIS
+  collective-bytes shift between the arms — the wire-savings evidence
+  (a compressed arm's axis bytes dropping ~3-4x) rendered next to the
+  drift, so one command shows both the win and its numeric cost;
 - one final JSON line with the verdict and the headline deltas.
 
 Exit code: 0 for ``exact``/``bounded``, 1 for ``diverged``, 2 for usage/
@@ -91,6 +95,44 @@ def dtype_shift(
     return rows
 
 
+def comm_axis_shift(
+    rep_a: Optional[Dict[str, Any]], rep_b: Optional[Dict[str, Any]]
+) -> Optional[List[Dict[str, Any]]]:
+    """Per-axis collective-bytes deltas between two reports' comm ledgers
+    (collectives aggregated by the mesh-axis set they span); None when
+    either side lacks a ledger.  The compressed-bytes evidence: an int8
+    arm's compressed axis shows its bytes divided by the wire win, while
+    untouched axes match — a drop appearing on the WRONG axis (or none at
+    all) means the compression didn't land where claimed."""
+    def per_axis(rep):
+        colls = (((rep or {}).get("comm") or {}).get("ledger") or {}).get(
+            "collectives")
+        if not colls:
+            return None
+        agg: Dict[str, Dict[str, int]] = {}
+        for c in colls:
+            key = "+".join(c.get("axes") or []) or "?"
+            e = agg.setdefault(key, {"bytes": 0, "ops": 0})
+            e["bytes"] += int(c.get("bytes", 0))
+            e["ops"] += 1
+        return agg
+
+    pa, pb = per_axis(rep_a), per_axis(rep_b)
+    if pa is None or pb is None:
+        return None
+    rows = []
+    for ax in sorted(set(pa) | set(pb)):
+        a = pa.get(ax, {"bytes": 0, "ops": 0})
+        b = pb.get(ax, {"bytes": 0, "ops": 0})
+        rows.append({
+            "axes": ax,
+            "bytes_a": a["bytes"], "bytes_b": b["bytes"],
+            "ops_a": a["ops"], "ops_b": b["ops"],
+            "ratio": round(a["bytes"] / b["bytes"], 3) if b["bytes"] else None,
+        })
+    return rows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m torchdistpackage_tpu.tools.parity_diff",
@@ -146,6 +188,17 @@ def main(argv=None) -> int:
             print(f"{r['dtype']:>8} {r['bytes_a']:>14,} {r['bytes_b']:>14,} "
                   f"{r['flops_a']:>12.3e} {r['flops_b']:>12.3e}")
 
+    cshift = comm_axis_shift(rep_a, rep_b)
+    if cshift:
+        print(f"\ncomm ledger shift per axis "
+              f"({args.label_a} -> {args.label_b}):")
+        print(f"{'axes':>16} {'bytes A':>14} {'bytes B':>14} "
+              f"{'A/B':>7} {'ops A':>6} {'ops B':>6}")
+        for r in cshift:
+            ratio = f"{r['ratio']:.2f}x" if r["ratio"] else "-"
+            print(f"{r['axes']:>16} {r['bytes_a']:>14,} {r['bytes_b']:>14,} "
+                  f"{ratio:>7} {r['ops_a']:>6} {r['ops_b']:>6}")
+
     line = {
         "metric": "parity",
         "key": args.key,
@@ -158,6 +211,11 @@ def main(argv=None) -> int:
     if shift:
         line["dtype_bytes_delta"] = {
             r["dtype"]: r["bytes_delta"] for r in shift if r["bytes_delta"]}
+    if cshift:
+        line["comm_axis_bytes"] = {
+            r["axes"]: {"a": r["bytes_a"], "b": r["bytes_b"],
+                        "ratio": r["ratio"]}
+            for r in cshift}
     print(json.dumps(line))
     if cmp["verdict"] == "diverged":
         print(f"\n!!! DIVERGED: {args.label_b} drifted past the bound vs "
