@@ -11,16 +11,27 @@ programs (one decode step, one prefill-chunk step); host code between
 ticks only rewrites int32 block tables.
 
 Telemetry wraps the decode step, so the RUNREPORT carries a ``serving``
-section (TTFT/TPOT percentiles, aggregate tokens/s, slot occupancy,
-KV-pool utilization) and the event timeline shows every admission /
-prefill chunk / retirement — the serving counterpart of the training MFU
-loop.  CI (tests/test_examples.py) validates all of it.
+section (TTFT/TPOT percentiles — per priority class too — aggregate
+tokens/s, slot occupancy, KV-pool utilization, and the
+``healthy|degraded|overloaded`` verdict) and the event timeline shows
+every admission / prefill chunk / retirement — the serving counterpart
+of the training MFU loop.  CI (tests/test_examples.py) validates all of
+it.
+
+Phase 2 demonstrates the preemption-safe drain contract (docs/serving.md
+"Serving under stress"): with requests in flight, a real SIGTERM (what
+SLURM sends before reclaiming the node) trips ``GracefulShutdown``,
+``run_until_idle(stop=...)`` drains the engine into persisted
+descriptors instead of finishing the work, and a RESTARTED engine
+resumes them mid-stream — emitted prefixes replayed through chunked
+prefill, carried PRNG keys continuing the sampling streams.
 
 - real TPU chips:      python examples/serve_gpt.py
 - 8-device CPU sim:    TDP_CPU_SIM=8 python examples/serve_gpt.py
 """
 
 import os
+import signal
 
 if os.environ.get("TDP_CPU_SIM"):
     from torchdistpackage_tpu.dist.overlap import cpu_sim
@@ -36,6 +47,7 @@ from torchdistpackage_tpu import setup_distributed, tpc
 from torchdistpackage_tpu.models import gpt_param_specs, init_gpt_params, llama_config
 from torchdistpackage_tpu.obs import Telemetry
 from torchdistpackage_tpu.serving import Request, ServingEngine
+from torchdistpackage_tpu.utils.preemption import GracefulShutdown
 
 
 def main():
@@ -73,7 +85,10 @@ def main():
         telemetry=tel, snapshot_every=8)
 
     # fixed-seed Poisson-ish arrivals: requests land every few engine
-    # ticks with mixed prompts, budgets, and per-request sampling
+    # ticks with mixed prompts, budgets, per-request sampling, AND mixed
+    # priority classes (interactive=2 > batch=0) with generous deadlines
+    # on the batch tier — the RUNREPORT serving section reports each
+    # class's TTFT/TPOT percentiles separately
     rng = np.random.RandomState(0)
     n_requests = 6 if smoke else 24
     schedule = []
@@ -81,12 +96,15 @@ def main():
     for i in range(n_requests):
         tick += int(rng.poisson(2))
         P = int(rng.choice([4, 8, 12]))
+        prio = 2 if i % 3 == 0 else 0  # every third request is interactive
         schedule.append((tick, Request(
             tokens=rng.randint(0, cfg.vocab_size, size=P).tolist(),
             max_new_tokens=int(rng.choice([6, 10, 16])),
             temperature=float(rng.choice([0.0, 0.7, 1.0])),
             top_k=int(rng.choice([0, 8, 32])) or None,
             seed=i,
+            priority=prio,
+            deadline_s=None if prio else 120.0,
         )))
 
     t = 0
@@ -100,15 +118,60 @@ def main():
     tel.record_serving(summary)
     assert summary["requests"]["completed"] == n_requests
     assert summary["decode_signatures"] == 1, "decode step retraced!"
+    assert summary["verdict"] == "healthy", summary["verdict"]
+    assert len(summary["priorities"]) == 2, "expected two priority classes"
     for rid in sorted(eng.finished)[:3]:
         f = eng.finished[rid]
         print(f"req {rid}: prompt {f['prompt_len']} -> +{f['new_tokens']} "
-              f"tokens ({f['reason']}), ttft {f['ttft_s'] * 1e3:.1f}ms")
+              f"tokens ({f['reason']}, prio {f['priority']}), "
+              f"ttft {f['ttft_s'] * 1e3:.1f}ms")
     print(f"served {summary['requests']['completed']} requests, "
           f"{summary['generated_tokens']} tokens at "
           f"{summary['tokens_per_sec']:.1f} tok/s; "
           f"occupancy {summary['slot_occupancy']['mean']:.0%}, "
-          f"pool {summary['kv_pool']['mean_utilization']:.0%}")
+          f"pool {summary['kv_pool']['mean_utilization']:.0%}; "
+          f"verdict {summary['verdict']}")
+
+    # ---- phase 2: preemption-safe drain (the SLURM SIGTERM contract) ----
+    # Requests in flight, a REAL SIGTERM arrives, run_until_idle drains
+    # into persisted descriptors, and a restarted engine resumes them.
+    drain_path = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"serve_gpt_drain_{os.getpid()}.json")
+    n_drain = 4 if smoke else 8
+    with GracefulShutdown(signals=("SIGTERM",)) as stop:
+        for i in range(n_drain):
+            eng.submit(Request(
+                tokens=rng.randint(0, cfg.vocab_size,
+                                   size=int(rng.choice([4, 8]))).tolist(),
+                max_new_tokens=16,
+                temperature=float(rng.choice([0.0, 0.8])),
+                seed=100 + i,
+                priority=int(rng.choice([0, 2]))))
+        for _ in range(4):  # a little service before the reclaim lands
+            eng.step()
+        os.kill(os.getpid(), signal.SIGTERM)
+        eng.run_until_idle(stop=stop, persist_path=drain_path)
+        assert stop.requested, "SIGTERM did not trip GracefulShutdown"
+    assert eng.n_busy == 0 and not eng.queue, "drain left work behind"
+
+    eng2 = ServingEngine(  # the relaunched job's engine, same config
+        params, cfg, num_slots=num_slots, block_size=8, chunk=8,
+        mesh=mesh, axis="tensor", dp_axis="data" if dp > 1 else None,
+        telemetry=tel, snapshot_every=8)
+    rids = eng2.resume(drain_path)
+    eng2.run_until_idle()
+    resumed = [eng2.finished[r] for r in rids]
+    assert len(resumed) == n_drain and not eng2.rejected
+    assert all(f["reason"] in ("eos", "max_tokens") for f in resumed)
+    assert eng2.serving_summary()["decode_signatures"] == 1
+    n_mid = sum(f["resumed"] for f in resumed)
+    print(f"SIGTERM drain: persisted {n_drain} requests "
+          f"({n_mid} mid-stream), restarted engine completed all "
+          f"{len(resumed)} — emitted prefixes replayed, key streams "
+          f"continued")
+    for p in (drain_path, drain_path + ".manifest.json"):
+        if os.path.exists(p):
+            os.remove(p)
     tel.finalize()
 
 

@@ -56,8 +56,10 @@ OBS_EXAMPLES = {
                            "numerics": "alert_before_rollback"},
     # continuous-batching engine (PR 5): the report must carry the serving
     # section (TTFT/TPOT, tokens/s, occupancy, pool) with the compile-once
-    # evidence, plus the request lifecycle events
-    "serve_gpt.py": {"serving": True},
+    # evidence, plus the request lifecycle events.  "stress" (PR 9) adds
+    # the per-priority percentiles + verdict and the SIGTERM drain demo's
+    # engine_drained event
+    "serve_gpt.py": {"serving": "stress"},
 }
 
 
@@ -140,6 +142,17 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
         kinds = {e["kind"] for e in report["events"]}
         assert {"request_admitted", "prefill_chunk",
                 "request_retired", "slots_snapshot"} <= kinds, kinds
+        if probe["serving"] == "stress":
+            from torchdistpackage_tpu.obs import SERVING_VERDICTS
+
+            assert srv["verdict"] in SERVING_VERDICTS, srv["verdict"]
+            prios = srv["priorities"]
+            assert len(prios) >= 2, (script, prios)
+            for row in prios.values():
+                assert {"p50", "p95", "p99"} <= set(row["ttft_s"]), row
+            # the SIGTERM demo drained and its events hit the timeline
+            assert "engine_drained" in kinds, kinds
+            assert "preemption" in kinds, kinds  # the real signal arrived
 
     if probe.get("memory"):
         # the PR-6 memory section: per-program static breakdown captured

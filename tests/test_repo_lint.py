@@ -345,6 +345,32 @@ def test_event_kind_pass_covers_serving():
             "slots_snapshot"} <= emitted, emitted
 
 
+def test_stress_event_kinds_registered_and_emitted():
+    """The serving-under-stress kinds (PR 9) are in the registry AND each
+    is actually emitted from ``serving/`` — preemption, shedding, expiry,
+    cancellation, the fault-detect/recover pair, and drain are the
+    engine's degradation evidence; a kind that stopped being emitted
+    would silently blind every overload/chaos assertion built on it."""
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+
+    stress_kinds = {
+        "request_preempted", "request_shed", "request_expired",
+        "request_cancelled", "engine_fault_detected", "engine_recovered",
+        "engine_drained",
+    }
+    assert stress_kinds <= EVENT_KINDS
+    emitted = set()
+    for path in sorted((PKG / "serving").rglob("*.py")):
+        emitted.update(k for _, k in _emit_call_kinds(path))
+    missing = stress_kinds - emitted
+    assert not missing, f"stress kinds never emitted from serving/: {missing}"
+    # and the chaos harness drives the matching engine fault kinds
+    from torchdistpackage_tpu.resilience.chaos import (
+        ENGINE_FAULT_KINDS, FAULT_KINDS)
+
+    assert set(ENGINE_FAULT_KINDS) <= set(FAULT_KINDS)
+
+
 # ------------------------------------------- silent exception swallowing
 
 # `except: pass` / `except Exception: pass` swallows the very faults the

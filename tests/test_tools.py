@@ -231,6 +231,23 @@ def test_bench_trend_regression_detection_and_numerics_columns(tmp_path):
     assert main(["--dir", str(tmp_path)]) == 1
 
 
+def test_bench_trend_overload_columns():
+    """The PR-9 stress columns: a ``serve-overload`` line's goodput gates
+    (``value``) with ``shed_rate``/``preempt_count`` rendered alongside —
+    a goodput hold bought by shedding more is visible, not hidden."""
+    from torchdistpackage_tpu.tools.bench_trend import AUX_KEYS, trend
+
+    assert {"shed_rate", "preempt_count"} <= set(AUX_KEYS)
+    line = {"metric": "serve-overload", "value": 850.0,
+            "shed_rate": 0.21, "preempt_count": 3, "config": "c"}
+    report, warnings = trend(
+        [(1, [line]), (2, [dict(line, value=700.0, shed_rate=0.4)])],
+        threshold=0.05)
+    assert any("shed_rate=0.21" in ln for ln in report)
+    assert any("preempt_count=3" in ln for ln in report)
+    assert any("REGRESSION serve-overload" in w for w in warnings)
+
+
 def test_bench_trend_comm_bytes_column():
     """The PR-8 wire-bytes column: a line carrying ``comm_bytes_per_dim``
     renders its TOTAL in the aux trail, so a compressed collective

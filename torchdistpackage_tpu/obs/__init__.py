@@ -84,6 +84,7 @@ from .aggregate import (
 from .report import (
     RESILIENCE_VERDICTS,
     RUNREPORT_SCHEMA,
+    SERVING_VERDICTS,
     default_report_path,
     render_markdown,
     validate_runreport,
@@ -162,6 +163,7 @@ __all__ = [
     "pipeline_bubble_fraction",
     "step_time_stats",
     "RESILIENCE_VERDICTS",
+    "SERVING_VERDICTS",
     "RUNREPORT_SCHEMA",
     "default_report_path",
     "render_markdown",

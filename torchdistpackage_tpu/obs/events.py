@@ -92,6 +92,33 @@ Serving kinds (``torchdistpackage_tpu.serving``, PR 5):
 ``slots_snapshot``  periodic occupancy + KV-pool utilization sample
 ==================  =====================================================
 
+Serving-under-stress kinds (``serving/engine.py``, PR 9 — the overload /
+fault half of the lifecycle; docs/serving.md "Serving under stress"):
+
+==========================  =============================================
+``request_preempted``       a higher-priority request evicted this slot:
+                            blocks freed, accumulated output discarded,
+                            request requeued for prompt replay
+``request_shed``            admission refused at the door — bounded queue
+                            full, estimated TTFT past the deadline, or
+                            the engine is draining (record = the
+                            structured rejection verdict)
+``request_expired``         a queued request's deadline passed before a
+                            slot freed; removed without service
+``request_cancelled``       ``cancel(rid)`` retired the request (queued
+                            or in-flight; blocks freed same tick)
+``engine_fault_detected``   the per-tick invariant audit (block
+                            conservation, table/ownership agreement) or
+                            the sampled-token validity check found a
+                            poisoned slot / leaked block
+``engine_recovered``        the fault was healed: poisoned slots retired
+                            + requeued, orphaned blocks reclaimed, the
+                            rest of the batch untouched
+``engine_drained``          ``drain()`` unwound the queue + in-flight
+                            slots into restartable descriptors
+                            (preemption-safe shutdown)
+==========================  =============================================
+
 A module-level default log lets deep call sites (signal handlers, debug
 callbacks) emit without plumbing a handle through every layer:
 ``emit_event("preemption", signum=15)``.
@@ -124,6 +151,10 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     "desync_detected", "checkpoint_save_skipped",
     # serving (PR 5)
     "request_admitted", "prefill_chunk", "request_retired", "slots_snapshot",
+    # serving under stress (PR 9)
+    "request_preempted", "request_shed", "request_expired",
+    "request_cancelled", "engine_fault_detected", "engine_recovered",
+    "engine_drained",
     # memory observability (PR 6)
     "mem_snapshot", "oom_risk",
     # numerics observability (PR 7)

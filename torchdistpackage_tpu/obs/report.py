@@ -19,6 +19,11 @@ RUNREPORT_SCHEMA = "tdp-runreport/v1"
 # the self-healing loop's end states (resilience/loop.py summary verdicts)
 RESILIENCE_VERDICTS = ("clean", "recovered", "preempted", "aborted")
 
+# the serving engine's end states (serving/engine.py serving_summary):
+# overloaded = demand was refused (shed / expired requests), degraded =
+# the engine preempted or healed faults to keep serving, healthy = neither
+SERVING_VERDICTS = ("healthy", "degraded", "overloaded")
+
 # the memory section's headroom verdicts (obs/mem_ledger.py owns the
 # thresholds; re-exported here next to the other verdict vocabularies)
 from .mem_ledger import MEM_VERDICTS  # noqa: E402
@@ -260,6 +265,28 @@ def _validate_serving(srv: Any) -> List[str]:
     util = srv.get("kv_pool", {}).get("mean_utilization")
     if not isinstance(util, (int, float)) or not (0.0 <= util <= 1.0):
         errs.append("serving.kv_pool.mean_utilization missing/out of [0,1]")
+    # stress fields (PR 9) — optional for back-compat, validated when set
+    if "verdict" in srv and srv["verdict"] not in SERVING_VERDICTS:
+        errs.append(
+            f"serving.verdict {srv['verdict']!r} not in {SERVING_VERDICTS}")
+    reqs = srv.get("requests", {})
+    for key in ("shed", "expired", "cancelled", "preempted", "resumed"):
+        if key in reqs and (not isinstance(reqs[key], int) or reqs[key] < 0):
+            errs.append(f"serving.requests.{key} non-int/negative")
+    prios = srv.get("priorities")
+    if prios is not None:
+        if not isinstance(prios, dict):
+            errs.append("serving.priorities non-dict")
+        else:
+            for p, row in prios.items():
+                if not isinstance(row, dict) or not isinstance(
+                        row.get("ttft_s", {}), dict):
+                    errs.append(f"serving.priorities[{p}] malformed")
+    faults = srv.get("faults")
+    if faults is not None and (
+            not isinstance(faults, dict)
+            or faults.get("healed", 0) > faults.get("detected", 0)):
+        errs.append("serving.faults malformed (healed > detected)")
     return errs
 
 
@@ -321,6 +348,14 @@ def render_summary_line(report: Dict[str, Any]) -> str:
         if isinstance(p50, (int, float)):
             tail = f"(ttft p50 {p50 * 1e3:.0f}ms)"
         parts.append(f"serve={srv['tokens_per_sec']:.1f}tok/s{tail}")
+        if srv.get("verdict") and srv["verdict"] != "healthy":
+            reqs = srv.get("requests", {})
+            detail = ", ".join(
+                f"{k} {reqs.get(k, 0)}"
+                for k in ("shed", "expired", "preempted")
+                if reqs.get(k))
+            parts.append(
+                f"SERVING={srv['verdict']}" + (f"({detail})" if detail else ""))
     return "  ".join(parts)
 
 
@@ -581,6 +616,35 @@ def render_markdown(report: Dict[str, Any]) -> str:
         L.append(f"- requests: **{reqs.get('completed', 0)} completed** "
                  f"({reqs.get('queued', 0)} queued, "
                  f"{reqs.get('in_flight', 0)} in flight at finalize)")
+        if srv.get("verdict"):
+            stress = ", ".join(
+                f"{k} {reqs.get(k, 0)}"
+                for k in ("shed", "expired", "preempted", "cancelled",
+                          "resumed")
+                if reqs.get(k))
+            L.append(f"- verdict: **{srv['verdict']}**"
+                     + (f" ({stress})" if stress else ""))
+        faults = srv.get("faults") or {}
+        if faults.get("detected"):
+            L.append(f"- faults: {faults['detected']} detected, "
+                     f"{faults.get('healed', 0)} healed "
+                     f"({faults.get('audits', 0)} invariant audits)")
+        prios = srv.get("priorities") or {}
+        if len(prios) > 1:
+            L.append("")
+            L.append("| priority | completed | TTFT p50 | TTFT p99 "
+                     "| TPOT p50 |")
+            L.append("|---|---|---|---|---|")
+            for p in sorted(prios, key=lambda x: -int(x)):
+                row = prios[p]
+                tt, tp = row.get("ttft_s") or {}, row.get("tpot_s") or {}
+                fmt = (lambda d, k: f"{d[k] * 1e3:.2f} ms"
+                       if isinstance(d.get(k), (int, float)) else "-")
+                L.append(
+                    f"| {p} | {row.get('completed', 0)} "
+                    f"| {fmt(tt, 'p50')} | {fmt(tt, 'p99')} "
+                    f"| {fmt(tp, 'p50')} |")
+            L.append("")
         L.append(f"- aggregate throughput: "
                  f"**{srv.get('tokens_per_sec', 0.0):.1f} tok/s** "
                  f"({srv.get('generated_tokens', 0)} tokens)")

@@ -33,6 +33,31 @@ Supported fault kinds (``Fault.kind``):
                     simply vanishes, as a real failed worker does
 ==================  ====================================================
 
+Engine-level fault kinds (the serving chaos matrix — ``Fault.step`` is
+the ENGINE TICK these fire at, and ``Fault.slot`` picks the victim slot /
+dp group; the :class:`~..serving.ServingEngine` drives them through
+``chaos=`` and must detect + heal every one, co-batched requests
+bit-identical — see docs/serving.md "Serving under stress"):
+
+==================  ====================================================
+``slot_stall``      sleep ``duration_s`` inside an engine tick — a
+                    wedged tick for the engine's :class:`~.watchdog
+                    .Watchdog` to escalate (``hang_suspected``)
+``alloc_exhaust``   grab every free block of a dp group's
+                    :class:`~..serving.BlockAllocator` without an owner
+                    — a block leak the per-tick conservation audit must
+                    find and reclaim
+``table_corrupt``   overwrite an entry of a live slot's device-bound
+                    block-table row — the poisoned slot must be retired
+                    and replayed BEFORE the row reaches a compiled step
+``nan_logits``      poison one slot's host-fetched sampled token with an
+                    out-of-range sentinel — the cheap deterministic
+                    stand-in for a NaN logit row (the same idiom as
+                    ``nan_spike`` poisoning the fetched loss): the
+                    engine's validity check must retire + replay exactly
+                    that slot
+==================  ====================================================
+
 Usage::
 
     chaos = ChaosMonkey(faults=[Fault("nan_spike", step=5)], seed=0)
@@ -54,7 +79,13 @@ import signal
 import time
 from typing import Any, List, Optional, Sequence
 
-FAULT_KINDS = ("ckpt_corrupt", "sigterm", "nan_spike", "stall", "host_dropout")
+#: Faults the serving engine injects/heals (``Fault.step`` = engine tick).
+ENGINE_FAULT_KINDS = (
+    "slot_stall", "alloc_exhaust", "table_corrupt", "nan_logits")
+
+FAULT_KINDS = (
+    "ckpt_corrupt", "sigterm", "nan_spike", "stall", "host_dropout",
+) + ENGINE_FAULT_KINDS
 
 
 @dataclasses.dataclass
@@ -73,6 +104,7 @@ class Fault:
     process: Optional[int] = None     # restrict to one host (None = all)
     target_step: Optional[int] = None  # ckpt_corrupt: ckpt step (None = latest)
     exit_code: int = 42               # host_dropout
+    slot: Optional[int] = None        # engine faults: victim slot / dp group
     repeat: bool = False
     fired: int = dataclasses.field(default=0, compare=False)
 
@@ -213,6 +245,61 @@ class ChaosMonkey:
         for f in self._due(step, ("host_dropout",)):
             self._emit(f, exit_code=f.exit_code)
             os._exit(f.exit_code)
+
+    # ------------------------------------------- serving-engine injectors
+
+    def before_engine_tick(self, tick: int, engine: Any) -> None:
+        """Fire engine-level faults due at ``tick`` (the engine calls this
+        at the top of :meth:`~..serving.ServingEngine.step`, BEFORE its
+        invariant audit — so every injected inconsistency is on the table
+        when the audit runs, and a healed tick proves detection, not
+        luck).  ``nan_logits`` fires later, through
+        :meth:`perturb_engine_tokens`."""
+        for f in self._due(tick, ("slot_stall",)):
+            self._emit(f, duration_s=f.duration_s)
+            time.sleep(f.duration_s)
+        for f in self._due(tick, ("alloc_exhaust",)):
+            g = f.slot or 0
+            alloc = engine._allocs[g % len(engine._allocs)]
+            stolen = alloc.alloc(alloc.n_free) or []
+            # deliberately NOT recorded anywhere the engine can see: the
+            # blocks are live with no owner, exactly what a leak looks like
+            self._emit(f, group=g, stolen_blocks=len(stolen))
+        for f in self._due(tick, ("table_corrupt",)):
+            victims = [
+                i for i, s in enumerate(engine._slots) if s.state != "free"]
+            if not victims:
+                continue  # nothing live to corrupt this tick; stays armed
+            slot = f.slot if f.slot is not None else victims[0]
+            # point the row's first entry at a block this slot does NOT
+            # own: seed-chosen from the victim group's free list when one
+            # exists (a freed block the step would read stale data from),
+            # else the last pool block
+            alloc = engine._allocs[slot // engine.slots_per_group]
+            pool = alloc._free or [engine.num_blocks - 1]
+            bogus = pool[self.rng.randrange(len(pool))]
+            engine._tables[slot, 0] = bogus
+            self._emit(f, slot=slot, entry=0, bogus_block=int(bogus))
+
+    def perturb_engine_tokens(self, tick: int, tokens: Any) -> Any:
+        """Poison one slot's host-fetched sampled token when a
+        ``nan_logits`` fault is due — the deterministic stand-in for a NaN
+        logit row (an all-NaN row's argmax is indistinguishable from a
+        legitimate token 0, so the injected evidence is an out-of-range
+        sentinel the engine's validity check must catch; the device state
+        is untouched, which is also what keeps the co-batched
+        bit-identity claim falsifiable)."""
+        due = self._due(tick, ("nan_logits",))
+        if not due:
+            return tokens
+        import numpy as np
+
+        tokens = np.array(tokens, copy=True)
+        for f in due:
+            slot = f.slot if f.slot is not None else 0
+            tokens[slot] = np.iinfo(np.int32).min
+            self._emit(f, slot=slot, target="sampled_token")
+        return tokens
 
     def perturb_loss(self, step: int, loss: float) -> float:
         """Poison the step's (host-fetched) loss when a ``nan_spike`` is

@@ -14,7 +14,15 @@ same block, so paged decode agrees with contiguous ``generate()`` to the
 bit (tests/test_serving.py).  TP/DP sharding comes from the same mesh
 axes as training; ``obs`` integration reports TTFT/TPOT percentiles,
 aggregate tokens/s, slot occupancy and pool utilization in the RUNREPORT
-``serving`` section.  See docs/serving.md.
+``serving`` section.
+
+Overload and faults are scheduler states, not exceptions (docs/serving.md
+"Serving under stress"): priority classes with evict-and-requeue
+preemption, deadline-aware admission that sheds with structured verdicts,
+same-tick cancellation, a per-tick block-conservation audit with
+self-healing recovery (chaos-matrix proven), and preemption-safe
+SIGTERM drain/resume with exact-token replay — all host-side, so the
+two-compiled-programs hot loop survives every path.  See docs/serving.md.
 """
 
 from .engine import Request, ServingEngine

@@ -9,7 +9,7 @@ batching).  This engine is that scheduler, built TPU-first:
 
 - **Fixed slots, compiled once.**  The decode batch is ``num_slots`` rows
   forever.  A request occupies a slot from admission to retirement; freed
-  slots are refilled from the FIFO queue on the next tick.  Because every
+  slots are refilled from the queue on the next tick.  Because every
   device-side shape is static (``[num_slots, 1]`` tokens, ``[num_slots,
   max_blocks]`` int32 tables, the block pool), the hot loop is exactly TWO
   compiled programs — one decode step, one prefill-chunk step — and host
@@ -36,21 +36,72 @@ batching).  This engine is that scheduler, built TPU-first:
   slot batch against its own pool shard, so a ``tp_dp`` mesh serves with
   zero engine changes.
 
+Overload and faults are first-class, not exceptional (docs/serving.md
+"Serving under stress").  Everything below is HOST-side scheduler state —
+no priority, deadline, or fault bit is ever a traced value, so the
+two-compiled-programs invariant survives every path:
+
+- **Priorities + preemption.**  ``Request.priority`` orders the queue
+  (higher first; FIFO within a class).  When the head of the queue cannot
+  be admitted, the lowest-priority running slot strictly below it is
+  *evicted*: blocks freed, accumulated output discarded, request requeued
+  for prompt replay through the ordinary chunked prefill (replay is
+  deterministic — greedy rows trivially, sampled rows because the slot
+  key restarts from the same seed — so a preempted request's final tokens
+  equal its unpreempted ones).
+- **Deadlines, shedding, cancel.**  ``Request.deadline_s`` is a TTFT
+  budget from submit: admission estimates TTFT from the queue's unstarted
+  prefill work x the engine's own measured tick time
+  (:meth:`ServingEngine.estimate_ttft`) and *sheds* requests that cannot
+  make it — a structured rejection verdict in ``engine.rejected`` plus a
+  ``request_shed`` event, never unbounded queue growth (``max_queue``
+  bounds the queue the same way).  A queued request whose deadline passes
+  expires (``request_expired``); :meth:`ServingEngine.cancel` retires a
+  queued or in-flight request and frees its blocks the same tick.
+- **Invariant audit + self-healing.**  Every tick starts with a block-
+  conservation audit (:meth:`ServingEngine.audit` over
+  ``BlockAllocator.audit``): allocator in_use must equal the live slots'
+  owned blocks, no table row may disagree with its slot's ownership, no
+  entry may point at a freed block.  A violated slot is poisoned —
+  retired with an ``engine_fault_detected`` event, its blocks reclaimed,
+  the request requeued for replay — and orphaned blocks are reclaimed;
+  the rest of the batch continues bit-identically (``engine_recovered``).
+  Sampled tokens are validity-checked on fetch (an out-of-range token is
+  the host-visible face of a NaN logit row) with the same retire-and-
+  replay recovery.  ``chaos=`` accepts a
+  :class:`~..resilience.ChaosMonkey` whose engine fault kinds
+  (``slot_stall`` / ``alloc_exhaust`` / ``table_corrupt`` /
+  ``nan_logits``) drive exactly these paths; ``watchdog=`` beats a
+  :class:`~..resilience.Watchdog` each tick so a wedged tick escalates
+  to ``hang_suspected``/abort.
+- **Preemption-safe drain.**  :meth:`ServingEngine.drain` (the
+  ``GracefulShutdown`` SIGTERM contract) stops admission and unwinds the
+  queue + in-flight slots into restartable descriptors — prompt, emitted
+  tokens, sampling state, the carried PRNG key — optionally persisted
+  with a SHA-256 manifest (the ``ckpt_guard`` verify-before-restore
+  idiom).  A restarted engine's :meth:`ServingEngine.resume` replays
+  prompt+emitted-prefix through chunked prefill and continues the stream
+  exactly: temp-0 requests resume to exact token parity
+  (``tools/parity_diff.py``-gated in tests), sampled ones continue their
+  key stream.
+
 Observability: every lifecycle transition is a structured event
 (``request_admitted`` / ``prefill_chunk`` / ``request_retired`` /
-``slots_snapshot``), decode ticks are Telemetry steps when a session is
-wired in, and :meth:`ServingEngine.serving_summary` is the RUNREPORT
-``serving`` section — TTFT/TPOT percentiles, aggregate tokens/s, slot
-occupancy, and KV-pool utilization (the serving counterpart of the
-training MFU loop).
+``slots_snapshot`` plus the stress kinds ``request_preempted`` /
+``request_shed`` / ``request_expired`` / ``request_cancelled`` /
+``engine_fault_detected`` / ``engine_recovered`` / ``engine_drained``),
+decode ticks are Telemetry steps when a session is wired in, and
+:meth:`ServingEngine.serving_summary` is the RUNREPORT ``serving``
+section — per-priority TTFT/TPOT percentiles, shed/preempt/expire
+counts, and a ``healthy | degraded | overloaded`` verdict next to the
+PR-5 aggregates.
 """
 
 from __future__ import annotations
 
-import collections
 import dataclasses
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -72,13 +123,23 @@ from .paged_cache import (
 # slot lifecycle
 FREE, PREFILL, DECODE = "free", "prefill", "decode"
 
+#: Drain-payload schema tag (ServingEngine.drain / .resume).
+DRAIN_SCHEMA = "tdp-engine-drain/v1"
+
 
 @dataclasses.dataclass
 class Request:
     """One serving request.  ``temperature=0`` is greedy (bit-identical to
     ``generate()``'s argmax); otherwise ``seed`` starts the slot's private
     sampling stream.  ``eos_id`` retires the request early — a serving-
-    layer concern ``generate()`` deliberately doesn't have."""
+    layer concern ``generate()`` deliberately doesn't have.
+
+    ``priority`` (host-side scheduler state, never traced) orders the
+    queue and arms preemption: a waiting request may evict a running slot
+    of strictly lower priority.  ``deadline_s`` is a TTFT budget measured
+    from submit: admission sheds the request when the engine's own
+    latency model says it cannot make the deadline, and a queued request
+    whose budget lapses expires without service."""
 
     tokens: Sequence[int]
     max_new_tokens: int
@@ -87,6 +148,8 @@ class Request:
     top_p: Optional[float] = None
     eos_id: Optional[int] = None
     seed: int = 0
+    priority: int = 0
+    deadline_s: Optional[float] = None
     rid: int = -1  # assigned at submit()
 
     def __post_init__(self) -> None:
@@ -100,6 +163,9 @@ class Request:
             raise ValueError(f"top_k must be >= 1, got {self.top_k}")
         if len(self.tokens) < 1:
             raise ValueError("empty prompt")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0, got {self.deadline_s}")
 
 
 def _split_keys(keys: jnp.ndarray):
@@ -143,11 +209,13 @@ def _slot_sample(
 
 class _SlotState:
     """Host-side bookkeeping for one slot (device state lives in the
-    engine's int32/f32 arrays; this carries the request identity)."""
+    engine's int32/f32 arrays; this carries the request identity).
+    ``orig_prompt_len``/``pre_gen`` account for resumed requests whose
+    admitted prompt includes an already-emitted prefix (drain/resume)."""
 
     __slots__ = ("state", "rid", "req", "blocks", "prompt", "off",
                  "generated", "t_submit", "t_admit", "t_last", "ttft_s",
-                 "tpot_s")
+                 "tpot_s", "orig_prompt_len", "pre_gen")
 
     def __init__(self) -> None:
         self.reset()
@@ -163,6 +231,8 @@ class _SlotState:
         self.t_submit = self.t_admit = self.t_last = 0.0
         self.ttft_s: Optional[float] = None
         self.tpot_s: List[float] = []
+        self.orig_prompt_len = 0
+        self.pre_gen = 0
 
 
 class ServingEngine:
@@ -194,6 +264,14 @@ class ServingEngine:
     telemetry: an ``obs.Telemetry`` — decode ticks become steps (recompile
         detection guards the compile-once contract) and events land on its
         timeline.
+    max_queue: bound on the waiting queue; a submit past it is SHED with a
+        structured verdict (``engine.rejected``) instead of growing the
+        queue without bound.  None = unbounded (the PR-5 behavior).
+    chaos: a :class:`~..resilience.ChaosMonkey` driven each tick
+        (``before_engine_tick`` + ``perturb_engine_tokens``) — the fault-
+        injection seam the recovery paths are proven against.
+    watchdog: a :class:`~..resilience.Watchdog`; the engine beats it once
+        per tick so a wedged tick escalates to ``hang_suspected``/abort.
     """
 
     def __init__(
@@ -214,6 +292,9 @@ class ServingEngine:
         kv_quant: bool = False,
         telemetry: Optional[Any] = None,
         snapshot_every: int = 16,
+        max_queue: Optional[int] = None,
+        chaos: Optional[Any] = None,
+        watchdog: Optional[Any] = None,
     ) -> None:
         if (axis is not None or dp_axis is not None) and mesh is None:
             raise ValueError("axis/dp_axis need a mesh")
@@ -226,6 +307,8 @@ class ServingEngine:
             raise ValueError(
                 f"num_slots/chunk/block_size must be >= 1, got "
                 f"{num_slots}/{chunk}/{block_size}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1 or None, got {max_queue}")
         self.cfg = cfg
         self.params = params
         self.num_slots = num_slots
@@ -236,6 +319,9 @@ class ServingEngine:
         self.kv_quant = kv_quant
         self.telemetry = telemetry
         self.snapshot_every = snapshot_every
+        self.max_queue = max_queue
+        self.chaos = chaos
+        self.watchdog = watchdog
         self._ev: EventLog = (
             telemetry.events if telemetry is not None else default_event_log())
 
@@ -273,9 +359,14 @@ class ServingEngine:
         self._keys = np.zeros((num_slots, 2), np.uint32)
 
         self._slots = [_SlotState() for _ in range(num_slots)]
-        self.queue: collections.deque = collections.deque()
+        self.queue: List[Tuple[Request, float]] = []
         self.finished: Dict[int, Dict[str, Any]] = {}
+        self.rejected: Dict[int, Dict[str, Any]] = {}
         self._next_rid = 0
+        self._seq: Dict[int, int] = {}  # rid -> FIFO age (survives requeue)
+        self._inject: Dict[int, Dict[str, Any]] = {}  # resume key/prefix
+        self._draining = False
+        self._tick_ewma: Optional[float] = None
         self._step_fn = self._build_step()
         self._decode_fn = (
             telemetry.wrap_step(self._step_fn) if telemetry is not None
@@ -351,12 +442,66 @@ class ServingEngine:
             self._param_specs = fn(self.cfg, tp_axis=self.axis, **kw)
         return self._param_specs
 
-    # ---------------------------------------------------------------- lifecycle
+    # ---------------------------------------------------------------- admission
+
+    def _blocks_needed(self, req: Request) -> int:
+        return -(-(len(req.tokens) + req.max_new_tokens) // self.block_size)
+
+    def _queue_sort(self) -> None:
+        """Priority order, FIFO within a class: the sort key is
+        (-priority, submit age) and ages survive requeue, so a preempted
+        request rejoins ahead of younger peers of its own class."""
+        self.queue.sort(key=lambda e: (-e[0].priority, self._seq[e[0].rid]))
+
+    def estimate_ttft(self, prompt_len: int) -> Optional[float]:
+        """Estimated seconds until a request of ``prompt_len`` submitted
+        NOW samples its first token, from the engine's own measured tick
+        time (an EWMA over decode-carrying ticks): the request's own
+        prefill chunks + the queue's unstarted prefill work + (when every
+        slot is busy) the ticks until the earliest busy slot can retire.
+        ``None`` until a tick has been measured — an unmeasured engine
+        admits everything (there is no evidence to shed on yet)."""
+        if self._tick_ewma is None:
+            return None
+        ticks = -(-prompt_len // self.chunk)
+        for q, _t in self.queue:
+            ticks += -(-len(q.tokens) // self.chunk)
+        if not any(s.state == FREE for s in self._slots):
+            remaining = []
+            for s in self._slots:
+                if s.state == FREE or s.req is None:
+                    continue
+                pre = (-(-(len(s.prompt) - s.off) // self.chunk)
+                       if s.state == PREFILL else 0)
+                remaining.append(
+                    max(0, pre + s.req.max_new_tokens - len(s.generated)))
+            if remaining:
+                ticks += min(remaining)
+        return ticks * self._tick_ewma
+
+    def _shed(self, req: Request, t_submit: float, reason: str,
+              **extra: Any) -> None:
+        """Refuse admission with a structured verdict: the record lands in
+        ``self.rejected[rid]`` and on the timeline as ``request_shed`` —
+        bounded, observable degradation instead of unbounded queueing."""
+        verdict = {
+            "rid": req.rid, "reason": reason, "priority": req.priority,
+            "deadline_s": req.deadline_s, "queue_depth": len(self.queue),
+            **extra,
+        }
+        self.rejected[req.rid] = verdict
+        self.stats["shed"] += 1
+        self._ev.emit("request_shed", **verdict)
 
     def submit(self, req: Request) -> int:
         """Enqueue; returns the request id.  Raises if the request can
         never fit the engine's context/pool ceilings (a too-long request
-        must fail loudly at the door, not deadlock the FIFO)."""
+        must fail loudly at the door, not deadlock the queue).  A request
+        the engine COULD serve but currently cannot afford — queue at
+        ``max_queue``, estimated TTFT past ``deadline_s``, engine draining
+        — is SHED: the rid is still returned, with the structured
+        rejection verdict in ``self.rejected[rid]`` and a ``request_shed``
+        event on the timeline."""
         P, N = len(req.tokens), req.max_new_tokens
         need = -(-(P + N) // self.block_size)
         if P + N > self.max_ctx:
@@ -372,13 +517,105 @@ class ServingEngine:
                 f"table ({self.cfg.max_seq})")
         req = dataclasses.replace(req, rid=self._next_rid)
         self._next_rid += 1
-        self.queue.append((req, time.perf_counter()))
+        self._seq[req.rid] = req.rid  # submit order IS the FIFO age
+        t_submit = time.perf_counter()
+        if self._draining:
+            self._shed(req, t_submit, "draining")
+            return req.rid
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            self._shed(req, t_submit, "queue_full", max_queue=self.max_queue)
+            return req.rid
+        if req.deadline_s is not None:
+            est = self.estimate_ttft(P)
+            if est is not None and est > req.deadline_s:
+                self._shed(req, t_submit, "deadline_unmeetable",
+                           est_ttft_s=round(est, 6))
+                return req.rid
+        self.queue.append((req, t_submit))
+        self._queue_sort()
         return req.rid
 
+    def _expire_queue(self, now: float) -> int:
+        """Drop queued requests whose TTFT deadline already passed — they
+        cannot be served in time, so holding a queue spot only delays
+        requests that still can."""
+        keep, expired = [], 0
+        for req, t_submit in self.queue:
+            if req.deadline_s is not None and now - t_submit > req.deadline_s:
+                expired += 1
+                self.stats["expired"] += 1
+                verdict = {
+                    "rid": req.rid, "reason": "expired",
+                    "priority": req.priority, "deadline_s": req.deadline_s,
+                    "waited_s": round(now - t_submit, 6),
+                }
+                self.rejected[req.rid] = verdict
+                self._inject.pop(req.rid, None)
+                self._ev.emit("request_expired", **verdict)
+            else:
+                keep.append((req, t_submit))
+        self.queue = keep
+        return expired
+
+    def _pick_victim(self, req: Request) -> Optional[int]:
+        """The slot to evict so ``req`` can run: lowest priority strictly
+        below ``req``'s; among equals, the most recently admitted (the
+        discard-and-replay loses the least work)."""
+        best = None
+        for i, s in enumerate(self._slots):
+            if s.state == FREE or s.req is None:
+                continue
+            if s.req.priority >= req.priority:
+                continue
+            key = (s.req.priority, -s.t_admit)
+            if best is None or key < best[0]:
+                best = (key, i)
+        return None if best is None else best[1]
+
+    def _preempt(self, i: int, by: Request) -> None:
+        s = self._slots[i]
+        self.stats["preempted"] += 1
+        self._ev.emit(
+            "request_preempted", rid=s.rid, slot=i,
+            priority=s.req.priority, by_rid=by.rid, by_priority=by.priority,
+            discarded_tokens=len(s.generated), blocks_freed=len(s.blocks))
+        self._requeue_slot(i)
+
+    def _requeue_slot(self, i: int) -> int:
+        """Evict slot ``i`` back to the queue: blocks freed (tolerantly —
+        a poisoned slot's ownership may already be inconsistent),
+        accumulated output discarded, the request requeued at its ORIGINAL
+        FIFO age for prompt replay.  Replay is deterministic: the slot key
+        restarts from the request seed (or the drain-injected key), so the
+        eventual tokens equal the uninterrupted run's."""
+        s = self._slots[i]
+        rid, req, t_submit = s.rid, s.req, s.t_submit
+        alloc = self._allocs[i // self.slots_per_group]
+        try:
+            alloc.free(s.blocks)
+        except ValueError:
+            alloc.reclaim(s.blocks)  # fault path: heal whatever it left
+        self._clear_slot_rows(i)
+        s.reset()
+        self.queue.append((req, t_submit))
+        self._queue_sort()
+        return rid
+
+    def _clear_slot_rows(self, i: int) -> None:
+        self._tables[i] = 0
+        self._lengths[i] = 0
+        self._last_tok[i] = 0
+        self._temps[i] = 0.0
+        self._top_k[i] = self.cfg.vocab_size
+        self._top_p[i] = 1.0
+
     def _admit(self) -> int:
-        """FIFO admission: the head request takes the first free slot
-        whose dp group can cover its blocks.  Head-of-line blocking is
-        deliberate — skipping ahead would starve long requests."""
+        """Priority admission: the head of the (priority-ordered) queue
+        takes the first free slot whose dp group can cover its blocks.
+        When it cannot be placed, the lowest-priority running slot
+        strictly below it is preempted and admission retries; head-of-line
+        blocking WITHIN a priority class is deliberate — skipping ahead
+        would starve long requests."""
         admitted = 0
         while self.queue:
             req, t_submit = self.queue[0]
@@ -392,8 +629,12 @@ class ServingEngine:
                     slot_idx = i
                     break
             if slot_idx is None:
-                break
-            self.queue.popleft()
+                victim = self._pick_victim(req)
+                if victim is None:
+                    break
+                self._preempt(victim, req)
+                continue  # blocks and/or a slot freed: retry the head
+            self.queue.pop(0)
             blocks = self._allocs[slot_idx // self.slots_per_group].alloc(need)
             s = self._slots[slot_idx]
             s.state, s.rid, s.req, s.blocks = PREFILL, req.rid, req, blocks
@@ -401,6 +642,7 @@ class ServingEngine:
             s.off, s.generated = 0, []
             s.t_submit, s.t_admit = t_submit, time.perf_counter()
             s.ttft_s, s.tpot_s = None, []
+            s.orig_prompt_len, s.pre_gen = len(req.tokens), 0
             self._tables[slot_idx] = 0
             self._tables[slot_idx, :need] = blocks
             self._lengths[slot_idx] = 0
@@ -411,12 +653,23 @@ class ServingEngine:
                 req.top_p if req.top_p is not None else 1.0)
             self._keys[slot_idx] = np.asarray(
                 jax.random.PRNGKey(req.seed), np.uint32)
+            inj = self._inject.get(req.rid)
+            if inj is not None:
+                # drain/resume: the admitted prompt carries the already-
+                # emitted prefix; the carried key continues the stream
+                if inj.get("key") is not None:
+                    self._keys[slot_idx] = np.asarray(inj["key"], np.uint32)
+                s.orig_prompt_len = int(inj["orig_prompt_len"])
+                s.pre_gen = int(inj["pre_gen"])
             self._ev.emit(
                 "request_admitted", rid=req.rid, slot=slot_idx,
                 prompt_len=int(P), max_new_tokens=int(N), blocks=need,
+                priority=req.priority,
                 queue_wait_s=round(s.t_admit - t_submit, 6))
             admitted += 1
         return admitted
+
+    # -------------------------------------------------------------------- ticks
 
     def _masked(self, state: str) -> np.ndarray:
         """Table rows for slots NOT in ``state`` zeroed (NULL block) so a
@@ -432,6 +685,25 @@ class ServingEngine:
     def _sig(self, tokens: np.ndarray) -> tuple:
         return (tokens.shape, str(tokens.dtype), self.num_slots,
                 self.max_blocks)
+
+    def _token_poisoned(self, tok: int) -> bool:
+        """An out-of-range sampled token is the host-visible face of a
+        poisoned logit row (NaN/garbage logits cannot be told apart from a
+        legitimate argmax on the host, so chaos injects the sentinel the
+        real failure would need anyway — see resilience/chaos.py)."""
+        return not (0 <= tok < self.cfg.vocab_size)
+
+    def _poisoned_token_recover(self, i: int, tok: int) -> None:
+        s = self._slots[i]
+        self.stats["faults_detected"] += 1
+        self._ev.emit(
+            "engine_fault_detected", fault="invalid_token", slot=i,
+            rid=s.rid, token=int(tok), tick=self._tick)
+        rid = self._requeue_slot(i)
+        self.stats["faults_healed"] += 1
+        self._ev.emit(
+            "engine_recovered", fault="invalid_token", slot=i, rid=rid,
+            action="requeued", tick=self._tick)
 
     def _prefill_tick(self) -> int:
         """One ``chunk``-token slice for EVERY prefilling slot, batched in
@@ -457,6 +729,8 @@ class ServingEngine:
         self._prefill_sigs.add(("prefill",) + self._sig(tokens))
         tok = np.asarray(tok)
         keys = np.asarray(keys)
+        if self.chaos is not None:
+            tok = self.chaos.perturb_engine_tokens(self._tick, tok)
         now = time.perf_counter()
         rids = []
         for i, s in enumerate(self._slots):
@@ -465,6 +739,9 @@ class ServingEngine:
             rids.append(s.rid)
             s.off += C
             if s.off >= len(s.prompt):  # final slice: first token sampled
+                if self._token_poisoned(int(tok[i])):
+                    self._poisoned_token_recover(i, int(tok[i]))
+                    continue
                 self._keys[i] = keys[i]
                 s.state = DECODE
                 s.ttft_s = now - s.t_submit
@@ -494,9 +771,14 @@ class ServingEngine:
             self.telemetry.end_step(active_slots=n_active)
         tok = np.asarray(tok)
         keys = np.asarray(keys)
+        if self.chaos is not None:
+            tok = self.chaos.perturb_engine_tokens(self._tick, tok)
         now = time.perf_counter()
         for i, s in enumerate(self._slots):
             if s.state != DECODE:
+                continue
+            if self._token_poisoned(int(tok[i])):
+                self._poisoned_token_recover(i, int(tok[i]))
                 continue
             self._keys[i] = keys[i]
             self._lengths[i] += 1
@@ -509,41 +791,190 @@ class ServingEngine:
         self.stats["decode_slot_steps"] += n_active
         return n_active
 
+    # --------------------------------------------------------------- retirement
+
     def _maybe_retire(self, i: int, tok: int, now: float) -> None:
         s = self._slots[i]
         req = s.req
         done_eos = req.eos_id is not None and tok == req.eos_id
+        # req.max_new_tokens is the budget remaining at THIS admission (a
+        # resumed request's original total lives in the drain descriptor)
         done_len = len(s.generated) >= req.max_new_tokens
         if not (done_eos or done_len):
             return
+        self._finish_slot(i, "eos" if done_eos else "max_tokens", now)
+
+    def _finish_slot(self, i: int, reason: str, now: float) -> None:
+        """Terminal slot exit (EOS / max-token / cancel): record, free
+        blocks, reset — all the same tick.  Only completed requests
+        (eos / max_tokens) contribute to the latency percentiles; a
+        cancelled request's partial service would skew the SLO evidence."""
+        s = self._slots[i]
+        completed = reason in ("eos", "max_tokens")
+        new_tokens = s.pre_gen + len(s.generated)
         self.finished[s.rid] = {
             "rid": s.rid,
             "tokens": np.concatenate(
                 [s.prompt, np.asarray(s.generated, np.int32)]),
-            "prompt_len": int(len(s.prompt)),
-            "new_tokens": len(s.generated),
-            "reason": "eos" if done_eos else "max_tokens",
+            "prompt_len": int(s.orig_prompt_len),
+            "new_tokens": new_tokens,
+            "reason": reason,
+            "priority": int(s.req.priority),
+            "resumed": s.pre_gen > 0,
             "ttft_s": s.ttft_s,
             "tpot_s": list(s.tpot_s),
             "t_submit": s.t_submit,
             "t_done": now,
         }
-        self._ttfts.append(s.ttft_s)
-        self._tpots.extend(s.tpot_s)
-        self.stats["generated_tokens"] += len(s.generated)
-        self._t_first = min(self._t_first, s.t_submit)
-        self._t_last_done = max(self._t_last_done, now)
-        self._ev.emit(
-            "request_retired", rid=s.rid, slot=i,
-            reason=self.finished[s.rid]["reason"],
-            new_tokens=len(s.generated),
-            ttft_s=round(s.ttft_s, 6) if s.ttft_s is not None else None)
+        self._inject.pop(s.rid, None)
+        if completed:
+            self._ttfts.append(s.ttft_s)
+            self._tpots.extend(s.tpot_s)
+            prio = int(s.req.priority)
+            if s.ttft_s is not None:
+                self._ttfts_by_prio.setdefault(prio, []).append(s.ttft_s)
+            self._tpots_by_prio.setdefault(prio, []).extend(s.tpot_s)
+            self.stats["generated_tokens"] += len(s.generated)
+            self._t_first = min(self._t_first, s.t_submit)
+            self._t_last_done = max(self._t_last_done, now)
+            self._ev.emit(
+                "request_retired", rid=s.rid, slot=i, reason=reason,
+                new_tokens=new_tokens, priority=prio,
+                ttft_s=round(s.ttft_s, 6) if s.ttft_s is not None else None)
+        else:
+            self.stats["cancelled"] += 1
+            self._ev.emit(
+                "request_cancelled", rid=s.rid, slot=i, where="slot",
+                emitted_tokens=new_tokens, blocks_freed=len(s.blocks))
         self._allocs[i // self.slots_per_group].free(s.blocks)
-        self._tables[i] = 0
-        self._lengths[i] = 0
-        self._last_tok[i] = 0
-        self._temps[i] = 0.0
+        self._clear_slot_rows(i)
         s.reset()
+
+    def cancel(self, rid: int) -> bool:
+        """Retire request ``rid`` wherever it is — queued (removed, no
+        service) or in-flight (slot retired, blocks freed THIS tick, the
+        partial output kept in ``finished[rid]`` with reason
+        ``cancelled``).  Returns False when the rid is unknown or already
+        terminal."""
+        for idx, (req, _t) in enumerate(self.queue):
+            if req.rid == rid:
+                del self.queue[idx]
+                self.stats["cancelled"] += 1
+                self.finished[rid] = {
+                    "rid": rid,
+                    "tokens": np.asarray(req.tokens, np.int32),
+                    "prompt_len": len(req.tokens),
+                    "new_tokens": 0,
+                    "reason": "cancelled",
+                    "priority": int(req.priority),
+                    "resumed": False,
+                    "ttft_s": None,
+                    "tpot_s": [],
+                    "t_submit": _t,
+                    "t_done": time.perf_counter(),
+                }
+                self._inject.pop(rid, None)
+                self._ev.emit("request_cancelled", rid=rid, where="queued",
+                              emitted_tokens=0, blocks_freed=0)
+                return True
+        for i, s in enumerate(self._slots):
+            if s.state != FREE and s.rid == rid:
+                self._finish_slot(i, "cancelled", time.perf_counter())
+                return True
+        return False
+
+    # ------------------------------------------------------------ invariant audit
+
+    def audit(self, heal: bool = True) -> Dict[str, Any]:
+        """Per-tick block-conservation invariant check, per dp group:
+
+        - every ACTIVE slot's device-bound table row must equal its owned
+          block list (padded with NULL) — a drifted row means the next
+          compiled step would read/write another request's cache;
+        - every owned block must be live in its group's allocator
+          (``BlockAllocator.audit``'s ``unknown`` is a use-after-free)
+          and owned by exactly ONE slot;
+        - every live allocator block must be owned by some slot
+          (``orphaned`` is a leak);
+        - an inactive slot's row must be all-NULL;
+        - ``in_use + n_free == n_usable`` (conservation).
+
+        ``heal=True`` (the engine's in-``step()`` mode) repairs what it
+        finds — poisoned slots are retired + requeued for replay, orphaned
+        blocks reclaimed, stale rows zeroed — bracketed by
+        ``engine_fault_detected`` / ``engine_recovered`` events.  With
+        ``heal=False`` it only reports (the test-side conservation probe).
+        Pure host arithmetic: no device call, no new signature.
+        """
+        violations: List[Dict[str, Any]] = []
+        poisoned: List[int] = []
+        stale_rows: List[int] = []
+        orphans: Dict[int, List[int]] = {}
+        for g, alloc in enumerate(self._allocs):
+            lo, hi = g * self.slots_per_group, (g + 1) * self.slots_per_group
+            owned_lists = []
+            owner: Dict[int, int] = {}
+            for i in range(lo, hi):
+                s = self._slots[i]
+                row = self._tables[i]
+                if s.state == FREE:
+                    if row.any():
+                        violations.append(
+                            {"kind": "stale_table_row", "slot": i})
+                        stale_rows.append(i)
+                    continue
+                owned_lists.append(s.blocks)
+                want = np.zeros(self.max_blocks, np.int32)
+                want[:len(s.blocks)] = s.blocks
+                if not np.array_equal(row, want):
+                    violations.append({
+                        "kind": "table_mismatch", "slot": i, "rid": s.rid,
+                        "row": row.tolist(), "owned": list(s.blocks)})
+                    poisoned.append(i)
+                for b in s.blocks:
+                    if b in owner:
+                        violations.append({
+                            "kind": "shared_block", "block": int(b),
+                            "slots": [owner[b], i]})
+                        if i not in poisoned:
+                            poisoned.append(i)
+                    owner[b] = i
+            rep = alloc.audit(owned_lists)
+            if rep["orphaned"]:
+                violations.append({
+                    "kind": "orphaned_blocks", "group": g,
+                    "blocks": rep["orphaned"]})
+                orphans[g] = rep["orphaned"]
+            for b in rep["unknown"]:
+                violations.append({
+                    "kind": "unowned_block", "group": g, "block": int(b)})
+                for i in range(lo, hi):
+                    if b in self._slots[i].blocks and i not in poisoned:
+                        poisoned.append(i)
+            if not rep["conserved"]:
+                violations.append({
+                    "kind": "conservation", "group": g,
+                    "in_use": rep["in_use"], "n_free": rep["n_free"],
+                    "n_usable": alloc.n_usable})
+        if violations and heal:
+            self.stats["faults_detected"] += len(violations)
+            self._ev.emit(
+                "engine_fault_detected", fault="invariant_audit",
+                tick=self._tick, n_violations=len(violations),
+                kinds=sorted({v["kind"] for v in violations}),
+                slots=sorted(poisoned))
+            requeued = [self._requeue_slot(i) for i in sorted(poisoned)]
+            for i in stale_rows:
+                self._tables[i] = 0
+            reclaimed = 0
+            for g, blocks in orphans.items():
+                reclaimed += len(self._allocs[g].reclaim(blocks))
+            self.stats["faults_healed"] += len(violations)
+            self._ev.emit(
+                "engine_recovered", fault="invariant_audit",
+                tick=self._tick, requeued_rids=requeued,
+                blocks_reclaimed=reclaimed)
+        return {"ok": not violations, "violations": violations}
 
     # -------------------------------------------------------------- driver API
 
@@ -552,9 +983,16 @@ class ServingEngine:
         return sum(s.state != FREE for s in self._slots)
 
     def step(self) -> Dict[str, int]:
-        """One engine tick: admit -> one prefill slice -> one decode step.
-        Returns what happened (all zeros = idle)."""
+        """One engine tick: chaos hook -> invariant audit (heal) -> expiry
+        -> admit (with preemption) -> one prefill slice -> one decode
+        step.  Returns what happened (all zeros = idle)."""
+        t0 = time.perf_counter()
         self._tick += 1
+        if self.chaos is not None:
+            self.chaos.before_engine_tick(self._tick, self)
+        self.stats["audits"] += 1
+        self.audit(heal=True)
+        expired = self._expire_queue(time.perf_counter())
         admitted = self._admit()
         prefilled = self._prefill_tick()
         decoded = self._decode_tick()
@@ -567,33 +1005,238 @@ class ServingEngine:
             self._ev.emit(
                 "slots_snapshot", tick=self._tick, busy=busy,
                 queued=len(self.queue), pool_utilization=round(util, 4))
+        if self.watchdog is not None:
+            self.watchdog.beat(self._tick)
+        if decoded:
+            dt = time.perf_counter() - t0
+            self._tick_ewma = (
+                dt if self._tick_ewma is None
+                else 0.8 * self._tick_ewma + 0.2 * dt)
         return {"admitted": admitted, "prefill_slots": prefilled,
-                "decode_slots": decoded, "busy": busy}
+                "decode_slots": decoded, "busy": busy, "expired": expired}
 
-    def run_until_idle(self, max_ticks: int = 100_000) -> None:
-        """Drain the queue and every in-flight slot."""
+    def run_until_idle(
+        self,
+        max_ticks: int = 100_000,
+        stop: Optional[Any] = None,
+        persist_path: Optional[str] = None,
+    ) -> None:
+        """Drain the queue and every in-flight slot.  ``stop`` is a
+        :class:`~..utils.preemption.GracefulShutdown` (or anything with a
+        ``requested`` flag): when it trips mid-loop the engine performs a
+        preemption-safe :meth:`drain` (persisting to ``persist_path`` when
+        given) instead of finishing the work — the SLURM SIGTERM
+        contract."""
         while self.queue or self.n_busy:
+            if stop is not None and getattr(stop, "requested", False):
+                self.drain(persist_path=persist_path)
+                return
             self.step()
             if self._tick > max_ticks:
                 raise RuntimeError(
                     f"engine did not drain within {max_ticks} ticks "
                     f"(queued={len(self.queue)}, busy={self.n_busy})")
 
+    # ----------------------------------------------------------- drain / resume
+
+    def _descriptor(self, req: Request, *, emitted: Sequence[int],
+                    key: Optional[np.ndarray],
+                    orig_prompt_len: int, pre_gen: int) -> Dict[str, Any]:
+        """One restartable request descriptor.  ``prompt`` is the ORIGINAL
+        prompt; ``emitted`` every token produced so far (a resume prefix
+        the admitted prompt carried, plus this engine's output);
+        ``key`` the carried PRNG key that samples the NEXT token."""
+        prompt = [int(t) for t in req.tokens]
+        pre = prompt[orig_prompt_len:]
+        # req.max_new_tokens is the budget REMAINING at this admission;
+        # the descriptor records the original total so a chain of
+        # drain/resume cycles never inflates or shrinks the request
+        return {
+            "prompt": prompt[:orig_prompt_len],
+            "emitted": [int(t) for t in pre] + [int(t) for t in emitted],
+            "max_new_tokens": int(req.max_new_tokens) + pre_gen,
+            "temperature": float(req.temperature),
+            "top_k": req.top_k,
+            "top_p": req.top_p,
+            "eos_id": req.eos_id,
+            "seed": int(req.seed),
+            "priority": int(req.priority),
+            "deadline_s": req.deadline_s,
+            "orig_rid": int(req.rid),
+            "key": None if key is None else [int(v) for v in key],
+        }
+
+    def drain(self, persist_path: Optional[str] = None) -> Dict[str, Any]:
+        """Preemption-safe shutdown: stop admitting (subsequent submits
+        are shed with reason ``draining``) and unwind every in-flight slot
+        and queued request into restartable descriptors — prompt, emitted
+        tokens, sampling params, the carried PRNG key.  Blocks are freed
+        and slots reset, so the engine is idle afterwards.
+
+        ``persist_path`` writes the payload as JSON plus a
+        ``<path>.manifest.json`` SHA-256 sidecar (the ``ckpt_guard``
+        verify-before-restore idiom — :meth:`resume` refuses bytes that
+        rotted on disk).  Returns the payload either way; a restarted
+        engine replays it with :meth:`resume`."""
+        self._draining = True
+        descs: List[Dict[str, Any]] = []
+        n_inflight = 0
+        for i, s in enumerate(self._slots):
+            if s.state == FREE:
+                continue
+            n_inflight += 1
+            # an in-flight DECODE slot's carried key samples its next
+            # token; a PREFILL slot has emitted nothing, so the admission
+            # key (from the seed / a prior injection) reproduces it
+            key = (np.array(self._keys[i], copy=True)
+                   if s.state == DECODE else None)
+            inj = self._inject.get(s.rid)
+            if key is None and inj is not None and inj.get("key") is not None:
+                key = np.asarray(inj["key"], np.uint32)
+            descs.append(self._descriptor(
+                s.req, emitted=s.generated, key=key,
+                orig_prompt_len=s.orig_prompt_len, pre_gen=s.pre_gen))
+            alloc = self._allocs[i // self.slots_per_group]
+            try:
+                alloc.free(s.blocks)
+            except ValueError:
+                alloc.reclaim(s.blocks)
+            self._clear_slot_rows(i)
+            self._inject.pop(s.rid, None)
+            s.reset()
+        n_queued = len(self.queue)
+        for req, _t in self.queue:
+            inj = self._inject.pop(req.rid, None)
+            descs.append(self._descriptor(
+                req, emitted=[],
+                key=(np.asarray(inj["key"], np.uint32)
+                     if inj and inj.get("key") is not None else None),
+                orig_prompt_len=(inj["orig_prompt_len"] if inj
+                                 else len(req.tokens)),
+                pre_gen=inj["pre_gen"] if inj else 0))
+        self.queue = []
+        payload = {"schema": DRAIN_SCHEMA, "n": len(descs),
+                   "requests": descs}
+        if persist_path is not None:
+            self._persist_drain(persist_path, payload)
+        self._ev.emit(
+            "engine_drained", n_inflight=n_inflight, n_queued=n_queued,
+            persisted=persist_path is not None, path=persist_path)
+        return payload
+
+    @staticmethod
+    def _persist_drain(path: str, payload: Dict[str, Any]) -> None:
+        import json
+        import os
+
+        from ..resilience.ckpt_guard import _sha256
+
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        manifest = {
+            "schema": DRAIN_SCHEMA + "-manifest",
+            "size": os.path.getsize(path),
+            "sha256": _sha256(path),
+        }
+        mtmp = path + ".manifest.json.tmp"
+        with open(mtmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(mtmp, path + ".manifest.json")
+
+    def resume(self, source: Any) -> List[int]:
+        """Re-submit a drain payload (a dict from :meth:`drain`, or a path
+        it persisted — verified against its SHA-256 manifest BEFORE
+        parsing, the ``ckpt_guard`` contract).  Each in-flight descriptor
+        is replayed as prompt + emitted-prefix through the ordinary
+        chunked prefill with its carried key injected, so the token stream
+        continues exactly where the drained engine stopped (temp-0:
+        exact-trajectory; sampled: same key stream).  Returns the new
+        rids, in descriptor order."""
+        if isinstance(source, str):
+            source = self._load_drain(source)
+        if not isinstance(source, dict) or source.get("schema") != DRAIN_SCHEMA:
+            raise ValueError(
+                f"not a {DRAIN_SCHEMA} payload: "
+                f"{type(source).__name__}/{(source or {}).get('schema')!r}")
+        self._draining = False
+        rids: List[int] = []
+        for d in source["requests"]:
+            emitted = [int(t) for t in d.get("emitted") or []]
+            remaining = int(d["max_new_tokens"]) - len(emitted)
+            req = Request(
+                tokens=[int(t) for t in d["prompt"]] + emitted,
+                max_new_tokens=max(1, remaining),
+                temperature=float(d.get("temperature", 0.0)),
+                top_k=d.get("top_k"),
+                top_p=d.get("top_p"),
+                eos_id=d.get("eos_id"),
+                seed=int(d.get("seed", 0)),
+                priority=int(d.get("priority", 0)),
+                deadline_s=d.get("deadline_s"),
+            )
+            rid = self.submit(req)
+            if rid in self.rejected:
+                rids.append(rid)
+                continue
+            if emitted or d.get("key") is not None:
+                self._inject[rid] = {
+                    "key": (np.asarray(d["key"], np.uint32)
+                            if d.get("key") is not None else None),
+                    "orig_prompt_len": len(d["prompt"]),
+                    "pre_gen": len(emitted),
+                }
+            self.stats["resumed"] += 1
+            rids.append(rid)
+        return rids
+
+    @staticmethod
+    def _load_drain(path: str) -> Dict[str, Any]:
+        import json
+        import os
+
+        from ..resilience.ckpt_guard import CheckpointCorruptError, _sha256
+
+        mpath = path + ".manifest.json"
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                manifest = json.load(f)
+            size = os.path.getsize(path)
+            if size != manifest.get("size"):
+                raise CheckpointCorruptError(
+                    f"drain payload {path}: size {size} != manifest "
+                    f"{manifest.get('size')}")
+            digest = _sha256(path)
+            if digest != manifest.get("sha256"):
+                raise CheckpointCorruptError(
+                    f"drain payload {path}: sha256 mismatch")
+        with open(path) as f:
+            return json.load(f)
+
+    # ------------------------------------------------------------------ metrics
+
     def reset_metrics(self) -> None:
         """Zero the serving metrics (the bench's warmup/measure split);
         compiled steps, pool, and queue state are untouched."""
         self.stats = {"decode_steps": 0, "prefill_chunks": 0,
-                      "decode_slot_steps": 0, "generated_tokens": 0}
+                      "decode_slot_steps": 0, "generated_tokens": 0,
+                      "shed": 0, "expired": 0, "cancelled": 0,
+                      "preempted": 0, "resumed": 0, "faults_detected": 0,
+                      "faults_healed": 0, "audits": 0}
         self._decode_sigs: set = set()
         self._prefill_sigs: set = set()
         self._ttfts: List[float] = []
         self._tpots: List[float] = []
+        self._ttfts_by_prio: Dict[int, List[float]] = {}
+        self._tpots_by_prio: Dict[int, List[float]] = {}
         self._tick = 0
         self._occ_sum = self._util_sum = 0.0
         self._occ_ticks = 0
         self._t_first = float("inf")
         self._t_last_done = 0.0
         self.finished = {}
+        self.rejected = {}
         for a in self._allocs:
             a.peak_in_use = a.in_use
 
@@ -601,20 +1244,54 @@ class ServingEngine:
 
     def serving_summary(self) -> Dict[str, Any]:
         """The RUNREPORT ``serving`` section (``Telemetry.record_serving``
-        attaches it; ``validate_runreport`` checks it)."""
+        attaches it; ``validate_runreport`` checks it).  On top of the
+        PR-5 aggregates: per-priority TTFT/TPOT percentiles, the
+        shed/preempt/expire/cancel counters, the fault-audit evidence,
+        and the ``healthy | degraded | overloaded`` verdict — overloaded
+        when demand was refused (shed/expired), degraded when the engine
+        had to preempt or heal faults to keep serving, healthy otherwise.
+        """
         span = self._t_last_done - self._t_first
-        n_req = len(self.finished)
+        completed = sum(
+            1 for f in self.finished.values()
+            if f["reason"] in ("eos", "max_tokens"))
         peak_util = max(a.peak_in_use for a in self._allocs) / (
             self._allocs[0].n_usable)
+        st = self.stats
+        if st["shed"] + st["expired"] > 0:
+            verdict = "overloaded"
+        elif st["preempted"] + st["faults_detected"] > 0:
+            verdict = "degraded"
+        else:
+            verdict = "healthy"
+        priorities = {
+            str(p): {
+                "completed": len(self._ttfts_by_prio.get(p, [])),
+                "ttft_s": percentiles(self._ttfts_by_prio.get(p, [])),
+                "tpot_s": percentiles(self._tpots_by_prio.get(p, [])),
+            }
+            for p in sorted(
+                set(self._ttfts_by_prio) | set(self._tpots_by_prio))
+        }
         return {
-            "requests": {"completed": n_req, "queued": len(self.queue),
-                         "in_flight": self.n_busy},
-            "generated_tokens": self.stats["generated_tokens"],
+            "requests": {"completed": completed, "queued": len(self.queue),
+                         "in_flight": self.n_busy,
+                         "shed": st["shed"], "expired": st["expired"],
+                         "cancelled": st["cancelled"],
+                         "preempted": st["preempted"],
+                         "resumed": st["resumed"]},
+            "generated_tokens": st["generated_tokens"],
             "tokens_per_sec": (
-                self.stats["generated_tokens"] / span
-                if span > 0 and n_req else 0.0),
+                st["generated_tokens"] / span
+                if span > 0 and completed else 0.0),
             "ttft_s": percentiles([t for t in self._ttfts if t is not None]),
             "tpot_s": percentiles(self._tpots),
+            "priorities": priorities,
+            "verdict": verdict,
+            "faults": {"detected": st["faults_detected"],
+                       "healed": st["faults_healed"],
+                       "audits": st["audits"]},
+            "drained": self._draining,
             "slot_occupancy": {
                 "mean": (self._occ_sum / self._occ_ticks
                          if self._occ_ticks else 0.0),
@@ -635,14 +1312,15 @@ class ServingEngine:
                     self.cfg, self.dp * self.num_blocks, self.block_size,
                     quantized=self.kv_quant),
             },
-            "decode_steps": self.stats["decode_steps"],
-            "prefill_chunks": self.stats["prefill_chunks"],
+            "decode_steps": st["decode_steps"],
+            "prefill_chunks": st["prefill_chunks"],
             "decode_batch_mean": (
-                self.stats["decode_slot_steps"] / self.stats["decode_steps"]
-                if self.stats["decode_steps"] else 0.0),
+                st["decode_slot_steps"] / st["decode_steps"]
+                if st["decode_steps"] else 0.0),
             # compile-once evidence: distinct device-call signatures the
             # engine issued (must be 1 per phase however many requests of
-            # whatever shapes were served)
+            # whatever shapes were served — priorities, preemptions,
+            # faults, and drains included)
             "decode_signatures": len(self._decode_sigs),
             "prefill_signatures": len(self._prefill_sigs),
         }

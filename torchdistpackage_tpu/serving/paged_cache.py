@@ -374,3 +374,70 @@ class BlockAllocator:
                     f"freeing block {b} not handed out by this allocator")
             self._live.discard(b)
             self._free.append(b)
+
+    # ------------------------------------------------- conservation audit
+
+    def audit(self, slot_tables) -> Dict[str, Any]:
+        """Block-conservation audit against the slots' owned-block lists
+        (the engine calls this every tick; ``tests`` call it after every
+        lifecycle transition).  ``slot_tables`` is one block sequence per
+        LIVE slot — the host-side ownership records the allocator's
+        ``_live`` set must agree with exactly:
+
+        - ``orphaned``: blocks the allocator counts in use that no slot
+          references (a leak — e.g. a retirement that forgot to free);
+        - ``unknown``: blocks a slot references that the allocator says
+          are free/never-allocated (a use-after-free — the slot would
+          read another request's cache once the block is rehanded out);
+        - ``shared``: blocks referenced by more than one slot (ownership
+          must be disjoint or scatters collide);
+        - ``conserved``: ``in_use + n_free == n_usable`` with no
+          duplicate or live entry on the free list.
+
+        ``ok`` iff all four are clean.  Pure host arithmetic, O(blocks).
+        """
+        import collections as _c
+
+        counts = _c.Counter(
+            int(b) for t in slot_tables for b in t if int(b) != NULL_BLOCK)
+        refset = set(counts)
+        free_set = set(self._free)
+        report = {
+            "orphaned": sorted(self._live - refset),
+            "unknown": sorted(refset - self._live),
+            "shared": sorted(b for b, c in counts.items() if c > 1),
+            "conserved": (
+                len(self._live) + len(self._free) == self.n_usable
+                and len(free_set) == len(self._free)
+                and not (free_set & self._live)
+                and NULL_BLOCK not in free_set
+                and NULL_BLOCK not in self._live
+            ),
+            "in_use": self.in_use,
+            "n_free": self.n_free,
+        }
+        report["ok"] = (
+            report["conserved"]
+            and not report["orphaned"]
+            and not report["unknown"]
+            and not report["shared"]
+        )
+        return report
+
+    def reclaim(self, blocks) -> List[int]:
+        """Force-return ``blocks`` to the free list whatever state they are
+        in — the self-healing half of :meth:`audit` (``free`` raises on
+        exactly the inconsistencies a fault creates).  Returns the blocks
+        actually recovered; NULL and already-free blocks are no-ops."""
+        healed = []
+        free_set = set(self._free)
+        for b in blocks:
+            b = int(b)
+            if b == NULL_BLOCK or not (0 < b < self.num_blocks):
+                continue
+            self._live.discard(b)
+            if b not in free_set:
+                self._free.append(b)
+                free_set.add(b)
+                healed.append(b)
+        return healed

@@ -40,8 +40,13 @@ from typing import Any, Dict, List, Tuple
 #: the TOTAL across dimensions (``comm_bytes=``), so a regression that
 #: re-inflates a compressed collective's bytes shows up in the trend next
 #: to the throughput it would eventually cost.
+#: ``shed_rate`` / ``preempt_count`` (PR 9) ride the ``serve-overload``
+#: line: the gate trends overloaded goodput (``value``), and these
+#: columns show whether a goodput hold was bought by shedding more —
+#: a scheduler regression that the headline alone would hide.
 AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
-            "grad_norm_final", "comm_bytes_per_dim")
+            "grad_norm_final", "comm_bytes_per_dim", "shed_rate",
+            "preempt_count")
 
 
 def _aux_str(key: str, val: Any) -> str:

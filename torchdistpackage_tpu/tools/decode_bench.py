@@ -30,6 +30,14 @@ batching exists for.  Emits ``serve-latency`` JSON lines (TTFT/TPOT
 percentiles, same schema as the per-phase cells), an aggregate
 serve-vs-sequential speedup line, and the RUNREPORT ``serving`` section.
 
+``--serve --overload`` adds the stress arm: the same compiled engine
+replayed at ~2x its just-measured capacity with mixed priorities and
+low-priority deadlines.  One ``serve-overload`` JSON line carries the
+gating ``value`` (overloaded aggregate tokens/s) plus ``shed_rate``,
+``preempt_count`` and per-priority p99 TTFT (``tools/bench_trend``
+trends all three), and the RUNREPORT ``serving`` section records the
+overload-vs-uncontended A/B (docs/serving.md "Serving under stress").
+
 ``--trace out.json`` additionally prints the comm-ledger summary of the
 compiled decode step (one extra AOT compile) and writes the run's
 Perfetto-loadable Chrome trace — cells appear as instant events on the
@@ -135,15 +143,114 @@ def _phase_lines(B, ctx, variant, prefill_s, decode_s):
     return out
 
 
+def _overload_arm(jax, jnp, cfg, params, tel, eng, base_summary, *,
+                  n_requests, num_slots, seed, smoke):
+    """The stress A/B: replay arrivals at ~2x the engine's MEASURED
+    capacity with mixed priorities and low-priority deadlines, against
+    the uncontended numbers ``bench_serve`` just produced on the SAME
+    compiled engine.  The claim under test (docs/serving.md "Serving
+    under stress"): high-priority p99 TTFT holds near its uncontended
+    value while low-priority requests shed/expire/preempt with structured
+    events — bounded, observable degradation instead of collapse.
+
+    Emits one ``serve-overload`` JSON line whose ``value`` is the
+    overloaded aggregate tokens/s (the gate ``bench_trend`` trends) with
+    ``shed_rate`` / ``preempt_count`` aux columns and per-priority p99
+    TTFT; returns the overload ``serving_summary()`` with the
+    ``overload_ab`` comparison attached (the RUNREPORT evidence)."""
+    import numpy as np
+
+    from ..serving import Request
+    from ..utils.logging import master_print
+
+    rng = np.random.RandomState(seed + 1)
+    p_lens = [4, 8] if smoke else [16, 32, 64]
+    n_lens = [8, 12] if smoke else [8, 16, 32]
+    mean_new = float(np.mean(n_lens))
+    cap_tok_s = max(base_summary["tokens_per_sec"], 1e-6)
+    # request service rate the uncontended arm measured -> 2x arrivals
+    interval = mean_new / cap_tok_s / 2.0
+    # low-priority deadline: a handful of uncontended mean-TTFT budgets —
+    # generous when the engine keeps up, unmeetable once 2x demand queues
+    base_ttft = (base_summary.get("ttft_s") or {}).get("p50") or interval
+    deadline = 8.0 * max(base_ttft, interval)
+
+    eng.reset_metrics()
+    eng.max_queue = 2 * num_slots
+    sched, t = [], 0.0
+    for i in range(n_requests):
+        P, N = int(rng.choice(p_lens)), int(rng.choice(n_lens))
+        prompt = rng.randint(0, cfg.vocab_size, size=P).tolist()
+        t += float(rng.exponential(scale=interval))
+        prio = int(rng.choice([0, 0, 2]))  # 1/3 high-priority traffic
+        sched.append((t, Request(
+            prompt, N, priority=prio,
+            deadline_s=None if prio else deadline)))
+
+    pending = list(sched)
+    t0 = time.perf_counter()
+    while pending or eng.n_busy or eng.queue:
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            eng.submit(pending.pop(0)[1])
+        if not (eng.n_busy or eng.queue):
+            time.sleep(min(1e-3, max(0.0, pending[0][0] - now)))
+            continue
+        eng.step()
+    eng.max_queue = None
+    summary = eng.serving_summary()
+
+    reqs = summary["requests"]
+    refused = reqs["shed"] + reqs["expired"]
+    shed_rate = refused / n_requests
+    base_prio = base_summary.get("priorities") or {}
+    over_prio = summary.get("priorities") or {}
+
+    def p99(prios, p):
+        return ((prios.get(str(p)) or {}).get("ttft_s") or {}).get("p99")
+
+    line = {
+        "metric": "serve-overload",
+        # the trend gate: aggregate goodput under 2x arrivals (a scheduler
+        # regression shows up here before anything else)
+        "value": round(summary["tokens_per_sec"], 1),
+        "n_requests": n_requests, "num_slots": num_slots,
+        "arrival_x_capacity": 2.0,
+        "shed_rate": round(shed_rate, 4),
+        "preempt_count": reqs["preempted"],
+        "expired": reqs["expired"],
+        "verdict": summary["verdict"],
+        "decode_signatures": summary["decode_signatures"],
+    }
+    ab = {"arrival_x_capacity": 2.0, "shed_rate": round(shed_rate, 4),
+          "priorities": {}}
+    agg_u = (base_summary.get("ttft_s") or {}).get("p99")
+    for p in sorted({int(k) for k in over_prio} | {int(k) for k in base_prio}):
+        # the uncontended arm serves every request at full attention, so
+        # its aggregate p99 stands in for classes it didn't label
+        u, o = p99(base_prio, p) or agg_u, p99(over_prio, p)
+        row = {"uncontended_p99_ttft_s": u, "overloaded_p99_ttft_s": o}
+        if o:
+            line[f"ttft_p99_ms_prio{p}"] = round(o * 1e3, 4)
+        if u and o:
+            row["ratio"] = round(o / u, 3)
+        ab["priorities"][str(p)] = row
+    summary["overload_ab"] = ab
+    master_print(json.dumps(line), flush=True)
+    return summary
+
+
 def bench_serve(jax, jnp, cfg, params, tel, *, n_requests, num_slots,
-                block_size, chunk, seed, smoke):
+                block_size, chunk, seed, smoke, overload=False):
     """Continuous batching vs sequential batch-of-1 ``generate()`` at
     EQUAL params, over a fixed-seed Poisson-ish arrival schedule with
     mixed prompt/output lengths — the traffic shape the engine exists
     for.  Both arms replay the identical schedule (a request cannot start
     before its arrival time) with compiles warmed up-front, so the
     speedup line measures scheduling, not tracing.  Returns the engine's
-    ``serving_summary()`` plus the baseline numbers."""
+    ``serving_summary()`` plus the baseline numbers.  ``overload=True``
+    adds the stress arm (:func:`_overload_arm`): the same engine replayed
+    at ~2x its just-measured capacity with mixed priorities/deadlines."""
     import numpy as np
 
     from ..models import generate
@@ -239,6 +346,15 @@ def bench_serve(jax, jnp, cfg, params, tel, *, n_requests, num_slots,
         **_mem_cols(),
     }), flush=True)
     summary["sequential_tok_s"] = seq_tok_s
+    if overload:
+        # the RUNREPORT carries the STRESS arm (with the uncontended
+        # comparison attached as overload_ab) — that is the arm whose
+        # verdict/shedding evidence this mode exists to produce
+        summary = _overload_arm(
+            jax, jnp, cfg, params, tel, eng, summary,
+            n_requests=n_requests, num_slots=num_slots, seed=seed,
+            smoke=smoke)
+        summary["sequential_tok_s"] = seq_tok_s
     tel.record_serving(summary)
     return summary
 
@@ -258,6 +374,13 @@ def _parse_args(argv=None):
                     help="bench the continuous-batching engine against the "
                          "sequential batch-of-1 generate() baseline "
                          "(replaces the weight-quant cells)")
+    ap.add_argument("--overload", action="store_true",
+                    help="with --serve: add the stress arm — arrivals at "
+                         "~2x the measured capacity with mixed priorities "
+                         "and deadlines; emits the serve-overload line "
+                         "(shed_rate, preempt_count, per-priority p99 "
+                         "TTFT) and records the overload A/B in the "
+                         "RUNREPORT serving section")
     ap.add_argument("--serve-requests", type=int, default=None,
                     metavar="N", help="requests in the --serve schedule "
                     "(default: 8 smoke / 24 full)")
@@ -347,7 +470,12 @@ def main(argv=None):
             jax, jnp, cfg, params, tel,
             n_requests=args.serve_requests or (12 if smoke else 24),
             num_slots=args.slots, block_size=args.block_size,
-            chunk=args.chunk, seed=args.seed, smoke=smoke)
+            chunk=args.chunk, seed=args.seed, smoke=smoke,
+            overload=args.overload)
+    elif args.overload:
+        master_print("decode_bench: --overload needs --serve",
+                     file=sys.stderr)
+        return 2
     for B, ctx in cells:
         r_bf, pre_bf, dec_bf = bench_decode(jax, jnp, cfg, params, B, ctx,
                                             steps, reps)
