@@ -153,6 +153,13 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
             # the SIGTERM demo drained and its events hit the timeline
             assert "engine_drained" in kinds, kinds
             assert "preemption" in kinds, kinds  # the real signal arrived
+            # the fast-path phase: shared-system-prompt traffic hit the
+            # prefix cache and the speculative engine drove the run the
+            # report records (hit/accept rates validated in [0, 1])
+            assert srv["prefix_hit_rate"] > 0, srv
+            assert 0.0 <= srv["spec_accept_rate"] <= 1.0, srv
+            assert srv["spec"]["k"] >= 1, srv
+            assert {"prefix_hit", "spec_draft", "spec_verify"} <= kinds, kinds
 
     if probe.get("memory"):
         # the PR-6 memory section: per-program static breakdown captured

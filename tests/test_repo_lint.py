@@ -371,6 +371,27 @@ def test_stress_event_kinds_registered_and_emitted():
     assert set(ENGINE_FAULT_KINDS) <= set(FAULT_KINDS)
 
 
+def test_fastpath_event_kinds_registered_and_emitted():
+    """The serving fast-path kinds (PR 10) are in the registry AND each
+    is actually emitted from ``serving/`` — the prefix-cache hit/COW/
+    eviction trail and the speculative draft/verify pair are the
+    evidence the hit-rate and accept-rate summary fields (and the
+    bench_trend AUX columns) are built on; a kind that stopped being
+    emitted would silently zero them."""
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+
+    fast_kinds = {
+        "prefix_hit", "block_cow", "spec_draft", "spec_verify",
+        "cache_evict",
+    }
+    assert fast_kinds <= EVENT_KINDS
+    emitted = set()
+    for path in sorted((PKG / "serving").rglob("*.py")):
+        emitted.update(k for _, k in _emit_call_kinds(path))
+    missing = fast_kinds - emitted
+    assert not missing, f"fast-path kinds never emitted from serving/: {missing}"
+
+
 # ------------------------------------------- silent exception swallowing
 
 # `except: pass` / `except Exception: pass` swallows the very faults the

@@ -1,0 +1,478 @@
+"""Serving fast path: refcounted prefix cache + copy-on-write blocks +
+static-k speculative decoding (PR 10).
+
+The load-bearing claims, asserted against goldens / the event timeline:
+
+- a warm shared-prefix admission maps resident blocks instead of
+  re-prefilling (prefill ticks drop, ``prefix_hit`` event) and still
+  emits tokens BIT-equal to the cold ``generate()`` golden — including
+  the whole-prompt-cached case, which copy-on-writes its last block
+  (``block_cow``), and with TWO concurrent writers COWing the same
+  source block;
+- sharing never breaks block conservation: retire/preempt/cancel on a
+  shared block decrement rather than free (the co-owner keeps decoding
+  bit-exactly), the refcount-aware audit passes every tick — including
+  under the PR-9 ``table_corrupt`` / ``alloc_exhaust`` chaos faults —
+  and refcount-0 cached blocks are evicted LRU only under pressure
+  (``cache_evict``);
+- temp-0 speculative decode is token-bitwise-identical to
+  non-speculative decode (the dense engine here; GQA + sliding-window
+  via per-family bundles), the hot loop stays at ONE decode signature
+  (the verify program at fixed k), and a drained speculative in-flight
+  request resumes to exact temp-0 parity;
+- ``estimate_ttft`` subtracts already-resident prefill chunks (warm vs
+  cold queue), so the PR-9 deadline gate does not shed warm traffic.
+
+Everything dense rides ONE module-scope engine (3 slots, 10 usable
+blocks, ``prefix_cache=True, spec_k=2``); the family matrix adds two
+lazily-built bundles — a handful of compiled programs for the file."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchdistpackage_tpu.models import (
+    GPTConfig,
+    generate,
+    init_gpt_params,
+    llama_config,
+)
+from torchdistpackage_tpu.obs.events import EventLog, set_default_event_log
+from torchdistpackage_tpu.obs.report import _validate_serving
+from torchdistpackage_tpu.resilience import ChaosMonkey, Fault
+from torchdistpackage_tpu.serving import BlockAllocator, Request, ServingEngine
+from torchdistpackage_tpu.serving.paged_cache import chain_block_hashes
+
+CFG = GPTConfig(vocab_size=64, dim=32, nheads=4, nlayers=2, max_seq=32)
+BS, CHUNK, K = 4, 4, 2
+NEW = 6
+P8 = 8                      # two FULL blocks: the whole-prompt/COW case
+USABLE = 10                 # need/req = ceil((8+6+2)/4) = 4 with spec slack
+
+FAMILY_CFGS = {
+    "gqa": llama_config(vocab_size=64, dim=32, nheads=4, nlayers=2,
+                        max_seq=32, kv_heads=2, ffn_hidden=48,
+                        dtype=jnp.float32),
+    "sliding": llama_config(vocab_size=64, dim=32, nheads=4, nlayers=2,
+                            max_seq=32, kv_heads=2, ffn_hidden=48,
+                            dtype=jnp.float32, sliding_window=6),
+}
+
+
+def _prompt(seed, n=P8, cfg=CFG):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, cfg.vocab_size)).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def fp():
+    """Shared params, the P8 golden, and ONE prefix+spec engine."""
+    params = init_gpt_params(jax.random.PRNGKey(0), CFG)
+    gold = jax.jit(lambda p, t: generate(p, t, CFG, max_new_tokens=NEW))
+
+    def want(prompt):
+        return np.asarray(gold(params, jnp.asarray(prompt)[None]))[0]
+
+    eng = ServingEngine(params, CFG, num_slots=3, block_size=BS,
+                        chunk=CHUNK, num_blocks=USABLE + 1,
+                        prefix_cache=True, spec_k=K)
+    return {"params": params, "eng": eng, "want": want}
+
+
+@pytest.fixture()
+def event_log(fp):
+    log = EventLog()
+    set_default_event_log(log)
+    fp["eng"]._ev = log
+    yield log
+    set_default_event_log(None)
+
+
+def _fresh(eng):
+    """Between tests: no live work, and every block either free or
+    CACHED (prefix retention is deliberate cross-test state; a leaked
+    refcount is not)."""
+    assert eng.n_busy == 0 and not eng.queue, "previous test leaked state"
+    for a in eng._allocs:
+        assert a.in_use == 0, "previous test leaked block refcounts"
+        assert a.n_free + a.n_cached == a.n_usable, "blocks went missing"
+    eng.reset_metrics()
+    eng.chaos = None
+    eng._draining = False
+    eng._tick_ewma = None
+    eng._inject.clear()
+    return eng
+
+
+def _run_audited(eng):
+    while eng.queue or eng.n_busy:
+        eng.step()
+        rep = eng.audit(heal=False)
+        assert rep["ok"], (eng._tick, rep["violations"])
+        assert eng._tick < 300
+
+
+# ------------------------------------------------------- allocator unit
+
+
+def test_allocator_refcounts_share_cache_evict():
+    a = BlockAllocator(8)
+    got = a.alloc(3)
+    a.register(got[0], "h0")
+    a.register(got[1], "h1")
+    assert a.match(["h0", "h1"]) == got[:2]
+    assert a.match(["h0", "hX", "h1"]) == got[:1]  # longest PREFIX only
+
+    # share bumps the refcount: two frees to release; audit wants the
+    # reference count to EQUAL the refcount (legal sharing), and flags
+    # a mismatch as `shared`
+    a.share(got[0])
+    assert a.audit([got, [got[0]]])["ok"]
+    rep = a.audit([got])  # one reference, refcount 2
+    assert not rep["ok"] and rep["shared"] == [got[0]]
+    a.free([got[0]])
+    assert a.in_use == 3  # still owned once
+    assert a.audit([got])["ok"]
+
+    # release: registered blocks go to the cached LRU, not the free list
+    a.free(got)
+    assert a.in_use == 0 and a.n_cached == 2
+    assert a.n_free + a.n_cached == a.n_usable
+    assert a.audit([])["ok"]  # conservation counts cached blocks
+
+    # a cached block revives via share (off the LRU, refcount 1)
+    a.share(got[1])
+    assert a.in_use == 1 and a.n_cached == 1
+    a.free([got[1]])
+
+    # eviction ONLY under pressure, LRU first, hashes dropped
+    rest = a.alloc(a.n_free)
+    assert a.n_cached == 2 and a.cache_evictions == 0
+    more = a.alloc(1)  # free list empty: evicts the LRU cached block
+    assert more is not None and a.cache_evictions == 1
+    assert a.pop_evicted() == [got[0]]
+    assert a.match(["h0"]) == []  # the prefix is gone with the block
+    assert a.match(["h1"]) == [got[1]]
+    a.free(rest + more)
+    # reclaim purges refcounts, cache membership, and registrations
+    healed = a.reclaim(list(range(1, 8)))
+    assert a.n_free == a.n_usable and a.n_cached == 0 and a.in_use == 0
+    assert a.match(["h1"]) == [] and healed
+    with pytest.raises(ValueError):
+        a.share(got[0])  # non-resident
+
+
+def test_warm_admission_logits_bitwise(fp):
+    """Acceptance bar, at the paged-forward level: a chunk computed
+    against a SHARED prefix block (mapped into a different table row)
+    produces logits BIT-identical to the same chunk in the cold run —
+    sharing is pure table indirection, zero numerics."""
+    from torchdistpackage_tpu.serving import init_paged_kv
+    from torchdistpackage_tpu.serving.paged_cache import paged_forward
+
+    params = fp["params"]
+    prompt = _prompt(35)  # 8 tokens = 2 chunks of 4
+    pool = init_paged_kv(CFG, 8, BS)
+    step = jax.jit(lambda c, t, tab, off: paged_forward(
+        params, t, CFG, c, tab, off, last_idx=jnp.asarray([BS - 1])))
+    cold_tab = jnp.asarray([[1, 2, 0]], jnp.int32)
+    t0 = jnp.asarray(prompt[:BS])[None]
+    t1 = jnp.asarray(prompt[BS:])[None]
+    pool, _ = step(pool, t0, cold_tab, jnp.asarray([0], jnp.int32))
+    pool, cold_logits = step(pool, t1, cold_tab, jnp.asarray([BS], jnp.int32))
+    # warm: block 1 (the shared prefix) mapped into a DIFFERENT table;
+    # the second chunk writes into a fresh block and attends through it
+    warm_tab = jnp.asarray([[1, 3, 0]], jnp.int32)
+    pool, warm_logits = step(pool, t1, warm_tab, jnp.asarray([BS], jnp.int32))
+    np.testing.assert_array_equal(
+        np.asarray(cold_logits), np.asarray(warm_logits),
+        err_msg="shared-prefix chunk logits drifted from the cold run")
+
+
+# ---------------------------------------------- warm admission + estimate
+
+
+def test_warm_prefix_hit_parity_and_prefill_savings(fp, event_log):
+    eng = _fresh(fp["eng"])
+    base = _prompt(40)
+    warm = np.concatenate([base[:BS], _prompt(41, 3)])  # shares block 0
+    cold_want, warm_want = fp["want"](base), fp["want"](warm)
+
+    r0 = eng.submit(Request(base.tolist(), NEW))
+    _run_audited(eng)
+    cold_chunks = eng.stats["prefill_chunks"]
+    np.testing.assert_array_equal(eng.finished[r0]["tokens"], cold_want)
+    assert eng.stats["prefix_hits"] == 0  # nothing resident yet
+
+    eng.reset_metrics()
+    r1 = eng.submit(Request(warm.tolist(), NEW))
+    _run_audited(eng)
+    np.testing.assert_array_equal(
+        eng.finished[r1]["tokens"], warm_want,
+        err_msg="warm prefix admission diverged from its cold run")
+    hits = event_log.of_kind("prefix_hit")
+    assert len(hits) == 1 and hits[0]["cached_tokens"] == BS
+    assert not hits[0]["cow"]
+    # prefill ticks saved ∝ hit: 7-token remainder = 2 chunks vs 2 for 8
+    assert eng.stats["prefill_chunks"] < cold_chunks
+    s = eng.serving_summary()
+    assert s["prefix_hit_rate"] == pytest.approx(BS / len(warm))
+    assert s["decode_signatures"] == 1 and s["prefill_signatures"] == 1
+    assert _validate_serving(s) == []
+    # the validator bites on out-of-range fast-path rates
+    assert any("prefix_hit_rate" in e for e in _validate_serving(
+        dict(s, prefix_hit_rate=2.0)))
+    assert any("spec" in e for e in _validate_serving(
+        dict(s, spec={"drafted": 1, "accepted": 2})))
+
+
+def test_estimate_ttft_warm_vs_cold_queue(fp):
+    """Satellite: admission estimates subtract already-resident prefill
+    chunks, so warm shared-prefix traffic is not spuriously shed."""
+    eng = _fresh(fp["eng"])
+    warm_prompt = _prompt(40)  # resident from the previous test
+    cold_prompt = _prompt(44)
+    eng._tick_ewma = 0.01
+    # cold: 2 chunks of 4; warm: both blocks resident, COW-capped to 1
+    # recomputed token = 1 chunk
+    assert eng.estimate_ttft(P8, tokens=cold_prompt.tolist()) == \
+        pytest.approx(0.02)
+    assert eng.estimate_ttft(P8, tokens=warm_prompt.tolist()) == \
+        pytest.approx(0.01)
+    # queued work ahead is costed at its WARM price too
+    req = Request(warm_prompt.tolist(), NEW)
+    import dataclasses
+    req = dataclasses.replace(req, rid=0)
+    eng._seq[0] = 0
+    eng.queue.append((req, 0.0))
+    assert eng.estimate_ttft(P8, tokens=cold_prompt.tolist()) == \
+        pytest.approx(0.03)  # 2 cold + 1 warm queued
+    eng.queue.clear()
+    del eng._seq[0]
+
+
+# --------------------------------------------------- COW + shared safety
+
+
+def test_cow_whole_prompt_cached_concurrent_writers(fp, event_log):
+    """Two requests whose WHOLE prompt is resident admitted the same
+    tick: each COWs the same source block into its own copy, writes its
+    recomputed last token there, and decodes bit-identically to the cold
+    golden — the concurrent-writer case block sharing must survive."""
+    eng = _fresh(fp["eng"])
+    prompt = _prompt(50)
+    want = fp["want"](prompt)
+    r0 = eng.submit(Request(prompt.tolist(), NEW))
+    _run_audited(eng)
+    np.testing.assert_array_equal(eng.finished[r0]["tokens"], want)
+
+    eng.reset_metrics()
+    r1 = eng.submit(Request(prompt.tolist(), NEW))
+    r2 = eng.submit(Request(prompt.tolist(), NEW))
+    eng.step()
+    cows = event_log.of_kind("block_cow")
+    assert len(cows) == 2, "both whole-prompt hits must COW"
+    assert cows[0]["src_block"] == cows[1]["src_block"]
+    assert cows[0]["dst_block"] != cows[1]["dst_block"]
+    _run_audited(eng)
+    for r in (r1, r2):
+        np.testing.assert_array_equal(
+            eng.finished[r]["tokens"], want,
+            err_msg="COW writer diverged from the cold golden")
+    s = eng.serving_summary()
+    assert s["prefix_cache"]["cow_copies"] == 2
+    assert s["prefix_cache"]["cow_signatures"] == 1  # one compiled copy
+    assert s["decode_signatures"] == 1
+    hits = event_log.of_kind("prefix_hit")
+    assert len(hits) == 2 and all(h["cow"] for h in hits)
+
+
+def test_preempt_on_shared_blocks_never_frees_coowner(fp, event_log):
+    """A preempted (and a cancelled) sharer must DECREMENT, not free:
+    the co-owner keeps decoding on the shared blocks bit-exactly."""
+    eng = _fresh(fp["eng"])
+    prompt = _prompt(60)
+    want = fp["want"](prompt)
+    a = eng.submit(Request(prompt.tolist(), NEW))
+    _run_audited(eng)  # A completes; blocks cached + registered
+    eng.reset_metrics()
+
+    a2 = eng.submit(Request(prompt.tolist(), NEW))          # COW + share
+    b = eng.submit(Request(prompt.tolist(), NEW))           # shares too
+    eng.step()
+    shared_counts = [v for v in eng._allocs[0]._ref.values() if v > 1]
+    assert shared_counts, "expected refcount > 1 on the shared prefix"
+
+    # a high-priority request that cannot fit evicts the most recent
+    # same-priority sharer; the survivor's blocks must stay live
+    hi = eng.submit(Request(_prompt(61).tolist(), NEW, priority=5))
+    _run_audited(eng)
+    pre = event_log.of_kind("request_preempted")
+    assert len(pre) == 1 and pre[0]["by_rid"] == hi
+    for rid in (a2, b):
+        f = eng.finished[rid]
+        assert f["reason"] == "max_tokens"
+        np.testing.assert_array_equal(
+            f["tokens"], want,
+            err_msg="sharer diverged after its co-owner was preempted")
+    np.testing.assert_array_equal(
+        eng.finished[hi]["tokens"], fp["want"](_prompt(61)))
+    assert eng.serving_summary()["requests"]["preempted"] == 1
+
+    # cancel a sharer mid-flight: same decrement discipline
+    eng.reset_metrics()
+    c1 = eng.submit(Request(prompt.tolist(), NEW))
+    c2 = eng.submit(Request(prompt.tolist(), NEW))
+    for _ in range(3):
+        eng.step()
+    assert eng.cancel(c1) is True
+    _run_audited(eng)
+    np.testing.assert_array_equal(eng.finished[c2]["tokens"], want)
+    assert _kinds_count(event_log, "request_cancelled") == 1
+
+
+def _kinds_count(log, kind):
+    return sum(1 for e in log.as_list() if e["kind"] == kind)
+
+
+def test_cache_eviction_only_under_pressure(fp, event_log):
+    """Refcount-0 cached blocks are retained until the free list cannot
+    cover a fresh allocation, then evicted LRU with a ``cache_evict``
+    event — block conservation holds throughout."""
+    eng = _fresh(fp["eng"])
+    alloc = eng._allocs[0]
+    # fill the cache with distinct retired prefixes
+    seeds = (70, 71, 72)
+    for s in seeds:
+        eng.submit(Request(_prompt(s).tolist(), 1))
+    _run_audited(eng)
+    assert alloc.n_cached > 0
+    evictions_before = eng.stats["cache_evictions"]
+    # two cold requests need 8 fresh blocks; free+cached covers them only
+    # by evicting
+    assert alloc.n_free < 8 <= alloc.n_free + alloc.n_cached
+    r = [eng.submit(Request(_prompt(80 + i).tolist(), NEW))
+         for i in range(2)]
+    _run_audited(eng)
+    for i, rid in enumerate(r):
+        np.testing.assert_array_equal(
+            eng.finished[rid]["tokens"], fp["want"](_prompt(80 + i)))
+    assert eng.stats["cache_evictions"] > evictions_before
+    assert event_log.of_kind("cache_evict")
+    # the evicted prefix is findable no more
+    oldest = chain_block_hashes(_prompt(seeds[0]), BS)
+    assert alloc.match(oldest) == []
+
+
+# ----------------------------------------------------- chaos w/ refcounts
+
+
+@pytest.mark.parametrize("fault", ["table_corrupt", "alloc_exhaust"])
+def test_chaos_faults_green_with_refcounts(fp, event_log, fault):
+    """Satellite: the PR-9 chaos faults stay green on a prefix+spec
+    engine — the refcount-aware audit heals, only the poisoned request
+    replays, co-batched output is bit-identical, one decode signature."""
+    eng = _fresh(fp["eng"])
+    p0, p1 = _prompt(90), _prompt(91)
+    kw = {"slot": 1} if fault == "table_corrupt" else {}
+    eng.chaos = ChaosMonkey(faults=[Fault(fault, step=4, **kw)], seed=0)
+    rids = [eng.submit(Request(p.tolist(), NEW)) for p in (p0, p1)]
+    _run_audited(eng)
+    eng.chaos = None
+    for rid, p in zip(rids, (p0, p1)):
+        np.testing.assert_array_equal(
+            eng.finished[rid]["tokens"], fp["want"](p),
+            err_msg=f"{fault}: tokens diverged under refcounted sharing")
+    s = eng.serving_summary()
+    assert s["decode_signatures"] == 1
+    assert s["faults"]["healed"] == s["faults"]["detected"] >= 1
+    kinds = {e["kind"] for e in event_log.as_list()}
+    assert {"engine_fault_detected", "engine_recovered"} <= kinds
+
+
+# ------------------------------------------------ speculative decode claims
+
+
+def test_spec_drain_resume_exact_parity(fp, event_log, tmp_path):
+    """A speculative in-flight request drained mid-decode resumes to
+    exact temp-0 token parity (the descriptor's emitted list IS the
+    accepted-draft state; replay rides chunked prefill + the warm
+    prefix cache)."""
+    eng = _fresh(fp["eng"])
+    prompt = _prompt(95)
+    want = fp["want"](prompt)
+    g = eng.submit(Request(prompt.tolist(), NEW))
+    smp = eng.submit(Request(_prompt(96).tolist(), NEW, temperature=1.0,
+                             top_k=16, seed=7))
+    while not any(s.state == "decode" and s.generated
+                  for s in eng._slots):
+        eng.step()
+    path = str(tmp_path / "spec_drain.json")
+    payload = eng.drain(persist_path=path)
+    assert eng.n_busy == 0 and payload["n"] == 2
+    assert _kinds_count(event_log, "engine_drained") == 1
+
+    eng._draining = False
+    rids = eng.resume(path)
+    _run_audited(eng)
+    f = eng.finished[rids[0]]
+    np.testing.assert_array_equal(
+        f["tokens"], want,
+        err_msg="speculative drain/resume broke temp-0 parity")
+    assert f["new_tokens"] == NEW
+    smp_f = eng.finished[rids[1]]
+    assert smp_f["new_tokens"] == NEW
+    assert np.all(smp_f["tokens"] < CFG.vocab_size)
+    s = eng.serving_summary()
+    assert s["requests"]["resumed"] == 2
+    assert s["decode_signatures"] == 1
+
+
+def test_spec_sampled_deterministic_replay(fp):
+    """Sampled speculative decode draws from the slot's own key stream:
+    same seed replays the same tokens, different seeds differ, every
+    token is in-vocab (residual rejection sampling never leaves the
+    filtered support)."""
+    eng = _fresh(fp["eng"])
+    prompt = _prompt(97)
+
+    def run(seed):
+        rid = eng.submit(Request(prompt.tolist(), NEW, temperature=1.0,
+                                 top_k=16, top_p=0.9, seed=seed))
+        _run_audited(eng)
+        return eng.finished[rid]["tokens"]
+
+    a, b, c = run(3), run(3), run(4)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert np.all(a[P8:] < CFG.vocab_size)
+    assert eng.serving_summary()["decode_signatures"] == 1
+
+
+@pytest.mark.parametrize("family", ["gqa", "sliding"])
+def test_spec_family_parity(family):
+    """Acceptance matrix: temp-0 speculative paged decode bit-equals
+    non-speculative ``generate()`` for the GQA and sliding-window
+    families too (dense is covered by the shared-engine tests)."""
+    cfg = FAMILY_CFGS[family]
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    prompts = np.stack([_prompt(10 + i, 5, cfg) for i in range(2)])
+    want = np.asarray(jax.jit(
+        lambda p, t: generate(p, t, cfg, max_new_tokens=NEW)
+    )(params, jnp.asarray(prompts)))
+    eng = ServingEngine(params, cfg, num_slots=2, block_size=BS,
+                        chunk=CHUNK, prefix_cache=True, spec_k=K)
+    r0 = eng.submit(Request(prompts[0].tolist(), NEW))
+    eng.step()
+    eng.step()  # slot 0 decoding when slot 1 admits: staggered offsets
+    r1 = eng.submit(Request(prompts[1].tolist(), NEW))
+    _run_audited(eng)
+    for rid, row in ((r0, 0), (r1, 1)):
+        np.testing.assert_array_equal(
+            eng.finished[rid]["tokens"], want[row],
+            err_msg=f"{family}: speculative decode diverged from generate()")
+    s = eng.serving_summary()
+    assert s["decode_signatures"] == 1 and s["prefill_signatures"] == 1
+    assert 0.0 <= s["spec_accept_rate"] <= 1.0
+    assert _validate_serving(s) == []

@@ -248,6 +248,30 @@ def test_bench_trend_overload_columns():
     assert any("REGRESSION serve-overload" in w for w in warnings)
 
 
+def test_bench_trend_fastpath_columns():
+    """The PR-10 fast-path columns: ``serve-prefix-*`` / ``serve-spec-*``
+    lines gate on tokens/s (``value``) with ``prefix_hit_rate`` /
+    ``spec_accept_rate`` rendered alongside — a throughput hold with a
+    collapsed hit or accept rate (the win evaporating) is visible in the
+    trend, and a regression still trips the gate."""
+    from torchdistpackage_tpu.tools.bench_trend import AUX_KEYS, trend
+
+    assert {"prefix_hit_rate", "spec_accept_rate"} <= set(AUX_KEYS)
+    warm = {"metric": "serve-prefix-warm", "value": 1850.0,
+            "prefix_hit_rate": 0.95, "config": "c"}
+    spec = {"metric": "serve-spec-on", "value": 1000.0,
+            "spec_accept_rate": 0.27, "config": "c"}
+    report, warnings = trend(
+        [(1, [warm, spec]),
+         (2, [dict(warm, value=1200.0, prefix_hit_rate=0.1),
+              dict(spec, value=990.0, spec_accept_rate=0.25)])],
+        threshold=0.05)
+    assert any("prefix_hit_rate=0.95" in ln for ln in report)
+    assert any("spec_accept_rate=0.27" in ln for ln in report)
+    assert any("REGRESSION serve-prefix-warm" in w for w in warnings)
+    assert not any("serve-spec-on" in w for w in warnings)  # -1% holds
+
+
 def test_bench_trend_comm_bytes_column():
     """The PR-8 wire-bytes column: a line carrying ``comm_bytes_per_dim``
     renders its TOTAL in the aux trail, so a compressed collective

@@ -357,14 +357,17 @@ def forward_cached_moe(
 
 
 def _full_logits(logits: jnp.ndarray, cfg: GPTConfig, axis: Optional[str]):
-    """Vocab-local [B, V_local] -> full [B, V] (psum-assembled shard slabs;
-    tiny at one position per sequence).  Identity when serial."""
+    """Vocab-local [..., V_local] -> full [..., V] (psum-assembled shard
+    slabs; tiny at a handful of positions per sequence).  Identity when
+    serial.  Any leading shape: [B, V_local] for ordinary decode, [B,
+    K+1, V_local] for the speculative multi-position verify step."""
     if axis is None:
         return logits
     n = axis_size(axis)
     i = jax.lax.axis_index(axis)
-    full = jnp.zeros((logits.shape[0], cfg.vocab_size), logits.dtype)
-    full = jax.lax.dynamic_update_slice(full, logits, (0, i * logits.shape[1]))
+    full = jnp.zeros(logits.shape[:-1] + (cfg.vocab_size,), logits.dtype)
+    start = (0,) * (logits.ndim - 1) + (i * logits.shape[-1],)
+    full = jax.lax.dynamic_update_slice(full, logits, start)
     return jax.lax.psum(full, axis)
 
 

@@ -119,6 +119,28 @@ fault half of the lifecycle; docs/serving.md "Serving under stress"):
                             (preemption-safe shutdown)
 ==========================  =============================================
 
+Serving fast-path kinds (``serving/engine.py``, PR 10 — prefix cache +
+speculative decoding; docs/serving.md "Prefix cache" / "Speculative
+decoding"):
+
+==========================  =============================================
+``prefix_hit``              admission mapped a resident shared prefix
+                            into the new slot's table (record carries
+                            the cached token count and whether the last
+                            block was copy-on-written)
+``block_cow``               a whole-prompt cache hit scheduled a
+                            copy-on-write of its final block (src/dst
+                            block ids; the copy is one fixed-signature
+                            compiled program per admission wave)
+``spec_draft``              the host drafter proposed ``spec_k`` tokens
+                            for every decoding slot this tick
+``spec_verify``             the compiled verify step judged the drafts:
+                            record carries tokens emitted vs drafts
+                            accepted (the accept-rate evidence)
+``cache_evict``             allocator pressure evicted refcount-0 cached
+                            blocks (LRU) to cover a fresh allocation
+==========================  =============================================
+
 A module-level default log lets deep call sites (signal handlers, debug
 callbacks) emit without plumbing a handle through every layer:
 ``emit_event("preemption", signum=15)``.
@@ -155,6 +177,8 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     "request_preempted", "request_shed", "request_expired",
     "request_cancelled", "engine_fault_detected", "engine_recovered",
     "engine_drained",
+    # serving fast path (PR 10)
+    "prefix_hit", "block_cow", "spec_draft", "spec_verify", "cache_evict",
     # memory observability (PR 6)
     "mem_snapshot", "oom_risk",
     # numerics observability (PR 7)

@@ -287,6 +287,17 @@ def _validate_serving(srv: Any) -> List[str]:
             not isinstance(faults, dict)
             or faults.get("healed", 0) > faults.get("detected", 0)):
         errs.append("serving.faults malformed (healed > detected)")
+    # fast-path fields (PR 10) — optional for back-compat, ranged when set
+    for key in ("prefix_hit_rate", "spec_accept_rate"):
+        if key in srv and (
+                not isinstance(srv[key], (int, float))
+                or not (0.0 <= srv[key] <= 1.0)):
+            errs.append(f"serving.{key} non-numeric/out of [0,1]")
+    spec = srv.get("spec")
+    if spec is not None and (
+            not isinstance(spec, dict)
+            or spec.get("accepted", 0) > spec.get("drafted", 0)):
+        errs.append("serving.spec malformed (accepted > drafted)")
     return errs
 
 
@@ -629,6 +640,21 @@ def render_markdown(report: Dict[str, Any]) -> str:
             L.append(f"- faults: {faults['detected']} detected, "
                      f"{faults.get('healed', 0)} healed "
                      f"({faults.get('audits', 0)} invariant audits)")
+        pc = srv.get("prefix_cache") or {}
+        if pc.get("enabled"):
+            L.append(
+                f"- prefix cache: hit rate "
+                f"**{srv.get('prefix_hit_rate', 0.0):.0%}** "
+                f"({pc.get('hits', 0)} hits, {pc.get('cached_tokens', 0)} "
+                f"tokens, {pc.get('cow_copies', 0)} COW, "
+                f"{pc.get('evictions', 0)} evictions)")
+        spec = srv.get("spec") or {}
+        if spec.get("k"):
+            L.append(
+                f"- speculative decode (k={spec['k']}): accept rate "
+                f"**{srv.get('spec_accept_rate', 0.0):.0%}** "
+                f"({spec.get('accepted', 0)}/{spec.get('drafted', 0)} "
+                f"drafts)")
         prios = srv.get("priorities") or {}
         if len(prios) > 1:
             L.append("")

@@ -22,7 +22,16 @@ preemption, deadline-aware admission that sheds with structured verdicts,
 same-tick cancellation, a per-tick block-conservation audit with
 self-healing recovery (chaos-matrix proven), and preemption-safe
 SIGTERM drain/resume with exact-token replay — all host-side, so the
-two-compiled-programs hot loop survives every path.  See docs/serving.md.
+two-compiled-programs hot loop survives every path.
+
+The fast path (docs/serving.md "Prefix cache" / "Speculative decoding"):
+``prefix_cache=True`` turns the block pool content-addressed — per-block
+refcounts, a chain-hash index over full token blocks, copy-on-write for
+whole-prompt hits, LRU retention of released prefixes — so shared
+system-prompt traffic prefills once per PREFIX; ``spec_k=K`` adds
+self-speculative decoding at a static draft width (host n-gram drafter,
+one compiled verify program over all k+1 positions, temp-0 bit-exact,
+sampled rows via residual rejection sampling).  See docs/serving.md.
 """
 
 from .engine import Request, ServingEngine
@@ -30,6 +39,8 @@ from .paged_cache import (
     NULL_BLOCK,
     BlockAllocator,
     block_size_of,
+    chain_block_hashes,
+    copy_blocks,
     expected_pool_bytes,
     gather_kv,
     init_paged_kv,
@@ -46,6 +57,8 @@ __all__ = [
     "NULL_BLOCK",
     "BlockAllocator",
     "block_size_of",
+    "chain_block_hashes",
+    "copy_blocks",
     "expected_pool_bytes",
     "gather_kv",
     "init_paged_kv",

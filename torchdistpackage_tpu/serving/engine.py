@@ -29,6 +29,28 @@ batching).  This engine is that scheduler, built TPU-first:
 - **Retirement.**  EOS or the request's ``max_new_tokens`` frees the slot
   and returns its blocks to the pool the same tick — no token of decode
   compute is spent on finished rows beyond the step that finished them.
+- **Prefix cache** (``prefix_cache=True``).  ``BlockAllocator`` carries
+  per-block refcounts and a content-hash index chained over FULL token
+  blocks (vLLM automatic-prefix-caching); admission maps the longest
+  resident prefix of a prompt into the new slot's table at ZERO prefill
+  cost (``prefix_hit`` event — chunked prefill starts after the cached
+  boundary), a whole-prompt hit copy-on-writes its last block
+  (``block_cow``) so the final token's logits can be recomputed without
+  touching a shared block, retirement/preemption decrement rather than
+  free, and refcount-0 cached blocks are retained on an LRU and evicted
+  (``cache_evict``) only under allocator pressure.  Shared system-prompt
+  traffic prefills once per PREFIX, not once per request.
+- **Speculative decoding** (``spec_k=K``).  A host-side self-speculative
+  drafter (n-gram / prompt-lookup — no second model) proposes a STATIC
+  ``K`` tokens per decoding slot each tick (``spec_draft``), and one
+  compiled verify program scores all K+1 positions in a single
+  paged-attention step (``spec_verify``): greedy rows accept while the
+  draft equals the model's argmax — temp-0 output is BIT-identical to
+  non-speculative decode — and sampled rows run residual rejection
+  sampling off the slot's own key stream.  Accepted prefixes advance the
+  block tables 1..K+1 tokens per tick; rejections truncate host-side
+  (the stale KV tail is overwritten before it can be attended).  The hot
+  loop stays at one decode-signature: the verify program at fixed K.
 - **TP/DP come from the mesh, not the code.**  With a mesh, the step runs
   inside shard_map: KV heads and the vocab-parallel head shard over
   ``axis`` (tp) exactly as in training/`generate()`, and slots + block
@@ -113,6 +135,8 @@ from ..obs.aggregate import percentiles
 from ..obs.events import EventLog, default_event_log
 from .paged_cache import (
     BlockAllocator,
+    chain_block_hashes,
+    copy_blocks,
     expected_pool_bytes,
     init_paged_kv,
     paged_forward,
@@ -174,20 +198,19 @@ def _split_keys(keys: jnp.ndarray):
     return ks[:, 0], ks[:, 1]
 
 
-def _slot_sample(
-    logits: jnp.ndarray,
-    keys: jnp.ndarray,
+def _filtered_logits(
+    x: jnp.ndarray,
     temperature: jnp.ndarray,
     top_k: jnp.ndarray,
     top_p: jnp.ndarray,
 ) -> jnp.ndarray:
-    """Vectorized per-slot sampler on full [B, V] logits: each row applies
-    ITS OWN temperature -> top-k -> top-p filter chain (the `_sample`
-    semantics, including the rank-0-always-kept nucleus edge) and draws
-    from its own key; ``temperature <= 0`` rows take the plain f32 argmax
-    — bitwise the ``generate()`` greedy choice."""
-    x = logits.astype(jnp.float32)
-    greedy = jnp.argmax(x, axis=-1).astype(jnp.int32)
+    """Per-row temperature -> top-k -> top-p filter chain on f32 [N, V]
+    logits (the `_sample` semantics, including the rank-0-always-kept
+    nucleus edge): masked entries become -inf, survivors are scaled by
+    1/temperature.  Shared by :func:`_slot_sample` and the speculative
+    verify step, which applies the SAME chain at every drafted position —
+    acceptance is judged against the distribution the slot would actually
+    have sampled from."""
     V = x.shape[-1]
     neg = jnp.float32(-jnp.inf)
     xs = x / jnp.maximum(temperature, 1e-6)[:, None]
@@ -202,7 +225,24 @@ def _slot_sample(
     keep = keep.at[:, 0].set(True)  # argmax always survives (top_p -> 0)
     cutoff = jnp.min(jnp.where(keep, sorted_x, jnp.inf), axis=-1,
                      keepdims=True)
-    xs = jnp.where(xs < cutoff, neg, xs)
+    return jnp.where(xs < cutoff, neg, xs)
+
+
+def _slot_sample(
+    logits: jnp.ndarray,
+    keys: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> jnp.ndarray:
+    """Vectorized per-slot sampler on full [B, V] logits: each row applies
+    ITS OWN temperature -> top-k -> top-p filter chain
+    (:func:`_filtered_logits`) and draws from its own key;
+    ``temperature <= 0`` rows take the plain f32 argmax — bitwise the
+    ``generate()`` greedy choice."""
+    x = logits.astype(jnp.float32)
+    greedy = jnp.argmax(x, axis=-1).astype(jnp.int32)
+    xs = _filtered_logits(x, temperature, top_k, top_p)
     sampled = jax.vmap(jax.random.categorical)(keys, xs).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
@@ -295,9 +335,13 @@ class ServingEngine:
         max_queue: Optional[int] = None,
         chaos: Optional[Any] = None,
         watchdog: Optional[Any] = None,
+        prefix_cache: bool = False,
+        spec_k: int = 0,
     ) -> None:
         if (axis is not None or dp_axis is not None) and mesh is None:
             raise ValueError("axis/dp_axis need a mesh")
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         if cfg.attn_impl in ("ring", "ulysses"):
             raise NotImplementedError(
                 "context-parallel serving is not supported: the KV pool is "
@@ -322,11 +366,17 @@ class ServingEngine:
         self.max_queue = max_queue
         self.chaos = chaos
         self.watchdog = watchdog
+        self.prefix_cache = bool(prefix_cache)
+        self.spec_k = int(spec_k)
         self._ev: EventLog = (
             telemetry.events if telemetry is not None else default_event_log())
 
         self.max_ctx = int(max_ctx if max_ctx is not None else cfg.max_seq)
-        self.max_blocks = -(-self.max_ctx // block_size)  # table width
+        # spec slack: a verify step writes up to spec_k positions past the
+        # committed length, so the table must cover max_ctx + spec_k
+        # positions or the clamp in _scatter_positions would fold an
+        # overshoot write back onto a REAL block
+        self.max_blocks = -(-(self.max_ctx + self.spec_k) // block_size)
         self.dp = int(mesh.shape[dp_axis]) if (mesh is not None and dp_axis) else 1
         if num_slots % self.dp:
             raise ValueError(
@@ -367,10 +417,18 @@ class ServingEngine:
         self._inject: Dict[int, Dict[str, Any]] = {}  # resume key/prefix
         self._draining = False
         self._tick_ewma: Optional[float] = None
+        self._pending_cow: List[Tuple[int, int, int]] = []  # slot, src, dst
         self._step_fn = self._build_step()
         self._decode_fn = (
             telemetry.wrap_step(self._step_fn) if telemetry is not None
             else self._step_fn)
+        self._cow_fn = self._build_cow() if self.prefix_cache else None
+        if self.spec_k:
+            vfn = self._build_verify_step()
+            self._verify_fn = (
+                telemetry.wrap_step(vfn) if telemetry is not None else vfn)
+        else:
+            self._verify_fn = None
         self.reset_metrics()
 
     # ------------------------------------------------------------ compiled step
@@ -384,17 +442,19 @@ class ServingEngine:
 
         return jax.tree.map(spec, cache)
 
+    def _fwd(self) -> Callable:
+        if self.cfg.moe_experts:
+            import functools
+
+            return functools.partial(paged_forward_moe, ep_axis=self.ep_axis)
+        return paged_forward
+
     def _build_step(self) -> Callable:
         """ONE python step serves both phases: S_in=1 calls are the decode
         step, S_in=chunk calls the prefill-chunk step — two signatures of
         the same program, compiled once each."""
-        cfg, axis, ep_axis = self.cfg, self.axis, self.ep_axis
-        if cfg.moe_experts:
-            import functools
-
-            fwd = functools.partial(paged_forward_moe, ep_axis=ep_axis)
-        else:
-            fwd = paged_forward
+        cfg, axis = self.cfg, self.axis
+        fwd = self._fwd()
 
         def step(params, cache, tokens, tables, offsets, last_idx, samp, keys):
             cache, logits = fwd(params, tokens, cfg, cache, tables, offsets,
@@ -432,6 +492,115 @@ class ServingEngine:
         return jax.jit(shard_map(
             step, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs))
 
+    def _build_verify_step(self) -> Callable:
+        """The speculative verify program — ONE compiled step at a STATIC
+        draft width: feed ``[last_tok, d_1..d_K]`` per slot at offsets
+        ``length..length+K`` through the same paged forward
+        (``all_logits=True``: every position's distribution in one
+        paged-attention pass), then judge each draft against the
+        distribution its slot would have sampled from.
+
+        Greedy rows (``temperature <= 0``): accept while the draft equals
+        the model's argmax — EXACT, so temp-0 output is bit-identical to
+        non-speculative decode whatever the drafter proposes.  Sampled
+        rows: standard residual rejection sampling against the filtered
+        distribution (the drafter is deterministic, a point mass, so the
+        acceptance test is ``u < p(draft)`` and the rejection draw comes
+        from p with the draft's mass removed) off the slot's own key
+        stream — distributionally exact.  Returns ``(cache, verify[B,
+        K+1], accept[B, K], keys)``: ``verify[:, i]`` is the token the
+        model emits when draft ``i`` is the first rejection (column K =
+        the bonus token when every draft survives); the host walks the
+        accept bits."""
+        cfg, axis = self.cfg, self.axis
+        K = self.spec_k
+        fwd = self._fwd()
+
+        def step(params, cache, tokens, tables, offsets, samp, keys):
+            cache, logits = fwd(params, tokens, cfg, cache, tables, offsets,
+                                axis=axis, all_logits=True)
+            x = _full_logits(logits, cfg, axis).astype(jnp.float32)
+            B, K1, V = x.shape
+            greedy = jnp.argmax(x, axis=-1).astype(jnp.int32)  # [B, K+1]
+            temp = samp["temperature"]
+            carry, sub = _split_keys(keys)
+            # a fixed 2K+1 keys per slot per tick: K acceptance uniforms,
+            # K residual draws, 1 bonus draw — static key plumbing
+            subs = jax.vmap(lambda k: jax.random.split(k, 2 * K + 1))(sub)
+            rep = lambda a: jnp.repeat(a, K1)
+            xf = _filtered_logits(
+                x.reshape(B * K1, V), rep(temp), rep(samp["top_k"]),
+                rep(samp["top_p"])).reshape(B, K1, V)
+            probs = jax.nn.softmax(xf, axis=-1)
+            drafts = tokens[:, 1:]  # [B, K]
+            p_draft = jnp.take_along_axis(
+                probs[:, :K], drafts[..., None], axis=-1)[..., 0]
+            u = jax.vmap(jax.vmap(jax.random.uniform))(subs[:, :K])
+            acc = jnp.where(temp[:, None] <= 0.0,
+                            drafts == greedy[:, :K], u < p_draft)
+            # residual: p with the draft's (point) mass removed; when the
+            # draft was the whole support the residual is empty — fall
+            # back to the filtered argmax (measure-zero guard)
+            neg = jnp.float32(-jnp.inf)
+            onehot = jax.nn.one_hot(drafts, V, dtype=jnp.bool_)
+            xr = jnp.where(onehot, neg, xf[:, :K])
+            has = jnp.max(xr, axis=-1) > neg
+            resid = jax.vmap(jax.vmap(jax.random.categorical))(
+                subs[:, K:2 * K], xr)
+            resid = jnp.where(has, resid, jnp.argmax(xf[:, :K], axis=-1))
+            bonus = jax.vmap(jax.random.categorical)(subs[:, 2 * K], xf[:, K])
+            ver = jnp.where(
+                temp[:, None] <= 0.0, greedy,
+                jnp.concatenate([resid, bonus[:, None]], axis=1),
+            ).astype(jnp.int32)
+            acc = acc.astype(jnp.int32)
+            if axis is not None:
+                # every tp shard judged the identical verdict (full logits
+                # psum-assembled, keys replicated); pmax re-types for the
+                # replicated out_spec, as in the ordinary decode step
+                ver = jax.lax.pmax(ver, axis)
+                acc = jax.lax.pmax(acc, axis)
+            return cache, ver, acc, carry
+
+        if self.mesh is None:
+            return jax.jit(step)
+        from jax.sharding import PartitionSpec as P
+
+        from ..compat import shard_map
+
+        dp = self.dp_axis
+        row = P(dp) if dp else P()
+        in_specs = (
+            self.param_specs_cached(),
+            self._cache_specs(self.cache),
+            row, row, row,
+            {"temperature": row, "top_k": row, "top_p": row},
+            row,
+        )
+        out_specs = (self._cache_specs(self.cache), row, row, row)
+        return jax.jit(shard_map(
+            step, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs))
+
+    def _build_cow(self) -> Callable:
+        """The copy-on-write program: one fixed-signature block copy
+        (``[num_slots]`` src/dst lanes, NULL-padded) applied between host
+        scheduling and the next prefill call — admission-path only, never
+        part of the per-tick hot loop."""
+        def cow(cache, src, dst):
+            return copy_blocks(cache, src, dst)
+
+        if self.mesh is None:
+            return jax.jit(cow)
+        from jax.sharding import PartitionSpec as P
+
+        from ..compat import shard_map
+
+        row = P(self.dp_axis) if self.dp_axis else P()
+        cache_specs = self._cache_specs(self.cache)
+        return jax.jit(shard_map(
+            cow, mesh=self.mesh, in_specs=(cache_specs, row, row),
+            out_specs=cache_specs))
+
     def param_specs_cached(self):
         if getattr(self, "_param_specs", None) is None:
             from ..models import gpt_moe_param_specs, gpt_param_specs
@@ -445,7 +614,30 @@ class ServingEngine:
     # ---------------------------------------------------------------- admission
 
     def _blocks_needed(self, req: Request) -> int:
-        return -(-(len(req.tokens) + req.max_new_tokens) // self.block_size)
+        # spec_k slack: a verify step writes drafts up to spec_k positions
+        # past the committed length, so every request's table must cover
+        # them (mirrors speculative_generate's overshoot slack)
+        return -(-(len(req.tokens) + req.max_new_tokens + self.spec_k)
+                 // self.block_size)
+
+    def _prefix_hashes(self, tokens) -> List[Any]:
+        return (chain_block_hashes(tokens, self.block_size)
+                if self.prefix_cache else [])
+
+    def _prefill_chunks(self, tokens) -> int:
+        """Prefill ticks a prompt costs, NET of prefix-cache hits: full
+        blocks already resident prefill for free (a whole-prompt hit
+        still recomputes the last token — the COW admission), so warm
+        shared-prefix traffic is not spuriously shed by the deadline
+        gate."""
+        p_len = len(tokens)
+        cached = 0
+        if self.prefix_cache:
+            hashes = self._prefix_hashes(tokens)
+            if hashes:
+                n_hit = max(len(a.match(hashes)) for a in self._allocs)
+                cached = min(n_hit * self.block_size, p_len - 1)
+        return -(-(p_len - cached) // self.chunk)
 
     def _queue_sort(self) -> None:
         """Priority order, FIFO within a class: the sort key is
@@ -453,19 +645,29 @@ class ServingEngine:
         request rejoins ahead of younger peers of its own class."""
         self.queue.sort(key=lambda e: (-e[0].priority, self._seq[e[0].rid]))
 
-    def estimate_ttft(self, prompt_len: int) -> Optional[float]:
+    def estimate_ttft(self, prompt_len: int,
+                      tokens: Optional[Sequence[int]] = None) -> Optional[float]:
         """Estimated seconds until a request of ``prompt_len`` submitted
         NOW samples its first token, from the engine's own measured tick
         time (an EWMA over decode-carrying ticks): the request's own
         prefill chunks + the queue's unstarted prefill work + (when every
         slot is busy) the ticks until the earliest busy slot can retire.
         ``None`` until a tick has been measured — an unmeasured engine
-        admits everything (there is no evidence to shed on yet)."""
+        admits everything (there is no evidence to shed on yet).
+
+        With the prefix cache on and ``tokens`` given, prefill chunks
+        already RESIDENT are subtracted (for the candidate and for every
+        queued request) — a warm shared-prefix request costs what it will
+        actually cost, not its cold estimate, so the PR-9 deadline gate
+        does not shed warm traffic spuriously."""
         if self._tick_ewma is None:
             return None
-        ticks = -(-prompt_len // self.chunk)
+        if self.prefix_cache and tokens is not None:
+            ticks = self._prefill_chunks(tokens)
+        else:
+            ticks = -(-prompt_len // self.chunk)
         for q, _t in self.queue:
-            ticks += -(-len(q.tokens) // self.chunk)
+            ticks += self._prefill_chunks(q.tokens)
         if not any(s.state == FREE for s in self._slots):
             remaining = []
             for s in self._slots:
@@ -503,7 +705,7 @@ class ServingEngine:
         rejection verdict in ``self.rejected[rid]`` and a ``request_shed``
         event on the timeline."""
         P, N = len(req.tokens), req.max_new_tokens
-        need = -(-(P + N) // self.block_size)
+        need = self._blocks_needed(req)
         if P + N > self.max_ctx:
             raise ValueError(
                 f"prompt {P} + max_new {N} exceeds max_ctx {self.max_ctx}")
@@ -526,7 +728,7 @@ class ServingEngine:
             self._shed(req, t_submit, "queue_full", max_queue=self.max_queue)
             return req.rid
         if req.deadline_s is not None:
-            est = self.estimate_ttft(P)
+            est = self.estimate_ttft(P, tokens=req.tokens)
             if est is not None and est > req.deadline_s:
                 self._shed(req, t_submit, "deadline_unmeetable",
                            est_ttft_s=round(est, 6))
@@ -591,15 +793,26 @@ class ServingEngine:
         s = self._slots[i]
         rid, req, t_submit = s.rid, s.req, s.t_submit
         alloc = self._allocs[i // self.slots_per_group]
-        try:
-            alloc.free(s.blocks)
-        except ValueError:
-            alloc.reclaim(s.blocks)  # fault path: heal whatever it left
+        self._release_blocks(alloc, s.blocks)
         self._clear_slot_rows(i)
         s.reset()
         self.queue.append((req, t_submit))
         self._queue_sort()
         return rid
+
+    @staticmethod
+    def _release_blocks(alloc: BlockAllocator, blocks: List[int]) -> None:
+        """Fault-path block release, PER BLOCK and refcount-aware: a
+        clean ownership reference decrements via ``free`` (a shared
+        block's co-owner keeps it — preempting or retiring one sharer
+        must never free a block another slot still references), and only
+        a block ``free`` refuses (the inconsistency a fault created) is
+        force-reclaimed."""
+        for b in blocks:
+            try:
+                alloc.free([b])
+            except ValueError:
+                alloc.reclaim([b])
 
     def _clear_slot_rows(self, i: int) -> None:
         self._tables[i] = 0
@@ -609,43 +822,108 @@ class ServingEngine:
         self._top_k[i] = self.cfg.vocab_size
         self._top_p[i] = 1.0
 
+    def _try_place(self, req: Request):
+        """Find a slot + blocks for ``req``.  With the prefix cache on,
+        the longest RESIDENT prefix of the prompt's full blocks (content-
+        hash chained) is mapped into the table at zero prefill cost —
+        each matched block's refcount bumps via ``share`` — and only the
+        remainder is freshly allocated (evicting refcount-0 cached blocks
+        LRU-first, only under pressure).  A whole-prompt hit keeps all
+        but its last block and schedules a copy-on-write of that one:
+        first-token sampling needs the last prompt position's LOGITS, and
+        its KV write may not land in a block other slots read.  Returns
+        ``(slot, shared, cow_src, fresh)`` or None (back-pressure)."""
+        P = len(req.tokens)
+        need = self._blocks_needed(req)
+        hashes = self._prefix_hashes(req.tokens)
+        for i, s in enumerate(self._slots):
+            if s.state != FREE:
+                continue
+            alloc = self._allocs[i // self.slots_per_group]
+            hit = alloc.match(hashes) if hashes else []
+            cow_src = None
+            if hit and len(hit) * self.block_size >= P:
+                cow_src = hit[-1]
+                hit = hit[:-1]
+            for b in hit:
+                alloc.share(b)
+            if cow_src is not None:
+                alloc.share(cow_src)  # pin: eviction must not take the src
+            fresh = alloc.alloc(need - len(hit))
+            if fresh is None:
+                # revert the shares: nothing partially admitted
+                for b in hit:
+                    alloc.free([b])
+                if cow_src is not None:
+                    alloc.free([cow_src])
+                continue
+            if cow_src is not None:
+                # unpin — the copy is scheduled before the next device
+                # call, and the cache threading orders it before any write
+                alloc.free([cow_src])
+            return i, hit, cow_src, fresh
+        return None
+
     def _admit(self) -> int:
         """Priority admission: the head of the (priority-ordered) queue
-        takes the first free slot whose dp group can cover its blocks.
-        When it cannot be placed, the lowest-priority running slot
-        strictly below it is preempted and admission retries; head-of-line
-        blocking WITHIN a priority class is deliberate — skipping ahead
-        would starve long requests."""
+        takes the first free slot whose dp group can cover its blocks
+        (shared-prefix blocks mapped, remainder allocated — see
+        :meth:`_try_place`).  When it cannot be placed, the lowest-
+        priority running slot strictly below it is preempted and
+        admission retries; head-of-line blocking WITHIN a priority class
+        is deliberate — skipping ahead would starve long requests."""
         admitted = 0
         while self.queue:
             req, t_submit = self.queue[0]
             P, N = len(req.tokens), req.max_new_tokens
-            need = -(-(P + N) // self.block_size)
-            slot_idx = None
-            for i, s in enumerate(self._slots):
-                if s.state != FREE:
-                    continue
-                if self._allocs[i // self.slots_per_group].n_free >= need:
-                    slot_idx = i
-                    break
-            if slot_idx is None:
+            need = self._blocks_needed(req)
+            placed = self._try_place(req)
+            if placed is None:
                 victim = self._pick_victim(req)
                 if victim is None:
                     break
                 self._preempt(victim, req)
                 continue  # blocks and/or a slot freed: retry the head
             self.queue.pop(0)
-            blocks = self._allocs[slot_idx // self.slots_per_group].alloc(need)
+            slot_idx, shared, cow_src, fresh = placed
+            alloc = self._allocs[slot_idx // self.slots_per_group]
+            evicted = alloc.pop_evicted()
+            blocks = shared + fresh
             s = self._slots[slot_idx]
             s.state, s.rid, s.req, s.blocks = PREFILL, req.rid, req, blocks
             s.prompt = np.asarray(req.tokens, np.int32)
-            s.off, s.generated = 0, []
+            # chunked prefill starts AFTER the cached boundary (a COW
+            # admission recomputes only the last prompt token)
+            s.off = (P - 1) if cow_src is not None else (
+                len(shared) * self.block_size)
+            s.generated = []
             s.t_submit, s.t_admit = t_submit, time.perf_counter()
             s.ttft_s, s.tpot_s = None, []
             s.orig_prompt_len, s.pre_gen = len(req.tokens), 0
             self._tables[slot_idx] = 0
             self._tables[slot_idx, :need] = blocks
             self._lengths[slot_idx] = 0
+            if evicted:
+                self.stats["cache_evictions"] += len(evicted)
+                self._ev.emit(
+                    "cache_evict", tick=self._tick, n_blocks=len(evicted),
+                    group=slot_idx // self.slots_per_group)
+            if self.prefix_cache:
+                self.stats["prefix_prompt_tokens"] += P
+            if s.off:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_cached_tokens"] += int(s.off)
+                self._ev.emit(
+                    "prefix_hit", rid=req.rid, slot=slot_idx,
+                    blocks=len(shared) + (1 if cow_src is not None else 0),
+                    cached_tokens=int(s.off), cow=cow_src is not None)
+            if cow_src is not None:
+                self._pending_cow.append(
+                    (slot_idx, int(cow_src), int(fresh[0])))
+                self.stats["cow_copies"] += 1
+                self._ev.emit(
+                    "block_cow", rid=req.rid, slot=slot_idx,
+                    src_block=int(cow_src), dst_block=int(fresh[0]))
             self._temps[slot_idx] = req.temperature
             self._top_k[slot_idx] = (
                 req.top_k if req.top_k is not None else self.cfg.vocab_size)
@@ -667,7 +945,23 @@ class ServingEngine:
                 priority=req.priority,
                 queue_wait_s=round(s.t_admit - t_submit, 6))
             admitted += 1
+        self._apply_cow()
         return admitted
+
+    def _apply_cow(self) -> None:
+        """Flush this admission wave's copy-on-write list as ONE compiled
+        block-copy call (NULL-padded fixed-width lanes).  The cache object
+        is threaded through, so the copy is device-ordered before any
+        subsequent prefill write to the copied block."""
+        if not self._pending_cow:
+            return
+        src = np.zeros(self.num_slots, np.int32)
+        dst = np.zeros(self.num_slots, np.int32)
+        for slot, s_blk, d_blk in self._pending_cow:
+            src[slot], dst[slot] = s_blk, d_blk
+        self._pending_cow.clear()
+        self.cache = self._cow_fn(self.cache, src, dst)
+        self._cow_sigs.add(("cow", self.num_slots))
 
     # -------------------------------------------------------------------- ticks
 
@@ -744,6 +1038,17 @@ class ServingEngine:
                     continue
                 self._keys[i] = keys[i]
                 s.state = DECODE
+                if self.prefix_cache:
+                    # every FULL prompt block is now fully written: bind
+                    # it to its chain hash so later admissions with the
+                    # same prefix map it instead of re-prefilling (first
+                    # registration wins; a COW copy of an already-
+                    # registered block stays unregistered)
+                    alloc = self._allocs[i // self.slots_per_group]
+                    for bh, blk in zip(
+                            chain_block_hashes(s.prompt, self.block_size),
+                            s.blocks):
+                        alloc.register(blk, bh)
                 s.ttft_s = now - s.t_submit
                 s.t_last = now
                 self._lengths[i] = len(s.prompt)
@@ -756,6 +1061,8 @@ class ServingEngine:
         return len(rids)
 
     def _decode_tick(self) -> int:
+        if self.spec_k:
+            return self._spec_decode_tick()
         mask, tables = self._masked(DECODE)
         n_active = int(mask.sum())
         if n_active == 0:
@@ -787,6 +1094,122 @@ class ServingEngine:
             s.tpot_s.append(now - s.t_last)
             s.t_last = now
             self._maybe_retire(i, int(tok[i]), now)
+        self.stats["decode_steps"] += 1
+        self.stats["decode_slot_steps"] += n_active
+        return n_active
+
+    # ------------------------------------------------------ speculative decode
+
+    def _draft(self, s: _SlotState) -> List[int]:
+        """Host-side self-speculative drafter: prompt-lookup / n-gram
+        continuation (no second model, no new weights).  Propose the
+        ``spec_k`` tokens that followed the most recent earlier occurrence
+        of the slot's last BIGRAM in its own history (prompt + generated),
+        falling back to the last unigram, then to repeating the last
+        token.  A bad draft costs nothing but acceptance — greedy
+        verification is exact whatever this proposes."""
+        hist = (list(int(t) for t in s.prompt) + s.generated)[-256:]
+        K = self.spec_k
+        cand: Optional[List[int]] = None
+        if len(hist) >= 3:
+            a, b = hist[-2], hist[-1]
+            for j in range(len(hist) - 3, -1, -1):
+                if hist[j] == a and hist[j + 1] == b:
+                    cand = hist[j + 2:j + 2 + K]
+                    break
+        if not cand:
+            last = hist[-1]
+            for j in range(len(hist) - 2, -1, -1):
+                if hist[j] == last:
+                    cand = hist[j + 1:j + 1 + K]
+                    break
+        cand = list(cand or [])
+        while len(cand) < K:
+            cand.append(cand[-1] if cand else hist[-1])
+        return cand[:K]
+
+    def _spec_decode_tick(self) -> int:
+        """The speculative decode tick: the drafter proposes a STATIC
+        ``spec_k`` tokens per decoding slot, ONE compiled verify program
+        scores all k+1 positions in a single paged-attention step, and
+        the host walks the accept bits — the accepted draft prefix plus
+        the model's own correction/bonus token advance the slot, a
+        rejection truncates host-side (the stale KV tail is overwritten
+        before it can ever be attended, exactly the
+        ``speculative_generate`` argument).  Emits 1..k+1 tokens per slot
+        per tick at one decode-signature — the decode latency floor
+        broken without touching the compile-once contract."""
+        mask, tables = self._masked(DECODE)
+        n_active = int(mask.sum())
+        if n_active == 0:
+            return 0
+        K = self.spec_k
+        tokens = np.zeros((self.num_slots, K + 1), np.int32)
+        offsets = np.where(mask, self._lengths, 0).astype(np.int32)
+        rids = []
+        for i, s in enumerate(self._slots):
+            if s.state != DECODE:
+                continue
+            rids.append(s.rid)
+            tokens[i, 0] = self._last_tok[i]
+            tokens[i, 1:] = self._draft(s)
+        self._ev.emit("spec_draft", k=K, n_slots=len(rids), rids=rids)
+        self.cache, verify, accept, keys = self._verify_fn(
+            self.params, self.cache, tokens, tables, offsets, self._samp(),
+            self._keys)
+        self._decode_sigs.add(("decode",) + self._sig(tokens))
+        if self.telemetry is not None:
+            self.telemetry.end_step(active_slots=n_active)
+        verify = np.asarray(verify)
+        accept = np.asarray(accept)
+        keys = np.asarray(keys)
+        if self.chaos is not None:
+            verify = self.chaos.perturb_engine_tokens(self._tick, verify)
+        now = time.perf_counter()
+        emitted_total = accepted_total = 0
+        for i, s in enumerate(self._slots):
+            if s.state != DECODE:
+                continue
+            # accepted draft prefix, then the model's correction (or the
+            # bonus token when every draft survived)
+            emitted: List[int] = []
+            for j in range(K):
+                if accept[i, j]:
+                    emitted.append(int(tokens[i, j + 1]))
+                else:
+                    emitted.append(int(verify[i, j]))
+                    break
+            else:
+                emitted.append(int(verify[i, K]))
+            self.stats["spec_drafted"] += K
+            if self._token_poisoned(int(verify[i, 0])) or any(
+                    self._token_poisoned(t) for t in emitted):
+                self._poisoned_token_recover(i, int(verify[i, 0]))
+                continue
+            self._keys[i] = keys[i]
+            req = s.req
+            took, done, reason = 0, False, "max_tokens"
+            for t in emitted:
+                s.generated.append(t)
+                took += 1
+                if req.eos_id is not None and t == req.eos_id:
+                    done, reason = True, "eos"
+                    break
+                if len(s.generated) >= req.max_new_tokens:
+                    done = True
+                    break
+            self.stats["spec_accepted"] += max(0, took - 1)
+            accepted_total += max(0, took - 1)
+            emitted_total += took
+            self._lengths[i] += took
+            self._last_tok[i] = s.generated[-1]
+            per_tok = (now - s.t_last) / took
+            s.tpot_s.extend([per_tok] * took)
+            s.t_last = now
+            if done:
+                self._finish_slot(i, reason, now)
+        self._ev.emit("spec_verify", k=K, n_slots=len(rids),
+                      emitted=emitted_total, accepted=accepted_total)
         self.stats["decode_steps"] += 1
         self.stats["decode_slot_steps"] += n_active
         return n_active
@@ -893,11 +1316,16 @@ class ServingEngine:
           compiled step would read/write another request's cache;
         - every owned block must be live in its group's allocator
           (``BlockAllocator.audit``'s ``unknown`` is a use-after-free)
-          and owned by exactly ONE slot;
-        - every live allocator block must be owned by some slot
+          with refcount-weighted ownership: the number of slots
+          referencing a block must EQUAL its refcount (legitimate
+          prefix sharing keeps them equal; a mismatch is a scatter
+          collision or a lost reference);
+        - every refcounted allocator block must be owned by some slot
           (``orphaned`` is a leak);
         - an inactive slot's row must be all-NULL;
-        - ``in_use + n_free == n_usable`` (conservation).
+        - ``unique in_use + cached + n_free == n_usable`` (conservation
+          under sharing — refcount-0 cached blocks are accounted, not
+          leaked).
 
         ``heal=True`` (the engine's in-``step()`` mode) repairs what it
         finds — poisoned slots are retired + requeued for replay, orphaned
@@ -913,7 +1341,6 @@ class ServingEngine:
         for g, alloc in enumerate(self._allocs):
             lo, hi = g * self.slots_per_group, (g + 1) * self.slots_per_group
             owned_lists = []
-            owner: Dict[int, int] = {}
             for i in range(lo, hi):
                 s = self._slots[i]
                 row = self._tables[i]
@@ -931,15 +1358,18 @@ class ServingEngine:
                         "kind": "table_mismatch", "slot": i, "rid": s.rid,
                         "row": row.tolist(), "owned": list(s.blocks)})
                     poisoned.append(i)
-                for b in s.blocks:
-                    if b in owner:
-                        violations.append({
-                            "kind": "shared_block", "block": int(b),
-                            "slots": [owner[b], i]})
-                        if i not in poisoned:
-                            poisoned.append(i)
-                    owner[b] = i
             rep = alloc.audit(owned_lists)
+            for b in rep["shared"]:
+                # refcount-weighted ownership violated: more (or fewer)
+                # slots reference the block than its refcount records
+                refs = [i for i in range(lo, hi)
+                        if b in self._slots[i].blocks]
+                violations.append({
+                    "kind": "shared_block", "block": int(b),
+                    "group": g, "slots": refs})
+                for i in refs:
+                    if i not in poisoned:
+                        poisoned.append(i)
             if rep["orphaned"]:
                 violations.append({
                     "kind": "orphaned_blocks", "group": g,
@@ -1097,10 +1527,7 @@ class ServingEngine:
                 s.req, emitted=s.generated, key=key,
                 orig_prompt_len=s.orig_prompt_len, pre_gen=s.pre_gen))
             alloc = self._allocs[i // self.slots_per_group]
-            try:
-                alloc.free(s.blocks)
-            except ValueError:
-                alloc.reclaim(s.blocks)
+            self._release_blocks(alloc, s.blocks)
             self._clear_slot_rows(i)
             self._inject.pop(s.rid, None)
             s.reset()
@@ -1223,9 +1650,14 @@ class ServingEngine:
                       "decode_slot_steps": 0, "generated_tokens": 0,
                       "shed": 0, "expired": 0, "cancelled": 0,
                       "preempted": 0, "resumed": 0, "faults_detected": 0,
-                      "faults_healed": 0, "audits": 0}
+                      "faults_healed": 0, "audits": 0,
+                      "prefix_hits": 0, "prefix_cached_tokens": 0,
+                      "prefix_prompt_tokens": 0, "cow_copies": 0,
+                      "cache_evictions": 0,
+                      "spec_drafted": 0, "spec_accepted": 0}
         self._decode_sigs: set = set()
         self._prefill_sigs: set = set()
+        self._cow_sigs: set = set()
         self._ttfts: List[float] = []
         self._tpots: List[float] = []
         self._ttfts_by_prio: Dict[int, List[float]] = {}
@@ -1317,6 +1749,27 @@ class ServingEngine:
             "decode_batch_mean": (
                 st["decode_slot_steps"] / st["decode_steps"]
                 if st["decode_steps"] else 0.0),
+            # serving fast path (prefix cache + speculative decode):
+            # fraction of admitted prompt tokens served from resident
+            # blocks, and fraction of proposed draft tokens the verify
+            # step accepted — both 0.0 when the feature is off/unused
+            "prefix_hit_rate": (
+                st["prefix_cached_tokens"] / st["prefix_prompt_tokens"]
+                if st["prefix_prompt_tokens"] else 0.0),
+            "spec_accept_rate": (
+                st["spec_accepted"] / st["spec_drafted"]
+                if st["spec_drafted"] else 0.0),
+            "prefix_cache": {
+                "enabled": self.prefix_cache,
+                "hits": st["prefix_hits"],
+                "cached_tokens": st["prefix_cached_tokens"],
+                "cow_copies": st["cow_copies"],
+                "evictions": st["cache_evictions"],
+                "cached_blocks": sum(a.n_cached for a in self._allocs),
+                "cow_signatures": len(self._cow_sigs),
+            },
+            "spec": {"k": self.spec_k, "drafted": st["spec_drafted"],
+                     "accepted": st["spec_accepted"]},
             # compile-once evidence: distinct device-call signatures the
             # engine issued (must be 1 per phase however many requests of
             # whatever shapes were served — priorities, preemptions,

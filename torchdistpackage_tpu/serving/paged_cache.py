@@ -41,7 +41,8 @@ int32 tables between compiled steps (see ``serving/engine.py``).
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -230,6 +231,7 @@ def paged_forward(
     offset: jnp.ndarray,
     axis: Optional[str] = None,
     last_idx=None,
+    all_logits: bool = False,
 ) -> Tuple[Dict[str, Any], jnp.ndarray]:
     """``forward_cached`` over the block pool: run ``tokens`` [B, S_in]
     (slot b's rows occupy global positions ``offset[b] + arange(S_in)``)
@@ -238,7 +240,12 @@ def paged_forward(
     [B, V_local] read at per-slot row ``last_idx`` (default: the last row
     — the decode case).  The layer dim rides the same ``lax.scan`` as the
     contiguous path; chunked prefill is just S_in=chunk at a running
-    offset — one implementation, both phases, either layout."""
+    offset — one implementation, both phases, either layout.
+
+    ``all_logits=True`` returns the per-position logits [B, S_in,
+    V_local] instead — the multi-position evaluation the speculative
+    verify step needs (the model's distribution at EVERY drafted
+    position, one paged-attention pass)."""
     bcfg = cfg.block
     S_in = tokens.shape[1]
     offset = jnp.asarray(offset, jnp.int32)
@@ -256,6 +263,9 @@ def paged_forward(
 
     h, (ck, cv) = jax.lax.scan(
         body, h, (params["blocks"], cache["k"], cache["v"]))
+    if all_logits:
+        return {"k": ck, "v": cv}, gpt_head(params, h, axis, False,
+                                            eps=cfg.norm_eps)
     logits = gpt_head(params, _select_row(h, last_idx), axis, False,
                       eps=cfg.norm_eps)
     return {"k": ck, "v": cv}, logits[:, 0, :]
@@ -271,12 +281,15 @@ def paged_forward_moe(
     axis: Optional[str] = None,
     last_idx=None,
     ep_axis: Optional[str] = None,
+    all_logits: bool = False,
 ) -> Tuple[Dict[str, Any], jnp.ndarray]:
     """:func:`paged_forward` for the MoE family (heterogeneous block list,
     expert FFN every moe_every-th block) — the same exact no-drop serving
     dispatch as ``forward_cached_moe`` (its docstring has the semantics:
     ragged grouped GEMMs when ``ep_axis`` is None, EP-sharded exchange at
-    no-drop capacity when set), attending through the block tables."""
+    no-drop capacity when set), attending through the block tables.
+    ``all_logits=True``: per-position logits, as in :func:`paged_forward`.
+    """
     import dataclasses as _dc
 
     from ..models.gpt_moe import moe_layer_config
@@ -317,16 +330,63 @@ def paged_forward_moe(
         vs.append(cv)
     stack = lambda cs: jax.tree.map(lambda *xs: jnp.stack(xs), *cs)
     cache = {"k": stack(ks), "v": stack(vs)}
+    if all_logits:
+        return cache, gpt_head(params, h, axis, False, eps=cfg.norm_eps)
     logits = gpt_head(params, _select_row(h, last_idx), axis, False,
                       eps=cfg.norm_eps)
     return cache, logits[:, 0, :]
+
+
+def copy_blocks(cache: Dict[str, Any], src: jnp.ndarray,
+                dst: jnp.ndarray) -> Dict[str, Any]:
+    """Copy block contents ``src[i] -> dst[i]`` along the pool's block dim
+    (dim 1 of every leaf, quantized pairs included) — the device half of
+    copy-on-write.  ``src``/``dst`` are fixed-width int32 vectors so the
+    copy is ONE compiled program whatever blocks an admission wave needs
+    copied; unused lanes are padded ``NULL -> NULL`` (the write-off
+    block's contents are never read, so colliding pad writes are
+    harmless)."""
+    def cp(leaf):
+        return leaf.at[:, dst].set(leaf[:, src])
+    return jax.tree.map(cp, cache)
+
+
+def chain_block_hashes(tokens, block_size: int) -> List[Any]:
+    """Per-full-block content hashes, chained from position 0 (vLLM
+    style): ``h_i = H(h_{i-1}, tokens[i*bs:(i+1)*bs])``, so a hash names
+    a block's contents AND everything before it — equal hashes mean equal
+    KV, which is what makes mapping a matched block into a new table
+    sound.  Host-side, prompt tokens only (full blocks; a trailing
+    partial block is never registered)."""
+    h: Any = 0
+    out: List[Any] = []
+    for i in range(len(tokens) // block_size):
+        h = hash((h, tuple(
+            int(t) for t in tokens[i * block_size:(i + 1) * block_size])))
+        out.append(h)
+    return out
 
 
 class BlockAllocator:
     """Host-side free-list over a pool's blocks (block 0 reserved as the
     NULL block).  LIFO reuse keeps recently-freed blocks hot.  Pure
     python — allocation happens between compiled steps and only ever
-    rewrites int32 tables, never device buffers."""
+    rewrites int32 tables, never device buffers.
+
+    **Refcounts + prefix cache** (vLLM automatic-prefix-caching lineage):
+    every in-use block carries a refcount.  :meth:`share` maps an
+    already-resident block into another slot's table (refcount + 1) so a
+    shared prompt prefix is prefilled ONCE per content, not once per
+    request; :meth:`free` decrements and only a block's LAST owner
+    actually releases it.  :meth:`register` binds a block to a content
+    hash (the engine chains hashes over FULL token blocks); a released
+    registered block is RETAINED on a refcount-0 cached LRU instead of
+    the free list, so its KV survives for the next request with the same
+    prefix.  :meth:`alloc` evicts cached blocks LRU-first, and ONLY under
+    pressure (the free list alone cannot cover the request) — eviction is
+    observable via :meth:`pop_evicted` / ``cache_evictions``.
+    Conservation under sharing becomes ``unique-in-use + cached + free ==
+    usable`` with refcount-weighted ownership (:meth:`audit`)."""
 
     def __init__(self, num_blocks: int) -> None:
         if num_blocks < 2:
@@ -335,12 +395,25 @@ class BlockAllocator:
                 f"got {num_blocks}")
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._live: set = set()
+        #: block -> refcount (> 0 == in use; a block shared by k slots
+        #: carries refcount k and is freed k times before release)
+        self._ref: Dict[int, int] = {}
+        #: refcount-0 RETAINED blocks, insertion order == LRU order
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
+        self._hash_of: Dict[int, Any] = {}   # block -> content hash
+        self._by_hash: Dict[Any, int] = {}   # content hash -> block
+        self._evicted: List[int] = []        # since last pop_evicted()
+        self.cache_evictions = 0
         self.peak_in_use = 0
 
     @property
     def n_free(self) -> int:
         return len(self._free)
+
+    @property
+    def n_cached(self) -> int:
+        """Refcount-0 blocks retained for prefix reuse (reclaimable)."""
+        return len(self._cached)
 
     @property
     def n_usable(self) -> int:
@@ -349,7 +422,8 @@ class BlockAllocator:
 
     @property
     def in_use(self) -> int:
-        return len(self._live)
+        """UNIQUE blocks with a live owner (shared blocks count once)."""
+        return len(self._ref)
 
     def utilization(self) -> float:
         return self.in_use / self.n_usable
@@ -357,23 +431,97 @@ class BlockAllocator:
     def alloc(self, n: int) -> Optional[List[int]]:
         """``n`` blocks, or None when the pool can't cover the request
         (the engine's admission back-pressure signal — nothing is
-        partially allocated)."""
+        partially allocated).  Free blocks are preferred; only when they
+        fall short are refcount-0 cached blocks evicted, LRU first (their
+        hashes drop out of the index — the prefix is gone)."""
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if n > len(self._free):
+        if n > len(self._free) + len(self._cached):
             return None
+        while len(self._free) < n:
+            b, _ = self._cached.popitem(last=False)  # LRU
+            self._drop_hash(b)
+            self._free.append(b)
+            self._evicted.append(b)
+            self.cache_evictions += 1
         blocks = [self._free.pop() for _ in range(n)]
-        self._live.update(blocks)
-        self.peak_in_use = max(self.peak_in_use, len(self._live))
+        for b in blocks:
+            self._ref[b] = 1
+        self.peak_in_use = max(self.peak_in_use, len(self._ref))
         return blocks
 
+    def pop_evicted(self) -> List[int]:
+        """Blocks evicted from the prefix cache since the last call (the
+        engine turns them into ``cache_evict`` events)."""
+        out, self._evicted = self._evicted, []
+        return out
+
+    def _drop_hash(self, b: int) -> None:
+        h = self._hash_of.pop(b, None)
+        if h is not None and self._by_hash.get(h) == b:
+            del self._by_hash[h]
+
+    def share(self, block: int) -> None:
+        """Map an already-resident block into another owner's table:
+        refcount + 1 for an in-use block; a cached (refcount-0) block is
+        revived off the LRU.  Raises on non-resident blocks — sharing a
+        freed block would be a use-after-free by construction."""
+        b = int(block)
+        if b in self._ref:
+            self._ref[b] += 1
+        elif b in self._cached:
+            del self._cached[b]
+            self._ref[b] = 1
+        else:
+            raise ValueError(f"share of non-resident block {b}")
+        self.peak_in_use = max(self.peak_in_use, len(self._ref))
+
+    def register(self, block: int, content_hash: Any) -> bool:
+        """Bind an in-use block to a content hash so future
+        :meth:`match` calls can find it.  First registration wins: when
+        the hash already names a DIFFERENT resident block (two slots
+        prefilled the same prompt concurrently), the newcomer stays
+        unregistered and frees normally.  Returns True when registered."""
+        b = int(block)
+        if b not in self._ref:
+            raise ValueError(f"register of block {b} not in use")
+        if content_hash in self._by_hash and self._by_hash[content_hash] != b:
+            return False
+        self._by_hash[content_hash] = b
+        self._hash_of[b] = content_hash
+        return True
+
+    def match(self, hashes: Sequence[Any]) -> List[int]:
+        """Longest prefix of ``hashes`` whose blocks are resident (in use
+        or cached), in order — the admission-time prefix lookup.  Pure
+        read: :meth:`share` is what pins the result."""
+        out: List[int] = []
+        for h in hashes:
+            b = self._by_hash.get(h)
+            if b is None or (b not in self._ref and b not in self._cached):
+                break
+            out.append(b)
+        return out
+
     def free(self, blocks: List[int]) -> None:
+        """Release one ownership reference per block.  A shared block
+        survives until its LAST owner frees it; at refcount 0 a
+        registered block moves to the cached LRU (prefix retained), an
+        unregistered one returns to the free list."""
         for b in blocks:
-            if b == NULL_BLOCK or b not in self._live:
+            b = int(b)
+            r = self._ref.get(b)
+            if b == NULL_BLOCK or r is None:
                 raise ValueError(
                     f"freeing block {b} not handed out by this allocator")
-            self._live.discard(b)
-            self._free.append(b)
+            if r > 1:
+                self._ref[b] = r - 1
+                continue
+            del self._ref[b]
+            if b in self._hash_of:
+                self._cached[b] = None  # MRU end of the LRU
+            else:
+                self._free.append(b)
 
     # ------------------------------------------------- conservation audit
 
@@ -382,17 +530,19 @@ class BlockAllocator:
         (the engine calls this every tick; ``tests`` call it after every
         lifecycle transition).  ``slot_tables`` is one block sequence per
         LIVE slot — the host-side ownership records the allocator's
-        ``_live`` set must agree with exactly:
+        refcounts must agree with exactly:
 
-        - ``orphaned``: blocks the allocator counts in use that no slot
-          references (a leak — e.g. a retirement that forgot to free);
+        - ``orphaned``: in-use blocks no slot references (a leak — e.g.
+          a retirement that forgot to free);
         - ``unknown``: blocks a slot references that the allocator says
-          are free/never-allocated (a use-after-free — the slot would
-          read another request's cache once the block is rehanded out);
-        - ``shared``: blocks referenced by more than one slot (ownership
-          must be disjoint or scatters collide);
-        - ``conserved``: ``in_use + n_free == n_usable`` with no
-          duplicate or live entry on the free list.
+          are free or cached (a use-after-free — the slot would read
+          another request's cache once the block is rehanded out);
+        - ``shared``: refcount-weighted ownership violated — the number
+          of slots referencing an in-use block differs from its
+          refcount (legitimate prefix sharing has them EQUAL; a scatter
+          collision needs an over-reference, which lands here);
+        - ``conserved``: ``unique in_use + cached + free == usable``
+          with disjoint free / cached / in-use sets and no NULL entry.
 
         ``ok`` iff all four are clean.  Pure host arithmetic, O(blocks).
         """
@@ -402,19 +552,28 @@ class BlockAllocator:
             int(b) for t in slot_tables for b in t if int(b) != NULL_BLOCK)
         refset = set(counts)
         free_set = set(self._free)
+        ref_keys = set(self._ref)
+        cached_set = set(self._cached)
         report = {
-            "orphaned": sorted(self._live - refset),
-            "unknown": sorted(refset - self._live),
-            "shared": sorted(b for b, c in counts.items() if c > 1),
+            "orphaned": sorted(ref_keys - refset),
+            "unknown": sorted(refset - ref_keys),
+            "shared": sorted(
+                b for b, c in counts.items()
+                if b in self._ref and c != self._ref[b]),
             "conserved": (
-                len(self._live) + len(self._free) == self.n_usable
+                len(self._ref) + len(self._cached) + len(self._free)
+                == self.n_usable
                 and len(free_set) == len(self._free)
-                and not (free_set & self._live)
+                and not (free_set & ref_keys)
+                and not (free_set & cached_set)
+                and not (cached_set & ref_keys)
                 and NULL_BLOCK not in free_set
-                and NULL_BLOCK not in self._live
+                and NULL_BLOCK not in ref_keys
+                and NULL_BLOCK not in cached_set
             ),
             "in_use": self.in_use,
             "n_free": self.n_free,
+            "n_cached": self.n_cached,
         }
         report["ok"] = (
             report["conserved"]
@@ -427,15 +586,19 @@ class BlockAllocator:
     def reclaim(self, blocks) -> List[int]:
         """Force-return ``blocks`` to the free list whatever state they are
         in — the self-healing half of :meth:`audit` (``free`` raises on
-        exactly the inconsistencies a fault creates).  Returns the blocks
-        actually recovered; NULL and already-free blocks are no-ops."""
+        exactly the inconsistencies a fault creates).  Refcounts, cache
+        membership, and hash registrations are all discarded.  Returns the
+        blocks actually recovered; NULL and already-free blocks are
+        no-ops."""
         healed = []
         free_set = set(self._free)
         for b in blocks:
             b = int(b)
             if b == NULL_BLOCK or not (0 < b < self.num_blocks):
                 continue
-            self._live.discard(b)
+            self._ref.pop(b, None)
+            self._cached.pop(b, None)
+            self._drop_hash(b)
             if b not in free_set:
                 self._free.append(b)
                 free_set.add(b)

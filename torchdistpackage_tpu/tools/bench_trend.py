@@ -44,9 +44,14 @@ from typing import Any, Dict, List, Tuple
 #: line: the gate trends overloaded goodput (``value``), and these
 #: columns show whether a goodput hold was bought by shedding more —
 #: a scheduler regression that the headline alone would hide.
+#: ``prefix_hit_rate`` / ``spec_accept_rate`` (PR 10) ride the
+#: ``serve-prefix-*`` / ``serve-spec-*`` fast-path A/B lines: a tokens/s
+#: hold with a collapsed hit or accept rate means the win is coming from
+#: somewhere else (or the workload changed under the gate) — visible
+#: here next to the throughput it buys.
 AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
             "grad_norm_final", "comm_bytes_per_dim", "shed_rate",
-            "preempt_count")
+            "preempt_count", "prefix_hit_rate", "spec_accept_rate")
 
 
 def _aux_str(key: str, val: Any) -> str:

@@ -38,6 +38,16 @@ gating ``value`` (overloaded aggregate tokens/s) plus ``shed_rate``,
 trends all three), and the RUNREPORT ``serving`` section records the
 overload-vs-uncontended A/B (docs/serving.md "Serving under stress").
 
+``--serve --shared-prefix`` and ``--serve --spec K`` add the fast-path
+A/Bs (docs/serving.md "Prefix cache" / "Speculative decoding"): the
+prefix arm replays shared-system-prompt traffic with the prefix cache
+off vs on (paired ``serve-prefix-{cold,warm}`` lines at equal
+``config_hash`` — prefill ticks saved ∝ hit rate), and the spec arm
+replays single-stream greedy requests at ``spec_k`` 0 vs K with token
+BIT-parity asserted between the arms (paired ``serve-spec-{off,on}``
+lines; ``prefix_hit_rate`` / ``spec_accept_rate`` ride the trend's aux
+columns).  CPU-sim rows in docs/BENCH_AB.md.
+
 ``--trace out.json`` additionally prints the comm-ledger summary of the
 compiled decode step (one extra AOT compile) and writes the run's
 Perfetto-loadable Chrome trace — cells appear as instant events on the
@@ -359,6 +369,174 @@ def bench_serve(jax, jnp, cfg, params, tel, *, n_requests, num_slots,
     return summary
 
 
+def _closed_loop(eng, requests):
+    """Submit-all-then-drain through ``eng``; returns (wall_s, summary).
+    Closed-loop on purpose: the fast-path A/Bs measure work ELIMINATED
+    (prefill ticks, decode steps), so arrival gaps would only add noise."""
+    for r in requests:
+        eng.submit(r)
+    t0 = time.perf_counter()
+    eng.run_until_idle()
+    return time.perf_counter() - t0, eng.serving_summary()
+
+
+def bench_serve_prefix(jax, jnp, cfg, params, tel, *, n_requests, num_slots,
+                       block_size, chunk, seed, smoke):
+    """The prefix-cache A/B: every request = one shared system prompt +
+    a short unique tail (the few-shot/system-prompt traffic shape), once
+    through an engine with the prefix cache OFF and once ON — same
+    params, same requests, paired ``serve-prefix-{cold,warm}`` JSON lines
+    at equal ``config_hash``.  The claim under test: prefill ticks saved
+    ∝ hit rate (the warm arm's chunked prefill starts after the cached
+    boundary), with the compile-once signature evidence green in both
+    arms."""
+    import hashlib
+
+    import numpy as np
+
+    from ..serving import Request, ServingEngine
+    from ..utils.logging import master_print
+
+    rng = np.random.RandomState(seed + 2)
+    sys_len = 4 * block_size                 # full blocks: all reusable
+    tail_lens = [2, 3, 4]
+    n_new = 6 if smoke else 12
+    sys_prompt = rng.randint(0, cfg.vocab_size, size=sys_len).tolist()
+    reqs = [Request(sys_prompt
+                    + rng.randint(0, cfg.vocab_size,
+                                  size=int(rng.choice(tail_lens))).tolist(),
+                    n_new)
+            for _ in range(n_requests)]
+    cfg_hash = hashlib.sha1(
+        f"serve-prefix|d{cfg.dim}|L{cfg.nlayers}|n{n_requests}|s{num_slots}"
+        f"|bs{block_size}|c{chunk}|sys{sys_len}|seed{seed}".encode()
+    ).hexdigest()[:12]
+
+    results = {}
+    for arm, warm in (("cold", False), ("warm", True)):
+        eng = ServingEngine(
+            params, cfg, num_slots=num_slots, block_size=block_size,
+            chunk=chunk, max_ctx=sys_len + max(tail_lens) + n_new,
+            prefix_cache=warm)
+        eng.submit(Request(sys_prompt, 2))  # warm the compiled steps
+        eng.run_until_idle()
+        eng.reset_metrics()
+        wall, summary = _closed_loop(eng, [Request(r.tokens, r.max_new_tokens)
+                                           for r in reqs])
+        tok_s = summary["generated_tokens"] / wall if wall > 0 else 0.0
+        line = {
+            "metric": f"serve-prefix-{arm}",
+            "value": round(tok_s, 1),
+            "n_requests": n_requests, "num_slots": num_slots,
+            "shared_prefix_tokens": sys_len,
+            "prefill_chunks": summary["prefill_chunks"],
+            "prefix_hit_rate": round(summary["prefix_hit_rate"], 4),
+            "decode_signatures": summary["decode_signatures"],
+            "prefill_signatures": summary["prefill_signatures"],
+            "config_hash": cfg_hash,
+        }
+        master_print(json.dumps(line), flush=True)
+        results[arm] = (summary, tok_s)
+    cold, warm = results["cold"][0], results["warm"][0]
+    saved = cold["prefill_chunks"] - warm["prefill_chunks"]
+    master_print(json.dumps({
+        "metric": "serve-prefix-ab",
+        "prefill_chunks_saved": saved,
+        "prefill_chunks_saved_frac": round(
+            saved / cold["prefill_chunks"], 4) if cold["prefill_chunks"] else 0,
+        "prefix_hit_rate": round(warm["prefix_hit_rate"], 4),
+        "speedup": round(results["warm"][1] / results["cold"][1], 3)
+        if results["cold"][1] > 0 else None,
+        "config_hash": cfg_hash,
+    }), flush=True)
+    tel.record_serving(warm)
+    return warm
+
+
+def bench_serve_spec(jax, jnp, cfg, params, tel, *, spec_k, n_requests,
+                     num_slots, block_size, chunk, seed, smoke):
+    """The speculative-decoding A/B: the same greedy requests (prompts
+    with self-similar structure, where the n-gram drafter has something
+    to look up) through a ``spec_k=0`` engine and a ``spec_k=K`` engine —
+    paired ``serve-spec-{off,on}`` lines at equal ``config_hash``, with
+    the bit-parity of every emitted token ASSERTED between the arms
+    (greedy verification is exact, so the speedup is free of semantic
+    drift).
+
+    Runs SINGLE-STREAM (``num_slots=1``), the latency regime speculative
+    decoding exists for: at one token per step per sequence, the decode
+    latency floor is the whole story, and each accepted draft removes an
+    entire tick.  ``decode_steps`` off-vs-on is the portable evidence —
+    wall-clock ratios also fold in per-call shape effects of the backend
+    (see docs/BENCH_AB.md for the CPU-sim caveat)."""
+    import hashlib
+
+    import numpy as np
+
+    from ..serving import Request, ServingEngine
+    from ..utils.logging import master_print
+
+    num_slots = 1  # latency regime: the workload spec decoding is FOR
+    n_requests = min(n_requests, 4 if smoke else 6)
+    rng = np.random.RandomState(seed + 3)
+    n_new = 24 if smoke else 48
+    pat_lens = [2, 3, 4]
+    reqs = []
+    for _ in range(n_requests):
+        pat = rng.randint(0, cfg.vocab_size,
+                          size=int(rng.choice(pat_lens))).tolist()
+        prompt = (pat * 8)[:12]  # repetitive: prompt-lookup has targets
+        reqs.append(Request(prompt, n_new))
+    cfg_hash = hashlib.sha1(
+        f"serve-spec|d{cfg.dim}|L{cfg.nlayers}|n{n_requests}|s{num_slots}"
+        f"|bs{block_size}|c{chunk}|new{n_new}|seed{seed}".encode()
+    ).hexdigest()[:12]
+
+    results = {}
+    for arm, k in (("off", 0), ("on", spec_k)):
+        eng = ServingEngine(
+            params, cfg, num_slots=num_slots, block_size=block_size,
+            chunk=chunk, max_ctx=12 + n_new, spec_k=k)
+        eng.submit(Request(reqs[0].tokens, 2))  # warm the compiled steps
+        eng.run_until_idle()
+        eng.reset_metrics()
+        wall, summary = _closed_loop(eng, [Request(r.tokens, r.max_new_tokens)
+                                           for r in reqs])
+        tok_s = summary["generated_tokens"] / wall if wall > 0 else 0.0
+        line = {
+            "metric": f"serve-spec-{arm}",
+            "value": round(tok_s, 1),
+            "spec_k": k, "n_requests": n_requests, "num_slots": num_slots,
+            "decode_steps": summary["decode_steps"],
+            "spec_accept_rate": round(summary["spec_accept_rate"], 4),
+            "decode_signatures": summary["decode_signatures"],
+            "config_hash": cfg_hash,
+        }
+        master_print(json.dumps(line), flush=True)
+        results[arm] = (eng, summary, tok_s)
+    # bit-parity between the arms: greedy verification is exact
+    off_eng, on_eng = results["off"][0], results["on"][0]
+    off_out = sorted((f["rid"], tuple(int(t) for t in f["tokens"]))
+                     for f in off_eng.finished.values())
+    on_out = sorted((f["rid"], tuple(int(t) for t in f["tokens"]))
+                    for f in on_eng.finished.values())
+    assert [t for _, t in off_out] == [t for _, t in on_out], (
+        "speculative arm diverged from non-speculative tokens")
+    off_s, on_s = results["off"][1], results["on"][1]
+    master_print(json.dumps({
+        "metric": "serve-spec-ab",
+        "spec_k": spec_k,
+        "spec_accept_rate": round(on_s["spec_accept_rate"], 4),
+        "decode_steps_saved": off_s["decode_steps"] - on_s["decode_steps"],
+        "speedup": round(results["on"][2] / results["off"][2], 3)
+        if results["off"][2] > 0 else None,
+        "bit_parity": True,
+        "config_hash": cfg_hash,
+    }), flush=True)
+    tel.record_serving(on_s)
+    return on_s
+
+
 def _parse_args(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m torchdistpackage_tpu.tools.decode_bench",
@@ -381,6 +559,16 @@ def _parse_args(argv=None):
                          "(shed_rate, preempt_count, per-priority p99 "
                          "TTFT) and records the overload A/B in the "
                          "RUNREPORT serving section")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="with --serve: add the prefix-cache A/B — every "
+                         "request shares one system prompt; paired "
+                         "serve-prefix-{cold,warm} lines at equal "
+                         "config_hash (prefill ticks saved vs hit rate)")
+    ap.add_argument("--spec", type=int, default=0, metavar="K",
+                    help="with --serve: add the speculative-decoding A/B "
+                         "at static draft width K — paired "
+                         "serve-spec-{off,on} lines at equal config_hash, "
+                         "token bit-parity asserted between the arms")
     ap.add_argument("--serve-requests", type=int, default=None,
                     metavar="N", help="requests in the --serve schedule "
                     "(default: 8 smoke / 24 full)")
@@ -472,9 +660,22 @@ def main(argv=None):
             num_slots=args.slots, block_size=args.block_size,
             chunk=args.chunk, seed=args.seed, smoke=smoke,
             overload=args.overload)
-    elif args.overload:
-        master_print("decode_bench: --overload needs --serve",
-                     file=sys.stderr)
+        if args.shared_prefix:
+            bench_serve_prefix(
+                jax, jnp, cfg, params, tel,
+                n_requests=args.serve_requests or (12 if smoke else 24),
+                num_slots=args.slots, block_size=args.block_size,
+                chunk=args.chunk, seed=args.seed, smoke=smoke)
+        if args.spec:
+            bench_serve_spec(
+                jax, jnp, cfg, params, tel, spec_k=args.spec,
+                n_requests=args.serve_requests or (12 if smoke else 24),
+                num_slots=args.slots, block_size=args.block_size,
+                chunk=args.chunk, seed=args.seed, smoke=smoke)
+    elif args.overload or args.shared_prefix or args.spec:
+        master_print(
+            "decode_bench: --overload/--shared-prefix/--spec need --serve",
+            file=sys.stderr)
         return 2
     for B, ctx in cells:
         r_bf, pre_bf, dec_bf = bench_decode(jax, jnp, cfg, params, B, ctx,
