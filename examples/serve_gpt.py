@@ -12,11 +12,17 @@ ticks only rewrites int32 block tables.
 
 Telemetry wraps the decode step, so the RUNREPORT carries a ``serving``
 section (TTFT/TPOT percentiles — per priority class too — aggregate
-tokens/s, slot occupancy, KV-pool utilization, and the
-``healthy|degraded|overloaded`` verdict) and the event timeline shows
-every admission / prefill chunk / retirement — the serving counterpart
-of the training MFU loop.  CI (tests/test_examples.py) validates all of
-it.
+tokens/s, slot occupancy, KV-pool utilization, the
+``healthy|degraded|overloaded`` verdict with its cited basis, and the
+``slo`` block: per-priority deadline attainment, goodput, TTFT
+calibration) and the event timeline shows every admission / prefill
+chunk / retirement plus the per-tick ``engine_tick`` accounting — the
+serving counterpart of the training MFU loop.  The engine additionally
+streams live ``serving_metrics`` gauges through a Prometheus-textfile
+sink while it runs, and the run proves every completed request's
+lifecycle reconstructs from the event timeline alone
+(docs/serving.md "Serving observability").  CI
+(tests/test_examples.py) validates all of it.
 
 Phase 2 demonstrates the preemption-safe drain contract (docs/serving.md
 "Serving under stress"): with requests in flight, a real SIGTERM (what
@@ -54,8 +60,13 @@ from jax.sharding import NamedSharding
 
 from torchdistpackage_tpu import setup_distributed, tpc
 from torchdistpackage_tpu.models import gpt_param_specs, init_gpt_params, llama_config
-from torchdistpackage_tpu.obs import Telemetry
-from torchdistpackage_tpu.serving import Request, ServingEngine
+from torchdistpackage_tpu.obs import PrometheusTextfileSink, Telemetry
+from torchdistpackage_tpu.serving import (
+    Request,
+    ServingEngine,
+    assemble_request_timelines,
+    lifecycle_phases,
+)
 from torchdistpackage_tpu.utils.preemption import GracefulShutdown
 
 
@@ -88,10 +99,18 @@ def main():
 
     tel = Telemetry(run="serve_gpt", mesh=mesh, poll_memory=not on_cpu)
     num_slots = 4 if smoke else 8
+    # live export: every tick's serving_metrics record lands in a
+    # Prometheus-textfile gauge set an external scraper could watch
+    # while the engine runs (docs/serving.md "Serving observability")
+    prom_path = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"),
+        f"serve_gpt_metrics_{os.getpid()}.prom")
+    metrics_sink = PrometheusTextfileSink(
+        prom_path, prefix="tdp_serving", run="serve_gpt")
     eng = ServingEngine(
         params, cfg, num_slots=num_slots, block_size=8, chunk=8,
         mesh=mesh, axis="tensor", dp_axis="data" if dp > 1 else None,
-        telemetry=tel, snapshot_every=8)
+        telemetry=tel, snapshot_every=8, metrics_sink=metrics_sink)
 
     # fixed-seed Poisson-ish arrivals: requests land every few engine
     # ticks with mixed prompts, budgets, per-request sampling, AND mixed
@@ -139,7 +158,33 @@ def main():
           f"{summary['tokens_per_sec']:.1f} tok/s; "
           f"occupancy {summary['slot_occupancy']['mean']:.0%}, "
           f"pool {summary['kv_pool']['mean_utilization']:.0%}; "
-          f"verdict {summary['verdict']}")
+          f"verdict {summary['verdict']} ({summary['verdict_basis']})")
+
+    # ---- serving observability (PR 11): SLO/goodput, live gauges, trace
+    slo = summary["slo"]
+    assert slo["attainment"] is not None, "deadline traffic left no SLO"
+    assert slo["goodput_tok_s"] <= summary["tokens_per_sec"] + 1e-6
+    assert summary["tick_accounting"]["ticks"] > 0
+    with open(prom_path) as f:
+        prom = f.read()
+    assert "tdp_serving_queue_depth" in prom, "live gauge export missing"
+    assert "tdp_serving_phase_decode_s" in prom
+    # every completed request's lifecycle reconstructs from the event
+    # timeline alone — the request-flow trace the Perfetto export renders
+    timelines = assemble_request_timelines(tel.events.as_list())
+    retired = [r for r in timelines if r["terminal"] == "retired"]
+    assert len(retired) >= n_requests, (len(retired), n_requests)
+    for r in retired:
+        walk = lifecycle_phases(r)
+        assert walk[0] == "queued" and walk[-1] == "retired", walk
+        assert "decode" in walk, walk
+    cal = slo["calibration"]
+    print(f"SLO: goodput {slo['goodput_tok_s']:.1f} tok/s, attainment "
+          f"{slo['attainment']:.0%}; TTFT calibration: {cal['n']} "
+          f"predictions resolved, bias {cal['bias'] or 1.0:.2f}; "
+          f"{len(retired)} lifecycles reconstructed from the trace; "
+          f"live gauges at {prom_path}")
+    os.remove(prom_path)
 
     # ---- phase 2: preemption-safe drain (the SLURM SIGTERM contract) ----
     # Requests in flight, a REAL SIGTERM arrives, run_until_idle drains

@@ -371,6 +371,40 @@ def test_stress_event_kinds_registered_and_emitted():
     assert set(ENGINE_FAULT_KINDS) <= set(FAULT_KINDS)
 
 
+def test_serving_obs_event_kinds_registered_and_emitted():
+    """The serving-observability kinds (PR 11) are in the registry AND
+    each is actually emitted from ``serving/`` — ``request_submitted``
+    anchors every lifecycle trace's queued span, ``request_resumed`` is
+    the flow link a request track follows across a drain→resume engine
+    restart, and ``engine_tick`` carries the per-tick phase accounting
+    plus the per-rid attribution the whole request trace is assembled
+    from; a kind that stopped being emitted would silently blind the
+    trace assembly (serving/tracing.py) and the serving_metrics export
+    built on it."""
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+
+    obs_kinds = {"request_submitted", "request_resumed", "engine_tick"}
+    assert obs_kinds <= EVENT_KINDS
+    emitted = set()
+    for path in sorted((PKG / "serving").rglob("*.py")):
+        emitted.update(k for _, k in _emit_call_kinds(path))
+    missing = obs_kinds - emitted
+    assert not missing, (
+        f"serving-obs kinds never emitted from serving/: {missing}")
+    # and the trace assembler actually consumes what the engine emits:
+    # every kind it dispatches on must be a registered kind (a renamed
+    # kind would silently empty the lifecycle records)
+    from torchdistpackage_tpu.serving import tracing as _tracing
+
+    src = (PKG / "serving" / "tracing.py").read_text()
+    for kind in ("request_submitted", "request_admitted", "engine_tick",
+                 "request_preempted", "engine_recovered",
+                 "request_retired", "request_cancelled", "request_shed",
+                 "request_expired", "engine_drained", "request_resumed"):
+        assert kind in EVENT_KINDS and kind in src, kind
+    assert _tracing.SERVING_METRICS_SCHEMA.startswith("tdp-serving-metrics")
+
+
 def test_fastpath_event_kinds_registered_and_emitted():
     """The serving fast-path kinds (PR 10) are in the registry AND each
     is actually emitted from ``serving/`` — the prefix-cache hit/COW/

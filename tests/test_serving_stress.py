@@ -86,6 +86,7 @@ def _fresh(eng):
     eng.watchdog = None
     eng._draining = False
     eng._tick_ewma = None
+    eng._ttft_bias = None  # calibration is measurement state, like the EWMA
     eng._inject.clear()
     return eng
 

@@ -272,6 +272,30 @@ def test_bench_trend_fastpath_columns():
     assert not any("serve-spec-on" in w for w in warnings)  # -1% holds
 
 
+def test_bench_trend_slo_columns():
+    """The PR-11 SLO columns: the ``serve-overload`` line's raw tokens/s
+    still gates (``value``), and ``goodput_tok_s`` / ``slo_attainment``
+    render alongside — a throughput hold bought by missing every
+    deadline (goodput collapsing under a steady headline) is visible in
+    the trend, and a goodput-line regression still trips the gate when
+    trended as its own series."""
+    from torchdistpackage_tpu.tools.bench_trend import AUX_KEYS, trend
+
+    assert {"slo_attainment", "goodput_tok_s"} <= set(AUX_KEYS)
+    line = {"metric": "serve-overload", "value": 850.0,
+            "shed_rate": 0.2, "preempt_count": 3,
+            "goodput_tok_s": 800.0, "slo_attainment": 0.92, "config": "c"}
+    report, warnings = trend(
+        [(1, [line]),
+         (2, [dict(line, goodput_tok_s=120.0, slo_attainment=0.15)])],
+        threshold=0.05)
+    assert any("goodput_tok_s=800.0" in ln for ln in report)
+    assert any("slo_attainment=0.92" in ln for ln in report)
+    assert any("slo_attainment=0.15" in ln for ln in report)
+    # headline held -> no gate trip; the collapse is VISIBLE in the aux
+    assert not warnings
+
+
 def test_bench_trend_comm_bytes_column():
     """The PR-8 wire-bytes column: a line carrying ``comm_bytes_per_dim``
     renders its TOTAL in the aux trail, so a compressed collective

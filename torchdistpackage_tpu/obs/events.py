@@ -141,6 +141,29 @@ decoding"):
                             blocks (LRU) to cover a fresh allocation
 ==========================  =============================================
 
+Serving observability kinds (``serving/engine.py`` + ``serving/tracing.py``,
+PR 11 — request-lifecycle tracing + tick accounting; docs/serving.md
+"Serving observability"):
+
+==========================  =============================================
+``request_submitted``       a request entered ``submit()`` (rid assigned)
+                            — the anchor of the lifecycle trace's
+                            ``queued`` span, emitted before any
+                            shed/admission decision
+``request_resumed``         ``resume()`` re-submitted a drain descriptor;
+                            the record carries ``orig_rid``, the flow
+                            link a Perfetto request track follows across
+                            an engine restart
+``engine_tick``             one engine tick's host-side accounting:
+                            per-phase durations (audit / sched / prefill
+                            / draft / decode / fetch / host), queue
+                            depth, slot occupancy, batch + pool
+                            utilization, live hit/accept rates, and the
+                            per-rid prefill/decode attribution the
+                            request trace is assembled from (emitted
+                            only for ticks that did work)
+==========================  =============================================
+
 A module-level default log lets deep call sites (signal handlers, debug
 callbacks) emit without plumbing a handle through every layer:
 ``emit_event("preemption", signum=15)``.
@@ -179,6 +202,8 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     "engine_drained",
     # serving fast path (PR 10)
     "prefix_hit", "block_cow", "spec_draft", "spec_verify", "cache_evict",
+    # serving observability (PR 11)
+    "request_submitted", "request_resumed", "engine_tick",
     # memory observability (PR 6)
     "mem_snapshot", "oom_risk",
     # numerics observability (PR 7)

@@ -13,6 +13,15 @@ train loop wires its telemetry once and the operator picks destinations:
   format, written atomically; no client library needed (the textfile
   format is plain ``name{labels} value`` lines).
 - :class:`MultiSink` — fan-out.
+
+The serving engine's live export rides the same protocol:
+``ServingEngine(metrics_sink=...)`` writes one ``serving_metrics``
+record per tick (schema
+:data:`~..serving.tracing.SERVING_METRICS_SCHEMA`, fields documented in
+docs/serving.md "Serving observability") — through
+:class:`PrometheusTextfileSink` that is a live per-tick gauge set
+(queue depth, slot occupancy, batch/pool utilization, per-phase tick
+seconds) an external scraper can watch while the engine runs.
 """
 
 from __future__ import annotations

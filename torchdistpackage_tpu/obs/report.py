@@ -270,6 +270,24 @@ def _validate_serving(srv: Any) -> List[str]:
         errs.append(
             f"serving.verdict {srv['verdict']!r} not in {SERVING_VERDICTS}")
     reqs = srv.get("requests", {})
+    # the verdict must cite evidence (PR 11) AND agree with the counters
+    # that define it — a verdict whose own numbers contradict it is a
+    # reporting bug, surfaced here instead of trusted downstream
+    if "verdict_basis" in srv and (
+            not isinstance(srv["verdict_basis"], str)
+            or not srv["verdict_basis"]):
+        errs.append("serving.verdict_basis empty/non-string")
+    if "verdict" in srv and srv["verdict"] in SERVING_VERDICTS:
+        refused = reqs.get("shed", 0) + reqs.get("expired", 0)
+        degraded = (reqs.get("preempted", 0)
+                    + (srv.get("faults") or {}).get("detected", 0))
+        want = ("overloaded" if refused > 0
+                else "degraded" if degraded > 0 else "healthy")
+        if srv["verdict"] != want:
+            errs.append(
+                f"serving.verdict {srv['verdict']!r} contradicts its "
+                f"evidence (shed+expired={refused}, "
+                f"preempted+faults={degraded} -> {want!r})")
     for key in ("shed", "expired", "cancelled", "preempted", "resumed"):
         if key in reqs and (not isinstance(reqs[key], int) or reqs[key] < 0):
             errs.append(f"serving.requests.{key} non-int/negative")
@@ -298,6 +316,72 @@ def _validate_serving(srv: Any) -> List[str]:
             not isinstance(spec, dict)
             or spec.get("accepted", 0) > spec.get("drafted", 0)):
         errs.append("serving.spec malformed (accepted > drafted)")
+    errs.extend(_validate_serving_slo(srv))
+    return errs
+
+
+def _validate_serving_slo(srv: Dict[str, Any]) -> List[str]:
+    """The ``serving.slo`` sub-section (PR 11): per-priority deadline
+    attainment in [0, 1], goodput bounded by the aggregate tokens/s
+    (goodput counts a SUBSET of the generated tokens over the same
+    span), and the TTFT calibration record's ranges (positive bias,
+    non-negative relative errors)."""
+    slo = srv.get("slo")
+    if slo is None:
+        return []
+    if not isinstance(slo, dict):
+        return [f"serving.slo is {type(slo).__name__}, expected dict"]
+    errs: List[str] = []
+    gp = slo.get("goodput_tok_s")
+    if not isinstance(gp, (int, float)) or gp < 0:
+        errs.append("serving.slo.goodput_tok_s missing/negative")
+    tps = srv.get("tokens_per_sec")
+    if (isinstance(gp, (int, float)) and isinstance(tps, (int, float))
+            and gp > tps * 1.001 + 1e-9):
+        errs.append(
+            f"serving.slo.goodput_tok_s {gp} exceeds tokens_per_sec {tps}")
+    att = slo.get("attainment")
+    if att is not None and (
+            not isinstance(att, (int, float)) or not 0.0 <= att <= 1.0):
+        errs.append("serving.slo.attainment out of [0, 1]")
+    for p, row in (slo.get("priorities") or {}).items():
+        if not isinstance(row, dict):
+            errs.append(f"serving.slo.priorities[{p}] non-dict")
+            continue
+        for k in ("completed", "met", "missed", "shed", "expired",
+                  "goodput_tokens"):
+            v = row.get(k)
+            if not isinstance(v, int) or v < 0:
+                errs.append(f"serving.slo.priorities[{p}].{k} "
+                            "missing/negative")
+                break
+        else:
+            if row["met"] + row["missed"] != row["completed"]:
+                errs.append(
+                    f"serving.slo.priorities[{p}]: met+missed != completed")
+        ra = row.get("attainment")
+        if ra is not None and (
+                not isinstance(ra, (int, float)) or not 0.0 <= ra <= 1.0):
+            errs.append(f"serving.slo.priorities[{p}].attainment "
+                        "out of [0, 1]")
+    cal = slo.get("calibration")
+    if cal is not None:
+        if not isinstance(cal, dict):
+            errs.append("serving.slo.calibration non-dict")
+            return errs
+        bias = cal.get("bias")
+        if bias is not None and (
+                not isinstance(bias, (int, float)) or bias <= 0):
+            errs.append("serving.slo.calibration.bias non-positive")
+        if not isinstance(cal.get("n"), int) or cal["n"] < 0:
+            errs.append("serving.slo.calibration.n missing/negative")
+        for p, row in (cal.get("priorities") or {}).items():
+            for k, v in (row or {}).items():
+                if k.startswith("rel_err_") and (
+                        not isinstance(v, (int, float)) or v < 0):
+                    errs.append(
+                        f"serving.slo.calibration.priorities[{p}].{k} "
+                        "negative/non-numeric")
     return errs
 
 
@@ -359,6 +443,11 @@ def render_summary_line(report: Dict[str, Any]) -> str:
         if isinstance(p50, (int, float)):
             tail = f"(ttft p50 {p50 * 1e3:.0f}ms)"
         parts.append(f"serve={srv['tokens_per_sec']:.1f}tok/s{tail}")
+        slo = srv.get("slo") or {}
+        if slo.get("attainment") is not None:
+            parts.append(
+                f"goodput={slo.get('goodput_tok_s', 0.0):.1f}tok/s"
+                f"(att {slo['attainment']:.0%})")
         if srv.get("verdict") and srv["verdict"] != "healthy":
             reqs = srv.get("requests", {})
             detail = ", ".join(
@@ -634,7 +723,9 @@ def render_markdown(report: Dict[str, Any]) -> str:
                           "resumed")
                 if reqs.get(k))
             L.append(f"- verdict: **{srv['verdict']}**"
-                     + (f" ({stress})" if stress else ""))
+                     + (f" ({stress})" if stress else "")
+                     + (f" — {srv['verdict_basis']}"
+                        if srv.get("verdict_basis") else ""))
         faults = srv.get("faults") or {}
         if faults.get("detected"):
             L.append(f"- faults: {faults['detected']} detected, "
@@ -700,6 +791,49 @@ def render_markdown(report: Dict[str, Any]) -> str:
             f"{srv.get('prefill_chunks', 0)} prefill chunks; "
             f"{srv.get('decode_signatures', '?')} decode signature(s) "
             f"compiled")
+        slo = srv.get("slo") or {}
+        if slo:
+            att = slo.get("attainment")
+            L.append(
+                f"- SLO goodput: **{slo.get('goodput_tok_s', 0.0):.1f} "
+                f"tok/s** ({slo.get('goodput_tokens', 0)} deadline-meeting "
+                f"tokens)"
+                + (f", attainment **{att:.0%}**" if att is not None
+                   else " — no deadlines submitted"))
+            cal = slo.get("calibration") or {}
+            if cal.get("n"):
+                bias = cal.get("bias")
+                L.append(
+                    f"- TTFT calibration: {cal['n']} prediction(s) "
+                    f"resolved, EWMA bias "
+                    + (f"**{bias:.3f}**" if isinstance(bias, (int, float))
+                       else "unset")
+                    + f" ({cal.get('pending', 0)} pending)")
+            sp = slo.get("priorities") or {}
+            if sp:
+                L.append("")
+                L.append("| priority | completed | met | missed | shed "
+                         "| expired | attainment | goodput tokens |")
+                L.append("|---|---|---|---|---|---|---|---|")
+                for p in sorted(sp, key=lambda x: -int(x)):
+                    row = sp[p]
+                    ra = row.get("attainment")
+                    L.append(
+                        f"| {p} | {row.get('completed', 0)} "
+                        f"| {row.get('met', 0)} | {row.get('missed', 0)} "
+                        f"| {row.get('shed', 0)} | {row.get('expired', 0)} "
+                        f"| " + (f"{ra:.0%}" if ra is not None else "-")
+                        + f" | {row.get('goodput_tokens', 0)} |")
+                L.append("")
+        ta = srv.get("tick_accounting") or {}
+        if ta.get("ticks"):
+            pm = ta.get("phases_mean_s") or {}
+            L.append(
+                f"- tick accounting: {ta['ticks']} ticks, mean "
+                f"{ta.get('mean_tick_s', 0.0) * 1e3:.2f} ms ("
+                + ", ".join(f"{k} {v * 1e3:.2f}" for k, v in pm.items()
+                            if v > 0)
+                + " ms)")
         L.append("")
 
     counters = report.get("counters", {})
@@ -727,7 +861,14 @@ def render_markdown(report: Dict[str, Any]) -> str:
         L.append("## Event timeline")
         L.append("")
         t0 = events[0]["t_mono"]
+        n_ticks = sum(1 for ev in events if ev.get("kind") == "engine_tick")
+        if n_ticks:
+            # per-tick accounting is trace material, not summary material
+            L.append(f"- ({n_ticks} `engine_tick` record(s) elided — "
+                     f"scrub them in the Perfetto trace)")
         for ev in events:
+            if ev.get("kind") == "engine_tick":
+                continue
             extras = {k: v for k, v in ev.items()
                       if k not in ("type", "kind", "t_wall", "t_mono", "process")
                       and v is not None}

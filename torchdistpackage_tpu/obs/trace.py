@@ -6,7 +6,11 @@ complete events on per-phase tracks, the :mod:`.events` timeline as
 instant events, per-step counter tracks (comm-ledger bytes, HBM bytes,
 and the numerics ``grad_norm`` / ``update_ratio``), all in the Chrome
 trace-event JSON format that ``chrome://tracing`` and
-https://ui.perfetto.dev load directly.
+https://ui.perfetto.dev load directly.  A timeline that carries serving
+events additionally renders the serving-observability layer
+(serving/tracing.py): one async flow track per request (queued →
+prefill → decode across preemptions and a drain→resume restart), engine
+tick phase lanes, and queue/occupancy/utilization counter tracks.
 
 Two layers of truth:
 
@@ -80,7 +84,10 @@ def chrome_trace_events(
     stamped = [r for r in history if "t_end_s" in r]
     ev_list = list(events)
     t0_candidates = [r["t_end_s"] - r.get("step_time_s", 0.0) for r in stamped]
-    t0_candidates += [e["t_mono"] for e in ev_list if "t_mono" in e]
+    # engine_tick events span [t_start, t_mono]; anchoring t0 on t_mono
+    # alone would push their spans to negative timestamps
+    t0_candidates += [e.get("t_start", e["t_mono"])
+                      for e in ev_list if "t_mono" in e]
     if not t0_candidates:
         return _metadata_events(process, run)
     t0 = min(t0_candidates)
@@ -146,6 +153,10 @@ def chrome_trace_events(
     for e in ev_list:
         if "t_mono" not in e:
             continue
+        if e.get("kind") == "engine_tick":
+            # rendered as phase lanes + counter tracks below, not as a
+            # per-tick instant (hundreds of identical pins are noise)
+            continue
         args = {k: v for k, v in e.items()
                 if k not in ("type", "kind", "t_wall", "t_mono", "process")
                 and v is not None}
@@ -154,6 +165,18 @@ def chrome_trace_events(
             "pid": process, "tid": 0, "ts": us(e["t_mono"]), "s": "t",
             "args": args,
         })
+    # serving observability: when the timeline carries serving events,
+    # append the request-lifecycle flow tracks and the tick phase lanes /
+    # counter tracks (serving/tracing.py), on the SAME t0 axis.  Local
+    # import: obs stays a leaf at module scope.
+    if any(e.get("kind") in ("engine_tick", "request_submitted")
+           for e in ev_list):
+        try:
+            from ..serving.tracing import serving_trace_events
+        except ImportError:
+            serving_trace_events = None
+        if serving_trace_events is not None:
+            out.extend(serving_trace_events(ev_list, process=process, t0=t0))
     return out
 
 

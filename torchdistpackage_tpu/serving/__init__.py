@@ -32,9 +32,34 @@ system-prompt traffic prefills once per PREFIX; ``spec_k=K`` adds
 self-speculative decoding at a static draft width (host n-gram drafter,
 one compiled verify program over all k+1 positions, temp-0 bit-exact,
 sampled rows via residual rejection sampling).  See docs/serving.md.
+
+Observability (docs/serving.md "Serving observability"): every tick is
+decomposed host-side into phase accounting (:mod:`.tracing` —
+``engine_tick`` events, Perfetto phase lanes + counter tracks, the
+``serving_metrics`` live-export schema), the event timeline reconstructs
+each request's full lifecycle as a flow-linked Perfetto track (queued →
+prefill → decode across preemptions and drain→resume), and
+``serving_summary()['slo']`` reports per-priority deadline attainment,
+goodput, and the predicted-vs-actual TTFT calibration whose bias feeds
+back into ``estimate_ttft`` — all host arithmetic, zero extra compiled
+programs.
 """
 
 from .engine import Request, ServingEngine
+from .tracing import (
+    REQUEST_PHASES,
+    REQUEST_TERMINALS,
+    SERVING_METRICS_SCHEMA,
+    TICK_PHASES,
+    assemble_request_timelines,
+    lifecycle_phases,
+    phase_table,
+    request_trace_events,
+    serving_metrics_record,
+    serving_trace_events,
+    tick_trace_events,
+    validate_request_record,
+)
 from .paged_cache import (
     NULL_BLOCK,
     BlockAllocator,
@@ -54,6 +79,18 @@ from .paged_cache import (
 __all__ = [
     "Request",
     "ServingEngine",
+    "REQUEST_PHASES",
+    "REQUEST_TERMINALS",
+    "SERVING_METRICS_SCHEMA",
+    "TICK_PHASES",
+    "assemble_request_timelines",
+    "lifecycle_phases",
+    "phase_table",
+    "request_trace_events",
+    "serving_metrics_record",
+    "serving_trace_events",
+    "tick_trace_events",
+    "validate_request_record",
     "NULL_BLOCK",
     "BlockAllocator",
     "block_size_of",

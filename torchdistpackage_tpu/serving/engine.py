@@ -107,20 +107,34 @@ two-compiled-programs invariant survives every path:
   (``tools/parity_diff.py``-gated in tests), sampled ones continue their
   key stream.
 
-Observability: every lifecycle transition is a structured event
-(``request_admitted`` / ``prefill_chunk`` / ``request_retired`` /
+Observability (docs/serving.md "Serving observability"): every lifecycle
+transition is a structured event (``request_submitted`` /
+``request_admitted`` / ``prefill_chunk`` / ``request_retired`` /
 ``slots_snapshot`` plus the stress kinds ``request_preempted`` /
 ``request_shed`` / ``request_expired`` / ``request_cancelled`` /
-``engine_fault_detected`` / ``engine_recovered`` / ``engine_drained``),
-decode ticks are Telemetry steps when a session is wired in, and
-:meth:`ServingEngine.serving_summary` is the RUNREPORT ``serving``
-section — per-priority TTFT/TPOT percentiles, shed/preempt/expire
-counts, and a ``healthy | degraded | overloaded`` verdict next to the
-PR-5 aggregates.
+``engine_fault_detected`` / ``engine_recovered`` / ``engine_drained`` /
+``request_resumed``), decode ticks are Telemetry steps when a session is
+wired in, and every tick leaves a host-side accounting record — the
+:data:`~.tracing.TICK_PHASES` decomposition (audit / sched / prefill /
+draft / decode / fetch / host) plus queue/occupancy/utilization gauges —
+on ``tick_records``, the ``engine_tick`` timeline (with per-rid
+attribution, from which serving/tracing.py reconstructs each request's
+full lifecycle as a Perfetto flow track), and the optional
+``metrics_sink=`` live export (``serving_metrics`` schema through the
+obs exporter sinks).  :meth:`ServingEngine.serving_summary` is the
+RUNREPORT ``serving`` section — per-priority TTFT/TPOT percentiles,
+shed/preempt/expire counts, the ``slo`` block (per-priority deadline
+attainment, goodput counting only deadline-meeting tokens, and the
+predicted-vs-actual TTFT calibration whose EWMA bias feeds back into
+:meth:`estimate_ttft`), and a ``healthy | degraded | overloaded``
+verdict that cites its evidence, next to the PR-5 aggregates.  All of
+it is host arithmetic around the same compiled calls:
+``decode_signatures == 1`` survives every traced/metered path.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -143,6 +157,7 @@ from .paged_cache import (
     paged_forward_moe,
     pool_bytes,
 )
+from .tracing import TICK_PHASES, serving_metrics_record
 
 # slot lifecycle
 FREE, PREFILL, DECODE = "free", "prefill", "decode"
@@ -312,6 +327,14 @@ class ServingEngine:
         injection seam the recovery paths are proven against.
     watchdog: a :class:`~..resilience.Watchdog`; the engine beats it once
         per tick so a wedged tick escalates to ``hang_suspected``/abort.
+    metrics_sink: any obs exporter sink (``write(record)`` — e.g.
+        :class:`~..obs.exporters.PrometheusTextfileSink` or ``JsonlSink``);
+        every ``metrics_every``-th tick writes a ``serving_metrics``
+        record (:data:`~.tracing.SERVING_METRICS_SCHEMA`) so an external
+        scraper can watch queue depth, slot occupancy, batch utilization,
+        and the per-phase tick breakdown of a RUNNING engine.
+    tick_history: bound on the in-memory per-tick accounting records
+        (``tick_records``; oldest dropped first, like the event log).
     """
 
     def __init__(
@@ -337,6 +360,9 @@ class ServingEngine:
         watchdog: Optional[Any] = None,
         prefix_cache: bool = False,
         spec_k: int = 0,
+        metrics_sink: Optional[Any] = None,
+        metrics_every: int = 1,
+        tick_history: int = 4096,
     ) -> None:
         if (axis is not None or dp_axis is not None) and mesh is None:
             raise ValueError("axis/dp_axis need a mesh")
@@ -368,6 +394,11 @@ class ServingEngine:
         self.watchdog = watchdog
         self.prefix_cache = bool(prefix_cache)
         self.spec_k = int(spec_k)
+        if metrics_every < 1:
+            raise ValueError(f"metrics_every must be >= 1, got {metrics_every}")
+        self.metrics_sink = metrics_sink
+        self.metrics_every = int(metrics_every)
+        self.tick_history = int(tick_history)
         self._ev: EventLog = (
             telemetry.events if telemetry is not None else default_event_log())
 
@@ -417,6 +448,14 @@ class ServingEngine:
         self._inject: Dict[int, Dict[str, Any]] = {}  # resume key/prefix
         self._draining = False
         self._tick_ewma: Optional[float] = None
+        #: EWMA of measured-TTFT / raw-estimate — the calibration factor
+        #: estimate_ttft applies (None until a prediction resolved; like
+        #: _tick_ewma it is measurement state, NOT reset by reset_metrics)
+        self._ttft_bias: Optional[float] = None
+        self._phase: Dict[str, float] = collections.defaultdict(float)
+        self._tick_prefill_rids: List[int] = []
+        self._tick_decode_rids: List[int] = []
+        self._tick_emitted = 0
         self._pending_cow: List[Tuple[int, int, int]] = []  # slot, src, dst
         self._step_fn = self._build_step()
         self._decode_fn = (
@@ -645,6 +684,33 @@ class ServingEngine:
         request rejoins ahead of younger peers of its own class."""
         self.queue.sort(key=lambda e: (-e[0].priority, self._seq[e[0].rid]))
 
+    def _slo_row(self, priority: int) -> Dict[str, int]:
+        """Per-priority SLO accumulator: completed/met/missed service plus
+        the demand the engine refused (shed/expired) — the attainment
+        denominator counts refusals as misses, because a shed request's
+        deadline was not met however principled the refusal was."""
+        return self._slo_by_prio.setdefault(int(priority), {
+            "completed": 0, "met": 0, "missed": 0,
+            "shed": 0, "expired": 0, "goodput_tokens": 0})
+
+    def _resolve_ttft(self, rid: int, actual: float, priority: int) -> None:
+        """Close the loop on one admission-time TTFT prediction: update
+        the calibration bias EWMA (measured / RAW estimate — the raw one,
+        so the feedback converges to the true factor instead of its
+        square root) and record the relative error of the estimate
+        admission actually used (the biased one) for the RUNREPORT
+        ``serving.slo.calibration`` percentiles."""
+        pred = self._ttft_pred.pop(rid, None)
+        if pred is None or actual <= 0 or pred["raw"] <= 0:
+            return
+        ratio = actual / pred["raw"]
+        self._ttft_bias = (
+            ratio if self._ttft_bias is None
+            else 0.8 * self._ttft_bias + 0.2 * ratio)
+        self._calib_n += 1
+        self._calib_by_prio.setdefault(int(priority), []).append(
+            abs(actual - pred["est"]) / actual)
+
     def estimate_ttft(self, prompt_len: int,
                       tokens: Optional[Sequence[int]] = None) -> Optional[float]:
         """Estimated seconds until a request of ``prompt_len`` submitted
@@ -659,7 +725,14 @@ class ServingEngine:
         already RESIDENT are subtracted (for the candidate and for every
         queued request) — a warm shared-prefix request costs what it will
         actually cost, not its cold estimate, so the PR-9 deadline gate
-        does not shed warm traffic spuriously."""
+        does not shed warm traffic spuriously.
+
+        The raw (ticks x tick-EWMA) estimate is multiplied by the
+        engine's TTFT calibration bias — the EWMA of measured-TTFT /
+        raw-estimate over resolved predictions (``_resolve_ttft``), the
+        RUNREPORT ``serving.slo.calibration`` record — so admission
+        stops trusting a systematically miscalibrated model instead of
+        shedding (or admitting) on it forever."""
         if self._tick_ewma is None:
             return None
         if self.prefix_cache and tokens is not None:
@@ -679,7 +752,8 @@ class ServingEngine:
                     max(0, pre + s.req.max_new_tokens - len(s.generated)))
             if remaining:
                 ticks += min(remaining)
-        return ticks * self._tick_ewma
+        raw = ticks * self._tick_ewma
+        return raw * (self._ttft_bias if self._ttft_bias is not None else 1.0)
 
     def _shed(self, req: Request, t_submit: float, reason: str,
               **extra: Any) -> None:
@@ -693,6 +767,8 @@ class ServingEngine:
         }
         self.rejected[req.rid] = verdict
         self.stats["shed"] += 1
+        self._slo_row(req.priority)["shed"] += 1
+        self._ttft_pred.pop(req.rid, None)
         self._ev.emit("request_shed", **verdict)
 
     def submit(self, req: Request) -> int:
@@ -721,6 +797,20 @@ class ServingEngine:
         self._next_rid += 1
         self._seq[req.rid] = req.rid  # submit order IS the FIFO age
         t_submit = time.perf_counter()
+        self._ev.emit(
+            "request_submitted", rid=req.rid, prompt_len=int(P),
+            max_new_tokens=int(N), priority=req.priority,
+            deadline_s=req.deadline_s)
+        # the admission model's prediction, recorded for calibration: the
+        # biased estimate is what the deadline gate trusts, the raw one is
+        # what the bias EWMA learns against (_resolve_ttft at first token)
+        est = self.estimate_ttft(P, tokens=req.tokens)
+        if est is not None and est > 0:
+            self._ttft_pred[req.rid] = {
+                "est": est,
+                "raw": est / (self._ttft_bias
+                              if self._ttft_bias is not None else 1.0),
+            }
         if self._draining:
             self._shed(req, t_submit, "draining")
             return req.rid
@@ -728,7 +818,6 @@ class ServingEngine:
             self._shed(req, t_submit, "queue_full", max_queue=self.max_queue)
             return req.rid
         if req.deadline_s is not None:
-            est = self.estimate_ttft(P, tokens=req.tokens)
             if est is not None and est > req.deadline_s:
                 self._shed(req, t_submit, "deadline_unmeetable",
                            est_ttft_s=round(est, 6))
@@ -746,6 +835,7 @@ class ServingEngine:
             if req.deadline_s is not None and now - t_submit > req.deadline_s:
                 expired += 1
                 self.stats["expired"] += 1
+                self._slo_row(req.priority)["expired"] += 1
                 verdict = {
                     "rid": req.rid, "reason": "expired",
                     "priority": req.priority, "deadline_s": req.deadline_s,
@@ -753,6 +843,7 @@ class ServingEngine:
                 }
                 self.rejected[req.rid] = verdict
                 self._inject.pop(req.rid, None)
+                self._ttft_pred.pop(req.rid, None)
                 self._ev.emit("request_expired", **verdict)
             else:
                 keep.append((req, t_submit))
@@ -796,6 +887,11 @@ class ServingEngine:
         self._release_blocks(alloc, s.blocks)
         self._clear_slot_rows(i)
         s.reset()
+        # the admission-time TTFT prediction's premise (the queue as it
+        # stood at submit) was invalidated by SCHEDULING, not by tick-time
+        # misestimation — resolving it would teach the bias the wrong
+        # lesson, so it is dropped instead
+        self._ttft_pred.pop(rid, None)
         self.queue.append((req, t_submit))
         self._queue_sort()
         return rid
@@ -1017,12 +1113,16 @@ class ServingEngine:
             tokens[i, :len(sl)] = sl
             offsets[i] = s.off
             last_idx[i] = min(len(s.prompt) - 1 - s.off, C - 1)
+        t_disp = time.perf_counter()
         self.cache, tok, keys = self._step_fn(
             self.params, self.cache, tokens, tables, offsets, last_idx,
             self._samp(), self._keys)
         self._prefill_sigs.add(("prefill",) + self._sig(tokens))
+        t_fetch = time.perf_counter()
+        self._phase["prefill"] += t_fetch - t_disp
         tok = np.asarray(tok)
         keys = np.asarray(keys)
+        self._phase["fetch"] += time.perf_counter() - t_fetch
         if self.chaos is not None:
             tok = self.chaos.perturb_engine_tokens(self._tick, tok)
         now = time.perf_counter()
@@ -1050,12 +1150,15 @@ class ServingEngine:
                             s.blocks):
                         alloc.register(blk, bh)
                 s.ttft_s = now - s.t_submit
+                self._resolve_ttft(s.rid, s.ttft_s, int(s.req.priority))
                 s.t_last = now
                 self._lengths[i] = len(s.prompt)
                 self._last_tok[i] = tok[i]
                 s.generated.append(int(tok[i]))
+                self._tick_emitted += 1
                 self._maybe_retire(i, int(tok[i]), now)
         self.stats["prefill_chunks"] += 1
+        self._tick_prefill_rids = rids
         self._ev.emit("prefill_chunk", rids=rids, chunk=C,
                       n_slots=len(rids))
         return len(rids)
@@ -1070,14 +1173,20 @@ class ServingEngine:
         tokens = np.where(mask, self._last_tok, 0).astype(np.int32)[:, None]
         offsets = np.where(mask, self._lengths, 0).astype(np.int32)
         last_idx = np.zeros(self.num_slots, np.int32)
+        self._tick_decode_rids = [
+            s.rid for s in self._slots if s.state == DECODE]
+        t_disp = time.perf_counter()
         self.cache, tok, keys = self._decode_fn(
             self.params, self.cache, tokens, tables, offsets, last_idx,
             self._samp(), self._keys)
         self._decode_sigs.add(("decode",) + self._sig(tokens))
+        t_fetch = time.perf_counter()
+        self._phase["decode"] += t_fetch - t_disp
         if self.telemetry is not None:
             self.telemetry.end_step(active_slots=n_active)
         tok = np.asarray(tok)
         keys = np.asarray(keys)
+        self._phase["fetch"] += time.perf_counter() - t_fetch
         if self.chaos is not None:
             tok = self.chaos.perturb_engine_tokens(self._tick, tok)
         now = time.perf_counter()
@@ -1091,6 +1200,7 @@ class ServingEngine:
             self._lengths[i] += 1
             self._last_tok[i] = tok[i]
             s.generated.append(int(tok[i]))
+            self._tick_emitted += 1
             s.tpot_s.append(now - s.t_last)
             s.t_last = now
             self._maybe_retire(i, int(tok[i]), now)
@@ -1146,6 +1256,7 @@ class ServingEngine:
         K = self.spec_k
         tokens = np.zeros((self.num_slots, K + 1), np.int32)
         offsets = np.where(mask, self._lengths, 0).astype(np.int32)
+        t_draft = time.perf_counter()
         rids = []
         for i, s in enumerate(self._slots):
             if s.state != DECODE:
@@ -1153,16 +1264,22 @@ class ServingEngine:
             rids.append(s.rid)
             tokens[i, 0] = self._last_tok[i]
             tokens[i, 1:] = self._draft(s)
+        self._phase["draft"] += time.perf_counter() - t_draft
+        self._tick_decode_rids = rids
         self._ev.emit("spec_draft", k=K, n_slots=len(rids), rids=rids)
+        t_disp = time.perf_counter()
         self.cache, verify, accept, keys = self._verify_fn(
             self.params, self.cache, tokens, tables, offsets, self._samp(),
             self._keys)
         self._decode_sigs.add(("decode",) + self._sig(tokens))
+        t_fetch = time.perf_counter()
+        self._phase["decode"] += t_fetch - t_disp
         if self.telemetry is not None:
             self.telemetry.end_step(active_slots=n_active)
         verify = np.asarray(verify)
         accept = np.asarray(accept)
         keys = np.asarray(keys)
+        self._phase["fetch"] += time.perf_counter() - t_fetch
         if self.chaos is not None:
             verify = self.chaos.perturb_engine_tokens(self._tick, verify)
         now = time.perf_counter()
@@ -1201,6 +1318,7 @@ class ServingEngine:
             self.stats["spec_accepted"] += max(0, took - 1)
             accepted_total += max(0, took - 1)
             emitted_total += took
+            self._tick_emitted += took
             self._lengths[i] += took
             self._last_tok[i] = s.generated[-1]
             per_tok = (now - s.t_last) / took
@@ -1250,6 +1368,7 @@ class ServingEngine:
             "t_done": now,
         }
         self._inject.pop(s.rid, None)
+        self._ttft_pred.pop(s.rid, None)
         if completed:
             self._ttfts.append(s.ttft_s)
             self._tpots.extend(s.tpot_s)
@@ -1257,6 +1376,16 @@ class ServingEngine:
             if s.ttft_s is not None:
                 self._ttfts_by_prio.setdefault(prio, []).append(s.ttft_s)
             self._tpots_by_prio.setdefault(prio, []).extend(s.tpot_s)
+            # SLO accounting: a request with no deadline meets by
+            # definition; only deadline-meeting service counts as goodput
+            met = (s.req.deadline_s is None
+                   or (s.ttft_s is not None
+                       and s.ttft_s <= s.req.deadline_s))
+            row = self._slo_row(prio)
+            row["completed"] += 1
+            row["met" if met else "missed"] += 1
+            if met:
+                row["goodput_tokens"] += len(s.generated)
             self.stats["generated_tokens"] += len(s.generated)
             self._t_first = min(self._t_first, s.t_submit)
             self._t_last_done = max(self._t_last_done, now)
@@ -1297,6 +1426,7 @@ class ServingEngine:
                     "t_done": time.perf_counter(),
                 }
                 self._inject.pop(rid, None)
+                self._ttft_pred.pop(rid, None)
                 self._ev.emit("request_cancelled", rid=rid, where="queued",
                               emitted_tokens=0, blocks_freed=0)
                 return True
@@ -1415,15 +1545,33 @@ class ServingEngine:
     def step(self) -> Dict[str, int]:
         """One engine tick: chaos hook -> invariant audit (heal) -> expiry
         -> admit (with preemption) -> one prefill slice -> one decode
-        step.  Returns what happened (all zeros = idle)."""
+        step.  Returns what happened (all zeros = idle).
+
+        Every tick is decomposed host-side into the :data:`TICK_PHASES`
+        accounting — audit / sched / prefill / draft / decode / fetch /
+        host — recorded on ``tick_records``, emitted as an
+        ``engine_tick`` timeline event (with per-rid attribution, the
+        raw material of the request-lifecycle trace —
+        serving/tracing.py), and exported live through ``metrics_sink``
+        under the ``serving_metrics`` schema.  All of it is wall-clock
+        bookkeeping around the SAME two compiled calls: zero extra
+        device dispatches, ``decode_signatures`` stays 1."""
         t0 = time.perf_counter()
         self._tick += 1
+        self._phase = collections.defaultdict(float)
+        self._tick_prefill_rids = []
+        self._tick_decode_rids = []
+        self._tick_emitted = 0
         if self.chaos is not None:
             self.chaos.before_engine_tick(self._tick, self)
         self.stats["audits"] += 1
+        t = time.perf_counter()
         self.audit(heal=True)
+        self._phase["audit"] += time.perf_counter() - t
+        t = time.perf_counter()
         expired = self._expire_queue(time.perf_counter())
         admitted = self._admit()
+        self._phase["sched"] += time.perf_counter() - t
         prefilled = self._prefill_tick()
         decoded = self._decode_tick()
         busy = self.n_busy
@@ -1437,13 +1585,68 @@ class ServingEngine:
                 queued=len(self.queue), pool_utilization=round(util, 4))
         if self.watchdog is not None:
             self.watchdog.beat(self._tick)
+        t_end = time.perf_counter()
         if decoded:
-            dt = time.perf_counter() - t0
+            dt = t_end - t0
             self._tick_ewma = (
                 dt if self._tick_ewma is None
                 else 0.8 * self._tick_ewma + 0.2 * dt)
+        self._record_tick(t0, t_end, admitted=admitted, expired=expired,
+                          prefilled=prefilled, decoded=decoded, busy=busy,
+                          util=util)
         return {"admitted": admitted, "prefill_slots": prefilled,
                 "decode_slots": decoded, "busy": busy, "expired": expired}
+
+    def _record_tick(self, t_start: float, t_end: float, *, admitted: int,
+                     expired: int, prefilled: int, decoded: int, busy: int,
+                     util: float) -> None:
+        """The tick-level accounting record: phase decomposition (the
+        residual ``host`` phase is everything the named phases did not
+        cover — queue sorts, table rewrites, retirement walks) plus the
+        per-tick gauges.  Appended to ``tick_records`` (bounded), emitted
+        as an ``engine_tick`` event WHEN THE TICK DID WORK (idle polls
+        stay off the timeline), and written to ``metrics_sink`` every
+        ``metrics_every`` ticks under :data:`SERVING_METRICS_SCHEMA`."""
+        st = self.stats
+        named = sum(self._phase.get(k, 0.0)
+                    for k in TICK_PHASES if k != "host")
+        phases = {k: round(self._phase.get(k, 0.0), 9)
+                  for k in TICK_PHASES if k != "host"}
+        phases["host"] = round(max(0.0, (t_end - t_start) - named), 9)
+        rec = {
+            "tick": self._tick,
+            "t_start": t_start,
+            "t_end": t_end,
+            "tick_s": round(t_end - t_start, 9),
+            "phases": phases,
+            "queue_depth": len(self.queue),
+            "busy": busy,
+            "admitted": admitted,
+            "expired": expired,
+            "prefill_slots": prefilled,
+            "decode_slots": decoded,
+            "batch_util": round(decoded / self.num_slots, 4),
+            "pool_util": round(util, 4),
+            "emitted_tokens": self._tick_emitted,
+            "prefix_hit_rate": round(
+                st["prefix_cached_tokens"] / st["prefix_prompt_tokens"], 4)
+            if st["prefix_prompt_tokens"] else 0.0,
+            "spec_accept_rate": round(
+                st["spec_accepted"] / st["spec_drafted"], 4)
+            if st["spec_drafted"] else 0.0,
+        }
+        self.tick_records.append(rec)
+        if admitted or expired or prefilled or decoded or busy or self.queue:
+            self._ev.emit(
+                "engine_tick", spec=bool(self.spec_k),
+                prefill_rids=list(self._tick_prefill_rids),
+                decode_rids=list(self._tick_decode_rids), **rec)
+        if (self.metrics_sink is not None
+                and self._tick % self.metrics_every == 0):
+            try:
+                self.metrics_sink.write(serving_metrics_record(rec))
+            except OSError:
+                pass  # full disk / read-only path: engine work matters more
 
     def run_until_idle(
         self,
@@ -1530,10 +1733,12 @@ class ServingEngine:
             self._release_blocks(alloc, s.blocks)
             self._clear_slot_rows(i)
             self._inject.pop(s.rid, None)
+            self._ttft_pred.pop(s.rid, None)
             s.reset()
         n_queued = len(self.queue)
         for req, _t in self.queue:
             inj = self._inject.pop(req.rid, None)
+            self._ttft_pred.pop(req.rid, None)
             descs.append(self._descriptor(
                 req, emitted=[],
                 key=(np.asarray(inj["key"], np.uint32)
@@ -1604,6 +1809,13 @@ class ServingEngine:
                 deadline_s=d.get("deadline_s"),
             )
             rid = self.submit(req)
+            # the flow link the request trace renders across an engine
+            # restart: the new instance names the one it continues
+            self._ev.emit(
+                "request_resumed", rid=rid,
+                orig_rid=int(d.get("orig_rid", -1)),
+                emitted_tokens=len(emitted),
+                shed=rid in self.rejected)
             if rid in self.rejected:
                 rids.append(rid)
                 continue
@@ -1662,6 +1874,14 @@ class ServingEngine:
         self._tpots: List[float] = []
         self._ttfts_by_prio: Dict[int, List[float]] = {}
         self._tpots_by_prio: Dict[int, List[float]] = {}
+        #: bounded per-tick accounting records (serving/tracing.py)
+        self.tick_records: collections.deque = collections.deque(
+            maxlen=self.tick_history)
+        #: unresolved admission-time TTFT predictions, rid -> {est, raw}
+        self._ttft_pred: Dict[int, Dict[str, float]] = {}
+        self._calib_by_prio: Dict[int, List[float]] = {}
+        self._calib_n = 0
+        self._slo_by_prio: Dict[int, Dict[str, int]] = {}
         self._tick = 0
         self._occ_sum = self._util_sum = 0.0
         self._occ_ticks = 0
@@ -1690,12 +1910,23 @@ class ServingEngine:
         peak_util = max(a.peak_in_use for a in self._allocs) / (
             self._allocs[0].n_usable)
         st = self.stats
+        # the verdict cites its evidence: which metric tripped it, with
+        # the counts (validate_runreport cross-checks the consistency)
         if st["shed"] + st["expired"] > 0:
             verdict = "overloaded"
+            basis = (f"demand refused: shed={st['shed']}, "
+                     f"expired={st['expired']}")
+            evidence = {"shed": st["shed"], "expired": st["expired"]}
         elif st["preempted"] + st["faults_detected"] > 0:
             verdict = "degraded"
+            basis = (f"served by degrading: preempted={st['preempted']}, "
+                     f"faults_detected={st['faults_detected']}")
+            evidence = {"preempted": st["preempted"],
+                        "faults_detected": st["faults_detected"]}
         else:
             verdict = "healthy"
+            basis = "no shed/expired demand, no preemptions, no faults"
+            evidence = {}
         priorities = {
             str(p): {
                 "completed": len(self._ttfts_by_prio.get(p, [])),
@@ -1704,6 +1935,63 @@ class ServingEngine:
             }
             for p in sorted(
                 set(self._ttfts_by_prio) | set(self._tpots_by_prio))
+        }
+        # --- SLO: per-priority deadline attainment + goodput.  Demand =
+        # completed + shed + expired (a refused request's deadline was
+        # not met, however principled the refusal); goodput counts only
+        # tokens of deadline-meeting requests.
+        slo_prios: Dict[str, Any] = {}
+        met_total = demand_total = goodput_tokens = 0
+        for p in sorted(self._slo_by_prio):
+            row = dict(self._slo_by_prio[p])
+            demand = row["completed"] + row["shed"] + row["expired"]
+            row["attainment"] = (
+                round(row["met"] / demand, 4) if demand else None)
+            slo_prios[str(p)] = row
+            met_total += row["met"]
+            demand_total += demand
+            goodput_tokens += row["goodput_tokens"]
+        calib_prios = {
+            str(p): {
+                "n": len(errs),
+                **{f"rel_err_{k}": round(v, 4)
+                   for k, v in percentiles(errs, ps=(50, 95)).items()},
+            }
+            for p, errs in sorted(self._calib_by_prio.items())
+        }
+        slo = {
+            "goodput_tokens": goodput_tokens,
+            "goodput_tok_s": (
+                goodput_tokens / span if span > 0 and completed else 0.0),
+            "attainment": (
+                round(met_total / demand_total, 4) if demand_total else None),
+            "priorities": slo_prios,
+            # predicted-vs-actual TTFT calibration: per-priority relative
+            # error of the estimate admission used, plus the EWMA bias
+            # factor estimate_ttft feeds back into itself — the
+            # per-replica feedback signal a router consumes
+            "calibration": {
+                "n": self._calib_n,
+                "bias": (round(self._ttft_bias, 6)
+                         if self._ttft_bias is not None else None),
+                "pending": len(self._ttft_pred),
+                "priorities": calib_prios,
+            },
+        }
+        # --- tick-level accounting roll-up (full per-tick records live
+        # on tick_records / the engine_tick timeline)
+        ticks = list(self.tick_records)
+        phases_mean = {}
+        if ticks:
+            for name in TICK_PHASES:
+                phases_mean[name] = float(
+                    np.mean([t["phases"].get(name, 0.0) for t in ticks]))
+        tick_accounting = {
+            "ticks": len(ticks),
+            "mean_tick_s": (float(np.mean([t["tick_s"] for t in ticks]))
+                            if ticks else 0.0),
+            "phases_mean_s": {k: round(v, 9)
+                              for k, v in phases_mean.items()},
         }
         return {
             "requests": {"completed": completed, "queued": len(self.queue),
@@ -1720,6 +2008,10 @@ class ServingEngine:
             "tpot_s": percentiles(self._tpots),
             "priorities": priorities,
             "verdict": verdict,
+            "verdict_basis": basis,
+            "verdict_evidence": evidence,
+            "slo": slo,
+            "tick_accounting": tick_accounting,
             "faults": {"detected": st["faults_detected"],
                        "healed": st["faults_healed"],
                        "audits": st["audits"]},

@@ -1,0 +1,497 @@
+"""Serving observability: request-lifecycle tracing + tick-level accounting.
+
+The engine's event timeline (obs/events.py) records every lifecycle
+TRANSITION — admitted, preempted, shed, retired — but a transition log is
+not a *trace*: "where did request 17's four seconds go?" needs spans, and
+"what did tick 230 spend its time on?" needs per-tick attribution.  This
+module closes both gaps, entirely HOST-side (it processes plain event
+dicts; no device call, no new compiled program — the engine's
+``decode_signatures == 1`` contract is untouched):
+
+- **Request-lifecycle assembly** (:func:`assemble_request_timelines`).
+  Replays the timeline into one record per request *instance*: phase
+  spans (``queued`` → ``prefill`` → ``decode``, re-entering ``queued``
+  on preemption / fault requeue), per-tick child spans (``prefill_chunk``
+  / ``decode_tick`` / ``verify_tick`` from the ``engine_tick`` rid
+  attribution), instant marks (``admitted``, ``preempted``,
+  ``fault_requeued``, ``drained``), a terminal state, and drain→resume
+  links (``request_resumed`` carries ``orig_rid``, so a restarted
+  engine's request chains back to the instance it continues).  The
+  ``sequence`` field is the ordered phase walk — what the acceptance
+  tests assert lifecycle reconstruction against.
+- **Perfetto rendering** (:func:`request_trace_events`,
+  :func:`tick_trace_events`, :func:`serving_trace_events`).  Each
+  request instance becomes one async track (Chrome ``b``/``e`` events
+  keyed by ``cat="request", id=uid``) with nested phase and tick spans
+  plus ``n`` instants; preempt→re-admit and drain→resume are flow
+  arrows (``s``/``f``), so one request's journey across ticks,
+  preemptions, and an engine restart renders CONNECTED in
+  https://ui.perfetto.dev.  ``engine_tick`` events additionally become
+  per-phase lanes (audit / sched / prefill / draft / decode / fetch /
+  host, laid back-to-back from the tick start — the same reconstruction
+  idiom as obs/trace.py's step spans) and counter tracks (queue depth,
+  slot occupancy, batch utilization, pool utilization, live hit/accept
+  rates).  ``obs.trace.chrome_trace_events`` appends all of it
+  automatically when serving events are present, so
+  ``decode_bench --serve --trace out.json`` (and ``TDP_TRACE``) just
+  work.
+- **Live export** (:func:`serving_metrics_record`).  Flattens a tick
+  record into the documented ``serving_metrics`` schema
+  (:data:`SERVING_METRICS_SCHEMA`; docs/serving.md "Serving
+  observability") — the record shape the engine's ``metrics_sink=``
+  writes through the existing :mod:`~..obs.exporters` sinks
+  (Prometheus-textfile gauges / JSONL lines an external scraper can
+  watch while the engine runs).
+- **Operator table** (:func:`phase_table`) — the per-tick phase
+  breakdown as text, printed by ``decode_bench --serve --trace`` next
+  to the latency tables.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+#: Schema tag on every ``metrics_sink`` record (docs/serving.md
+#: "Serving observability" documents the fields).
+SERVING_METRICS_SCHEMA = "tdp-serving-metrics/v1"
+
+#: Per-tick phases, in execution order (the order the lanes are laid
+#: back-to-back from the tick start): invariant ``audit``, host
+#: ``sched``-uling (expiry + admission + the COW flush), the ``prefill``
+#: chunk dispatch, the host ``draft``-er (speculative only), the
+#: ``decode``/verify dispatch, output ``fetch`` (device→host transfer,
+#: including the telemetry sync), and the residual ``host`` walk.
+TICK_PHASES = ("audit", "sched", "prefill", "draft", "decode", "fetch",
+               "host")
+
+#: Request phase-span vocabulary (re-entered on preemption/requeue).
+REQUEST_PHASES = ("queued", "prefill", "decode")
+
+#: Terminal states a request instance can reach.
+REQUEST_TERMINALS = ("retired", "cancelled", "shed", "expired", "drained")
+
+#: Chrome tids for the tick phase lanes (obs/trace.py owns 0-4 for the
+#: step spans; serving lanes start at 10).
+TICK_TIDS = {name: 10 + i for i, name in enumerate(TICK_PHASES)}
+
+
+def serving_metrics_record(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten one engine tick record into the ``serving_metrics`` sink
+    schema: scalar gauges only (PrometheusTextfileSink turns every
+    numeric field into a gauge; JsonlSink keeps the record whole)."""
+    out: Dict[str, Any] = {
+        "type": "serving_metrics",
+        "schema": SERVING_METRICS_SCHEMA,
+        "tick": rec["tick"],
+        "tick_s": rec.get("tick_s", 0.0),
+        "queue_depth": rec.get("queue_depth", 0),
+        "busy_slots": rec.get("busy", 0),
+        "prefill_slots": rec.get("prefill_slots", 0),
+        "decode_slots": rec.get("decode_slots", 0),
+        "batch_util": rec.get("batch_util", 0.0),
+        "pool_util": rec.get("pool_util", 0.0),
+        "admitted": rec.get("admitted", 0),
+        "expired": rec.get("expired", 0),
+        "emitted_tokens": rec.get("emitted_tokens", 0),
+        "prefix_hit_rate": rec.get("prefix_hit_rate", 0.0),
+        "spec_accept_rate": rec.get("spec_accept_rate", 0.0),
+    }
+    phases = rec.get("phases") or {}
+    for name in TICK_PHASES:
+        out[f"phase_{name}_s"] = float(phases.get(name, 0.0))
+    return out
+
+
+# ------------------------------------------------------ lifecycle assembly
+
+
+def _new_record(rid: int, instance: int) -> Dict[str, Any]:
+    return {
+        "rid": int(rid),
+        "uid": f"{int(rid)}.{instance}",
+        "spans": [],        # [{"name", "t0", "t1"}] phase-level
+        "ticks": [],        # [{"name", "tick", "t0", "t1"}] per-tick children
+        "marks": [],        # [{"name", "t"}] instants
+        "sequence": [],     # ordered phase/mark walk (the lifecycle)
+        "terminal": None,
+        "resumed_from": None,
+        "resumed_to": None,
+        "preemptions": 0,
+        "args": {},
+        "_phase": None,
+        "_t_phase": None,
+    }
+
+
+def _open_phase(rec: Dict[str, Any], name: str, t: float) -> None:
+    rec["_phase"], rec["_t_phase"] = name, t
+    rec["sequence"].append(name)
+
+
+def _close_phase(rec: Dict[str, Any], t: float) -> None:
+    if rec["_phase"] is None:
+        return
+    t0 = rec["_t_phase"]
+    rec["spans"].append(
+        {"name": rec["_phase"], "t0": t0, "t1": max(t, t0)})
+    rec["_phase"] = rec["_t_phase"] = None
+
+
+def _mark(rec: Dict[str, Any], name: str, t: float) -> None:
+    rec["marks"].append({"name": name, "t": t})
+    rec["sequence"].append(name)
+
+
+def assemble_request_timelines(
+    events: Iterable[Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """Replay an event timeline into per-request-instance lifecycle
+    records (submission order).  Tolerant of a log attached mid-run: an
+    event for a request whose submission was never seen opens a fresh
+    record at that event.  Request ids restart at 0 per engine, so
+    instances are keyed ``uid = "<rid>.<n>"`` — a reused rid (several
+    engines sharing one timeline, or drain→resume) gets a NEW instance,
+    and ``request_resumed`` links the new instance to the one it
+    continues (``resumed_from`` / ``resumed_to``)."""
+    records: List[Dict[str, Any]] = []
+    open_by_rid: Dict[int, Dict[str, Any]] = {}
+    all_by_rid: Dict[int, List[Dict[str, Any]]] = {}
+
+    def start(rid: int, t: float) -> Dict[str, Any]:
+        rec = _new_record(rid, len(all_by_rid.get(rid, [])))
+        records.append(rec)
+        open_by_rid[rid] = rec
+        all_by_rid.setdefault(rid, []).append(rec)
+        _open_phase(rec, "queued", t)
+        return rec
+
+    def ensure(rid: int, t: float) -> Dict[str, Any]:
+        rec = open_by_rid.get(rid)
+        return rec if rec is not None else start(rid, t)
+
+    def finish(rid: int, t: float, terminal: str) -> None:
+        rec = ensure(rid, t)
+        _close_phase(rec, t)
+        rec["terminal"] = terminal
+        rec["sequence"].append(terminal)
+        open_by_rid.pop(rid, None)
+
+    def requeue(rid: int, t: float, mark: str) -> None:
+        rec = open_by_rid.get(rid)
+        if rec is None:
+            return
+        _close_phase(rec, t)
+        _mark(rec, mark, t)
+        rec["preemptions"] += 1
+        _open_phase(rec, "queued", t)
+
+    for e in events:
+        kind = e.get("kind")
+        t = e.get("t_mono")
+        if kind is None or t is None:
+            continue
+        rid = e.get("rid")
+        if kind == "request_submitted":
+            if rid in open_by_rid:  # rid reused without a terminal: rotate
+                _close_phase(open_by_rid[rid], t)
+                open_by_rid.pop(rid)
+            rec = start(rid, t)
+            rec["args"] = {
+                k: e[k] for k in ("prompt_len", "max_new_tokens",
+                                  "priority", "deadline_s")
+                if e.get(k) is not None}
+        elif kind == "request_resumed":
+            rec = ensure(rid, t)
+            parents = [r for r in all_by_rid.get(e.get("orig_rid"), [])
+                       if r is not rec]
+            if parents:
+                rec["resumed_from"] = parents[-1]["uid"]
+                parents[-1]["resumed_to"] = rec["uid"]
+        elif kind == "request_admitted":
+            rec = ensure(rid, t)
+            _close_phase(rec, t)
+            _mark(rec, "admitted", t)
+            _open_phase(rec, "prefill", t)
+        elif kind == "engine_tick":
+            t0 = e.get("t_start", t)
+            spec = bool(e.get("spec"))
+            for r in e.get("prefill_rids") or []:
+                rec = open_by_rid.get(r)
+                if rec is not None:
+                    rec["ticks"].append({"name": "prefill_chunk",
+                                         "tick": e.get("tick"),
+                                         "t0": t0, "t1": t})
+            for r in e.get("decode_rids") or []:
+                rec = open_by_rid.get(r)
+                if rec is None:
+                    continue
+                if rec["_phase"] == "prefill":
+                    # the final prefill chunk and the first decode run in
+                    # ONE tick, and admission may also have happened mid-
+                    # tick — clamp the switch so phases never overlap
+                    t_sw = max(t0, rec["_t_phase"] if rec["_t_phase"]
+                               is not None else t0)
+                    _close_phase(rec, t_sw)
+                    _open_phase(rec, "decode", t_sw)
+                rec["ticks"].append(
+                    {"name": "verify_tick" if spec else "decode_tick",
+                     "tick": e.get("tick"), "t0": t0, "t1": t})
+        elif kind == "request_preempted":
+            requeue(rid, t, "preempted")
+        elif kind == "engine_recovered":
+            rids = e.get("requeued_rids")
+            if rids is None:
+                rids = [rid] if (rid is not None
+                                 and e.get("action") == "requeued") else []
+            for r in rids:
+                requeue(r, t, "fault_requeued")
+        elif kind == "request_retired":
+            finish(rid, t, "retired")
+        elif kind == "request_cancelled":
+            finish(rid, t, "cancelled")
+        elif kind == "request_shed":
+            finish(rid, t, "shed")
+        elif kind == "request_expired":
+            finish(rid, t, "expired")
+        elif kind == "engine_drained":
+            for r in list(open_by_rid):
+                rec = open_by_rid[r]
+                _close_phase(rec, t)
+                _mark(rec, "drained", t)
+                rec["terminal"] = "drained"
+                open_by_rid.pop(r)
+    return records
+
+
+def lifecycle_phases(record: Dict[str, Any]) -> List[str]:
+    """The ordered phase/mark walk of one request instance — e.g.
+    ``['queued', 'admitted', 'prefill', 'decode', 'preempted', 'queued',
+    'drained']`` — what "the lifecycle reconstructs from the trace"
+    means, concretely."""
+    return list(record["sequence"])
+
+
+def validate_request_record(record: Dict[str, Any]) -> List[str]:
+    """Structural checks on one assembled record: known vocabulary,
+    spans time-ordered and non-negative, tick children inside the
+    record's overall window.  Returns problem strings (empty = good)."""
+    errs: List[str] = []
+    uid = record.get("uid", "?")
+    last_t = None
+    for s in record["spans"]:
+        if s["name"] not in REQUEST_PHASES:
+            errs.append(f"{uid}: unknown phase {s['name']!r}")
+        if s["t1"] < s["t0"]:
+            errs.append(f"{uid}: span {s['name']} ends before it starts")
+        if last_t is not None and s["t0"] < last_t - 1e-9:
+            errs.append(f"{uid}: span {s['name']} overlaps its predecessor")
+        last_t = s["t1"]
+    term = record.get("terminal")
+    if term is not None and term not in REQUEST_TERMINALS:
+        errs.append(f"{uid}: unknown terminal {term!r}")
+    if record["spans"]:
+        lo = record["spans"][0]["t0"] - 1e-9
+        hi = record["spans"][-1]["t1"] + 1e-9
+        for c in record["ticks"]:
+            if c["t0"] < lo or c["t1"] > hi:
+                errs.append(f"{uid}: tick child {c['name']} outside spans")
+                break
+    return errs
+
+
+# ------------------------------------------------------- Perfetto rendering
+
+
+def _serving_t0(events: Sequence[Dict[str, Any]]) -> Optional[float]:
+    ts = [e.get("t_start", e["t_mono"]) for e in events if "t_mono" in e]
+    return min(ts) if ts else None
+
+
+def request_trace_events(
+    events: Sequence[Dict[str, Any]],
+    process: int = 0,
+    t0: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """Chrome trace events for the per-request tracks: one async track
+    per request instance (``cat="request"``, ``id=uid``) holding the
+    outer request span, nested phase spans, per-tick children, and
+    instant marks; flow arrows (``s``/``f``) connect a preemption to its
+    re-admission and a drained instance to the instance that resumes
+    it."""
+    records = assemble_request_timelines(events)
+    if t0 is None:
+        t0 = _serving_t0(events)
+    if t0 is None:
+        return []
+
+    def us(t: float) -> float:
+        return round(max(t - t0, 0.0) * 1e6, 3)
+
+    out: List[Dict[str, Any]] = []
+    by_uid = {r["uid"]: r for r in records}
+
+    def window(rec):
+        ts = ([s["t0"] for s in rec["spans"]]
+              + [s["t1"] for s in rec["spans"]]
+              + [m["t"] for m in rec["marks"]])
+        return (min(ts), max(ts)) if ts else None
+
+    for rec in records:
+        win = window(rec)
+        if win is None:
+            continue
+        base = {"cat": "request", "id": rec["uid"], "pid": process, "tid": 0}
+        args = dict(rec["args"])
+        if rec["terminal"]:
+            args["terminal"] = rec["terminal"]
+        if rec["resumed_from"]:
+            args["resumed_from"] = rec["resumed_from"]
+        out.append({"ph": "b", "name": f"req{rec['rid']}",
+                    "ts": us(win[0]), "args": args, **base})
+        for s in rec["spans"]:
+            out.append({"ph": "b", "name": s["name"], "ts": us(s["t0"]),
+                        **base})
+            out.append({"ph": "e", "name": s["name"], "ts": us(s["t1"]),
+                        **base})
+        for c in rec["ticks"]:
+            out.append({"ph": "b", "name": c["name"], "ts": us(c["t0"]),
+                        "args": {"tick": c.get("tick")}, **base})
+            out.append({"ph": "e", "name": c["name"], "ts": us(c["t1"]),
+                        **base})
+        for m in rec["marks"]:
+            out.append({"ph": "n", "name": m["name"], "ts": us(m["t"]),
+                        **base})
+        out.append({"ph": "e", "name": f"req{rec['rid']}",
+                    "ts": us(win[1]), **base})
+        # preempt/fault requeue -> next admission, as flow arrows
+        readmits = [m["t"] for m in rec["marks"] if m["name"] == "admitted"]
+        for i, m in enumerate(m for m in rec["marks"]
+                              if m["name"] in ("preempted",
+                                               "fault_requeued")):
+            nxt = [t for t in readmits if t >= m["t"]]
+            if not nxt:
+                continue
+            fid = f"requeue-{rec['uid']}-{i}"
+            flow = {"cat": "flow", "name": "requeue", "id": fid,
+                    "pid": process, "tid": 0}
+            out.append({"ph": "s", "ts": us(m["t"]), **flow})
+            out.append({"ph": "f", "bp": "e", "ts": us(nxt[0]), **flow})
+        # drain -> resume, across engine instances
+        if rec["resumed_from"] and rec["resumed_from"] in by_uid:
+            parent = by_uid[rec["resumed_from"]]
+            pwin = window(parent)
+            if pwin is not None:
+                fid = f"resume-{rec['uid']}"
+                flow = {"cat": "flow", "name": "resume", "id": fid,
+                        "pid": process, "tid": 0}
+                out.append({"ph": "s", "ts": us(pwin[1]), **flow})
+                out.append({"ph": "f", "bp": "e", "ts": us(win[0]), **flow})
+    return out
+
+
+def tick_trace_events(
+    events: Sequence[Dict[str, Any]],
+    process: int = 0,
+    t0: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """Chrome trace events for the tick accounting: per-phase lanes
+    (``X`` spans laid back-to-back from each tick's start, the same
+    reconstruction as obs/trace.py's step spans) plus counter tracks —
+    queue depth, busy/prefill/decode slots, batch + pool utilization,
+    and the live prefix-hit / spec-accept rates."""
+    ticks = [e for e in events if e.get("kind") == "engine_tick"
+             and "t_mono" in e]
+    if not ticks:
+        return []
+    if t0 is None:
+        t0 = _serving_t0(ticks)
+
+    def us(t: float) -> float:
+        return round(max(t - t0, 0.0) * 1e6, 3)
+
+    out: List[Dict[str, Any]] = []
+    for name, tid in TICK_TIDS.items():
+        out.append({"ph": "M", "name": "thread_name", "pid": process,
+                    "tid": tid, "args": {"name": f"tick/{name}"}})
+        out.append({"ph": "M", "name": "thread_sort_index", "pid": process,
+                    "tid": tid, "args": {"sort_index": tid}})
+    for e in ticks:
+        start = e.get("t_start", e["t_mono"])
+        phases = e.get("phases") or {}
+        cursor = start
+        for name in TICK_PHASES:
+            dur = float(phases.get(name, 0.0) or 0.0)
+            if dur > 0:
+                out.append({
+                    "ph": "X", "name": name, "cat": "tick",
+                    "pid": process, "tid": TICK_TIDS[name],
+                    "ts": us(cursor), "dur": round(dur * 1e6, 3),
+                    "args": {"tick": e.get("tick")},
+                })
+            cursor += dur
+        ts = us(start)
+        out.append({"ph": "C", "name": "serving_queue_depth",
+                    "pid": process, "tid": 0, "ts": ts,
+                    "args": {"queued": e.get("queue_depth", 0)}})
+        out.append({"ph": "C", "name": "serving_slots", "pid": process,
+                    "tid": 0, "ts": ts,
+                    "args": {"busy": e.get("busy", 0),
+                             "prefill": e.get("prefill_slots", 0),
+                             "decode": e.get("decode_slots", 0)}})
+        out.append({"ph": "C", "name": "serving_utilization",
+                    "pid": process, "tid": 0, "ts": ts,
+                    "args": {"batch": e.get("batch_util", 0.0),
+                             "pool": e.get("pool_util", 0.0)}})
+        out.append({"ph": "C", "name": "serving_rates", "pid": process,
+                    "tid": 0, "ts": ts,
+                    "args": {"prefix_hit": e.get("prefix_hit_rate", 0.0),
+                             "spec_accept": e.get("spec_accept_rate",
+                                                  0.0)}})
+    return out
+
+
+def serving_trace_events(
+    events: Sequence[Dict[str, Any]],
+    process: int = 0,
+    t0: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """Everything serving adds to a Chrome trace: request-flow tracks +
+    tick lanes + counters.  ``obs.trace.chrome_trace_events`` calls this
+    when serving events are on the timeline; pass the same ``t0`` the
+    rest of the trace uses so both land on one axis."""
+    if t0 is None:
+        t0 = _serving_t0([e for e in events if "t_mono" in e])
+    return (tick_trace_events(events, process=process, t0=t0)
+            + request_trace_events(events, process=process, t0=t0))
+
+
+# ---------------------------------------------------------- operator table
+
+
+def phase_table(events: Iterable[Dict[str, Any]]) -> str:
+    """Text table of the per-tick phase breakdown over ``engine_tick``
+    records — totals, mean ms, and share of accounted tick time per
+    phase.  ``decode_bench --serve --trace`` prints it next to the
+    latency tables."""
+    ticks = [e for e in events if e.get("kind") == "engine_tick"]
+    if not ticks:
+        return "tick phase breakdown: no engine_tick records"
+    totals = {name: 0.0 for name in TICK_PHASES}
+    counts = {name: 0 for name in TICK_PHASES}
+    for e in ticks:
+        for name in TICK_PHASES:
+            dur = float((e.get("phases") or {}).get(name, 0.0) or 0.0)
+            totals[name] += dur
+            counts[name] += 1 if dur > 0 else 0
+    accounted = sum(totals.values()) or 1.0
+    lines = [f"tick phase breakdown ({len(ticks)} ticks, "
+             f"{accounted * 1e3:.1f} ms accounted):",
+             f"  {'phase':<9} {'total_ms':>10} {'mean_ms':>9} "
+             f"{'ticks':>6} {'share':>7}"]
+    for name in TICK_PHASES:
+        n = counts[name]
+        lines.append(
+            f"  {name:<9} {totals[name] * 1e3:>10.2f} "
+            f"{(totals[name] / n * 1e3 if n else 0.0):>9.3f} "
+            f"{n:>6} {totals[name] / accounted:>6.1%}")
+    return "\n".join(lines)

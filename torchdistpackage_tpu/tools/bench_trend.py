@@ -49,9 +49,15 @@ from typing import Any, Dict, List, Tuple
 #: hold with a collapsed hit or accept rate means the win is coming from
 #: somewhere else (or the workload changed under the gate) — visible
 #: here next to the throughput it buys.
+#: ``slo_attainment`` / ``goodput_tok_s`` (PR 11) ride the
+#: ``serve-overload`` line too: the headline ``value`` is RAW tokens/s,
+#: which can hold while every deadline is missed — goodput (tokens/s of
+#: deadline-meeting requests only) and attainment are the columns that
+#: catch a scheduler trading SLOs for throughput.
 AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
             "grad_norm_final", "comm_bytes_per_dim", "shed_rate",
-            "preempt_count", "prefix_hit_rate", "spec_accept_rate")
+            "preempt_count", "prefix_hit_rate", "spec_accept_rate",
+            "slo_attainment", "goodput_tok_s")
 
 
 def _aux_str(key: str, val: Any) -> str:

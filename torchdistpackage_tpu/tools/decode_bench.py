@@ -52,7 +52,14 @@ columns).  CPU-sim rows in docs/BENCH_AB.md.
 compiled decode step (one extra AOT compile) and writes the run's
 Perfetto-loadable Chrome trace — cells appear as instant events on the
 timeline (the cell loops are not Telemetry-wrapped, so there are no
-per-step spans; the event timeline and ledger still render).
+per-step spans; the event timeline and ledger still render).  With
+``--serve``, the trace additionally carries the serving-observability
+layer (docs/serving.md "Serving observability"): one async flow track
+per request (queued → prefill → decode across preemptions and
+drain/resume), engine-tick phase lanes, and queue/occupancy/utilization
+counter tracks — every serve arm (``--overload`` / ``--shared-prefix`` /
+``--spec`` included) lands on the one timeline, and a per-tick
+phase-breakdown table is printed next to the latency tables.
 """
 
 from __future__ import annotations
@@ -219,6 +226,7 @@ def _overload_arm(jax, jnp, cfg, params, tel, eng, base_summary, *,
     def p99(prios, p):
         return ((prios.get(str(p)) or {}).get("ttft_s") or {}).get("p99")
 
+    slo = summary.get("slo") or {}
     line = {
         "metric": "serve-overload",
         # the trend gate: aggregate goodput under 2x arrivals (a scheduler
@@ -230,8 +238,14 @@ def _overload_arm(jax, jnp, cfg, params, tel, eng, base_summary, *,
         "preempt_count": reqs["preempted"],
         "expired": reqs["expired"],
         "verdict": summary["verdict"],
+        # PR-11 SLO columns (bench_trend AUX): true goodput (tokens/s of
+        # deadline-meeting requests only) and deadline attainment — a
+        # tokens/s hold bought by missing deadlines is visible here
+        "goodput_tok_s": round(slo.get("goodput_tok_s", 0.0), 1),
         "decode_signatures": summary["decode_signatures"],
     }
+    if slo.get("attainment") is not None:
+        line["slo_attainment"] = round(slo["attainment"], 4)
     ab = {"arrival_x_capacity": 2.0, "shed_rate": round(shed_rate, 4),
           "priorities": {}}
     agg_u = (base_summary.get("ttft_s") or {}).get("p99")
@@ -672,6 +686,15 @@ def main(argv=None):
                 n_requests=args.serve_requests or (12 if smoke else 24),
                 num_slots=args.slots, block_size=args.block_size,
                 chunk=args.chunk, seed=args.seed, smoke=smoke)
+        if trace_path:
+            # the tick-level accounting next to the latency tables: where
+            # each engine tick's time went, aggregated over every serve
+            # arm above (all arms share this session's event timeline —
+            # the same records the Perfetto trace renders as phase lanes)
+            from ..serving.tracing import phase_table
+
+            master_print(phase_table(tel.events.as_list()),
+                         file=sys.stderr)
     elif args.overload or args.shared_prefix or args.spec:
         master_print(
             "decode_bench: --overload/--shared-prefix/--spec need --serve",
