@@ -1,0 +1,334 @@
+"""Pallas fused paged-attention kernel (ops/paged_attention.py).
+
+The load-bearing claims, each against the gather path as parity oracle:
+
+- **Kernel parity**: the in-kernel block-table walk matches the
+  gather-then-dense oracle to float tolerance across every serving shape
+  — dense and GQA head grouping, sliding window, scalar AND [B]-vector
+  offsets, S_in=1 decode and the K+1 spec-verify shape, fetch widths 1/2/4
+  — and the fused int8 dequant path matches the gather-quant oracle.
+- **Engine token bit-parity**: an ``attn_impl='pallas'`` engine (running
+  the interpreter-mode kernel on CPU) emits tokens BIT-equal to the
+  contiguous-cache ``generate()`` golden and to the gather engine, with
+  ``decode_signatures == 1`` — speculative verify and the int8 pool
+  included.
+- **Memory evidence** (via the Telemetry AOT hook): the gather arm's
+  compiled decode program materializes the O(max_blocks*bs) gathered-view
+  buffer; the pallas arm's program never allocates that shape.
+- **Hot-loop lint**: ``gather_kv`` is never called while the pallas
+  engine traces its programs — the gather survives only as the parity
+  oracle.
+
+Budget: ONE module-scope bundle (a single GQA+sliding-window family,
+spec_k=2) holds the golden, the pallas+gather engine pair, and the int8
+engine — every test reuses the same handful of compiled programs.  The
+32k long-context serving proof is slow-tier.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchdistpackage_tpu.models import generate, init_gpt_params, llama_config
+from torchdistpackage_tpu.ops.paged_attention import (
+    modeled_attend_temp_bytes,
+    paged_decode_attention,
+    resolve_attn_impl,
+)
+from torchdistpackage_tpu.serving import Request, ServingEngine, paged_attention
+
+# One family covering GQA (kv_heads < nheads) AND sliding-window masking;
+# spec_k=2 makes the decode program the K+1 verify shape.
+CFG = llama_config(vocab_size=64, dim=32, nheads=4, nlayers=2, max_seq=32,
+                   kv_heads=2, ffn_hidden=48, dtype=jnp.float32,
+                   sliding_window=6)
+PROMPT, NEW = 5, 6
+
+
+def _run_staggered(eng, prompts):
+    """The engine's real regime: request B admitted while A decodes."""
+    r0 = eng.submit(Request(prompts[0].tolist(), NEW))
+    eng.step()
+    eng.step()
+    r1 = eng.submit(Request(prompts[1].tolist(), NEW))
+    eng.run_until_idle(max_ticks=500)
+    return [np.asarray(eng.finished[r]["tokens"]) for r in (r0, r1)]
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    """Module-scope bundle: golden, pallas+gather engine pair (with
+    Telemetry capturing the compiled decode program via the AOT hook),
+    int8 pallas engine, and the gather_kv trace-time call counts."""
+    import torchdistpackage_tpu.serving.paged_cache as pc
+    from torchdistpackage_tpu.obs import Telemetry
+
+    calls = {"n": 0}
+    real_gather_kv = pc.gather_kv
+
+    def counting_gather_kv(*a, **kw):
+        calls["n"] += 1
+        return real_gather_kv(*a, **kw)
+
+    pc.gather_kv = counting_gather_kv
+    try:
+        params = init_gpt_params(jax.random.PRNGKey(0), CFG)
+        prompts = np.stack([
+            np.asarray(jax.random.randint(
+                jax.random.PRNGKey(10 + i), (PROMPT,), 0, CFG.vocab_size))
+            for i in range(2)
+        ]).astype(np.int32)
+        want = np.asarray(jax.jit(
+            lambda p, t: generate(p, t, CFG, max_new_tokens=NEW)
+        )(params, jnp.asarray(prompts)))
+
+        out = {"cfg": CFG, "params": params, "prompts": prompts,
+               "want": want, "tel": {}, "eng": {}, "tokens": {},
+               "gather_calls": {}}
+        # narrow tables (max_ctx=16 at block_size=8 -> 3-wide) keep the
+        # interpreter's unrolled grid small: compile cost, not coverage
+        ekw = dict(num_slots=2, block_size=8, chunk=4, max_ctx=16)
+        # pallas arm runs spec_k=2 so its decode program IS the K+1
+        # verify shape; the gather oracle runs the ordinary S_in=1 decode
+        # (both gather programs' gathered view looks the same)
+        for impl, k in (("pallas", 2), ("gather", 0)):
+            calls["n"] = 0
+            tel = Telemetry(run=f"paged-{impl}", poll_memory=False)
+            eng = ServingEngine(params, CFG, spec_k=k, attn_impl=impl,
+                                telemetry=tel, **ekw)
+            out["tokens"][impl] = _run_staggered(eng, prompts)
+            out["gather_calls"][impl] = calls["n"]
+            out["tel"][impl], out["eng"][impl] = tel, eng
+        calls["n"] = 0
+        q8 = ServingEngine(params, CFG, attn_impl="pallas", kv_quant=True,
+                           **ekw)
+        rids = [q8.submit(Request(p.tolist(), NEW)) for p in prompts]
+        q8.run_until_idle(max_ticks=500)
+        out["gather_calls"]["int8_pallas"] = calls["n"]
+        out["tokens"]["int8_pallas"] = [
+            np.asarray(q8.finished[r]["tokens"]) for r in rids]
+        out["eng"]["int8_pallas"] = q8
+        yield out
+    finally:
+        pc.gather_kv = real_gather_kv
+
+
+# ------------------------------------------------------- kernel-level parity
+
+
+def _rand_pool(nb, hkv, bs, hd, seed):
+    kp = jax.random.normal(jax.random.PRNGKey(seed), (nb, hkv, bs, hd),
+                           jnp.float32)
+    vp = jax.random.normal(jax.random.PRNGKey(seed + 1), (nb, hkv, bs, hd),
+                           jnp.float32)
+    return kp, vp
+
+
+def test_kernel_matches_gather_oracle():
+    """Dense + GQA x {decode, K+1 verify} x {causal, sliding window} x
+    fetch widths 1/2/4, vector offsets — all within float tolerance of the
+    gather-then-dense oracle (eager interpreter, no compiles)."""
+    B, hkv, bs, hd, mb = 2, 2, 4, 8, 5  # mb % fw != 0: remainder covered
+    nb = 1 + B * mb
+    kp, vp = _rand_pool(nb, hkv, bs, hd, 1)
+    tables = jnp.asarray(
+        np.random.RandomState(0).permutation(np.arange(1, nb))
+        .reshape(B, mb), jnp.int32)
+    offs = jnp.asarray([9, 14], jnp.int32)
+    # masking semantics at fetch_width=1, then fetch_width=4 (mb=5: the
+    # remainder step) once on the hardest combination — each axis covered
+    # without the full cross product (eager interpreter calls are slow)
+    cases = [(g, s, w, 1) for g in (1, 2) for s in (1, 3)
+             for w in (None, 6)] + [(2, 3, 6, 4), (2, 1, None, 4)]
+    for groups, s_in, window, fw in cases:
+        H = hkv * groups
+        q = jax.random.normal(
+            jax.random.PRNGKey(groups * 10 + s_in), (B, H, s_in, hd),
+            jnp.float32)
+        want = paged_attention(q, kp, vp, offs, tables=tables,
+                               window=window)
+        got = paged_decode_attention(q, kp, vp, tables, offs,
+                                     window=window, fetch_width=fw)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-6,
+            err_msg=f"G={groups} S={s_in} w={window} fw={fw}")
+
+
+def test_kernel_scalar_offset_matches_vector():
+    """A scalar offset is the constant-vector case, bitwise."""
+    B, hkv, bs, hd, mb, nb = 2, 2, 4, 8, 4, 12
+    kp, vp = _rand_pool(nb, hkv, bs, hd, 3)
+    tables = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
+    q = jax.random.normal(jax.random.PRNGKey(5), (B, 4, 1, hd), jnp.float32)
+    a = paged_decode_attention(q, kp, vp, tables, 7)
+    b = paged_decode_attention(q, kp, vp, tables,
+                               jnp.asarray([7, 7], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and both agree with the oracle at the scalar offset
+    want = paged_attention(q, kp, vp, 7, tables=tables)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(want), atol=2e-6)
+
+
+def test_kernel_int8_fused_dequant():
+    """The fused int8 path — (q8, scale) block pairs dequantized
+    in-register — matches the gather-quant oracle (which materializes the
+    f32 gathered view) to float tolerance, for k AND v scales."""
+    B, hkv, bs, hd, mb, nb = 2, 2, 4, 8, 5, 12
+    rs = np.random.RandomState(7)
+    k8 = jnp.asarray(rs.randint(-127, 128, (nb, hkv, bs, hd)), jnp.int8)
+    v8 = jnp.asarray(rs.randint(-127, 128, (nb, hkv, bs, hd)), jnp.int8)
+    ks = jnp.asarray(rs.uniform(1e-3, 2e-2, (nb, hkv, bs)), jnp.float32)
+    vs = jnp.asarray(rs.uniform(1e-3, 2e-2, (nb, hkv, bs)), jnp.float32)
+    tables = jnp.asarray(rs.permutation(np.arange(1, nb))[:B * mb]
+                         .reshape(B, mb), jnp.int32)
+    offs = jnp.asarray([11, 6], jnp.int32)
+    for s_in in (1, 3):
+        q = jax.random.normal(jax.random.PRNGKey(s_in), (B, 4, s_in, hd),
+                              jnp.float32)
+        want = paged_attention(q, (k8, ks), (v8, vs), offs, tables=tables)
+        got = paged_decode_attention(q, (k8, ks), (v8, vs), tables, offs)
+        assert got.dtype == q.dtype
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-6)
+
+
+def test_resolve_attn_impl():
+    """'auto' resolves per backend (gather on CPU — the interpreter kernel
+    is a correctness story, not a speed story); junk is rejected."""
+    assert resolve_attn_impl("auto") == "gather"  # CPU container
+    assert resolve_attn_impl(None) == "gather"
+    assert resolve_attn_impl("pallas") == "pallas"
+    assert resolve_attn_impl("gather") == "gather"
+    with pytest.raises(ValueError, match="attn_impl"):
+        resolve_attn_impl("cuda")
+    with pytest.raises(ValueError, match="attn_impl"):
+        ServingEngine(None, CFG, attn_impl="nope")
+
+
+# ---------------------------------------------------- engine token parity
+
+
+def test_pallas_engine_token_bit_parity(bundle):
+    """The pallas engine (spec_k=2 — the decode program IS the K+1 verify
+    shape) emits tokens BIT-equal to contiguous ``generate()`` and to the
+    gather engine, at one decode signature per arm."""
+    for impl in ("pallas", "gather"):
+        for row, got in enumerate(bundle["tokens"][impl]):
+            np.testing.assert_array_equal(
+                got, bundle["want"][row],
+                err_msg=f"{impl} engine diverged from generate()")
+        s = bundle["eng"][impl].serving_summary()
+        assert s["decode_signatures"] == 1
+        assert s["prefill_signatures"] == 1
+        assert s["attn_impl"] == impl
+        assert s["requests"]["completed"] == 2
+
+
+def test_pallas_engine_int8_pool_parity(bundle):
+    """The int8 pool through the FUSED dequant path: token-identical to
+    the fp golden at these seeds (the established quantized-KV bar —
+    test_serving.py's gather-quant golden makes the same claim)."""
+    for row, got in enumerate(bundle["tokens"]["int8_pallas"]):
+        np.testing.assert_array_equal(
+            got, bundle["want"][row],
+            err_msg="int8 pallas decode diverged beyond quant tolerance")
+    s = bundle["eng"]["int8_pallas"].serving_summary()
+    assert s["decode_signatures"] == 1 and s["attn_impl"] == "pallas"
+
+
+# ----------------------------------------------------- memory-ledger evidence
+
+
+def test_compiled_decode_drops_gathered_temp(bundle):
+    """Via the Telemetry AOT hook (the compiled decode executable captured
+    at first dispatch — no second compile): the gather arm's program
+    materializes the O(max_blocks*bs) gathered-view buffer ([B, Hkv,
+    max_blocks*bs, hd] or its [B, mb, Hkv, bs, hd] precursor); the pallas
+    arm's program contains NO buffer of either shape — per-step attention
+    traffic is block-bounded, which is what opens 32k contexts."""
+    from torchdistpackage_tpu.obs.mem_ledger import static_ledger
+
+    def views(impl):
+        eng = bundle["eng"][impl]
+        B, hkv, hd = eng.num_slots, 2, 8
+        mb, bs = eng.max_blocks, eng.block_size
+        return (f"f32[{B},{hkv},{mb * bs},{hd}]",
+                f"[{B},{mb},{hkv},{bs},{hd}]")
+
+    texts = {}
+    for impl in ("pallas", "gather"):
+        comps = [e["compiled"]
+                 for e in bundle["tel"][impl]._compiled.values()
+                 if e["compiled"] is not None]
+        assert comps, f"{impl}: Telemetry captured no compiled signature"
+        # the hook's static ledger parses the same executable
+        assert static_ledger(comps[0]) is not None
+        texts[impl] = "\n".join(c.as_text() for c in comps)
+    assert any(v in texts["gather"] for v in views("gather")), (
+        "gather arm lost its gathered view? shapes under test are stale")
+    assert not any(v in texts["pallas"] for v in views("pallas")), (
+        "pallas decode program still allocates the gathered-view temp")
+
+
+# --------------------------------------------------------------- hot-loop lint
+
+
+def test_gather_kv_not_called_from_pallas_hot_loop(bundle):
+    """Repo-lint: with ``attn_impl='pallas'`` the engine's traced programs
+    never call ``gather_kv`` (counted at trace time — compiled steps make
+    no python calls); the gather arm does (it IS the gather), and the
+    engine source never references gather_kv directly (it survives only
+    in paged_cache's oracle branch and audit-free paths)."""
+    import inspect
+
+    import torchdistpackage_tpu.serving.engine as engine_mod
+
+    assert bundle["gather_calls"]["pallas"] == 0, (
+        "pallas engine still gathers in the hot loop")
+    assert bundle["gather_calls"]["int8_pallas"] == 0
+    assert bundle["gather_calls"]["gather"] > 0  # the counter works
+    assert "gather_kv" not in inspect.getsource(engine_mod)
+
+
+# ------------------------------------------------------- 32k long context
+
+
+@pytest.mark.slow
+def test_32k_long_context_serving():
+    """The bounded-VMEM payoff: a 32k-context engine on the pallas path
+    serves a long prompt through chunked prefill over paged KV and
+    decodes, at one signature per phase — while the modeled per-step
+    footprint verdict (MemoryModel-style shape math against
+    ``headroom_verdict``) says the gather path's gathered view would NOT
+    fit the same budget.  docs/long_context.md has the composition."""
+    from torchdistpackage_tpu.obs.mem_ledger import headroom_verdict
+    from torchdistpackage_tpu.serving import pool_bytes
+
+    cfg = llama_config(vocab_size=64, dim=32, nheads=4, nlayers=1,
+                       max_seq=32768, kv_heads=2, ffn_hidden=48,
+                       dtype=jnp.float32)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, num_slots=1, block_size=512,
+                        chunk=512, max_ctx=32768, attn_impl="pallas")
+    assert eng.max_blocks == 64
+    prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (2048,), 0, cfg.vocab_size), np.int32)
+    rid = eng.submit(Request(prompt.tolist(), 4))
+    eng.run_until_idle(max_ticks=100)
+    f = eng.finished[rid]
+    assert f["reason"] == "max_tokens" and f["new_tokens"] == 4
+    s = eng.serving_summary()
+    assert s["decode_signatures"] == 1 and s["prefill_signatures"] == 1
+
+    # modeled per-decode-step footprint: pool + attention working set
+    pool = pool_bytes(eng.cache)
+    hd = cfg.block.head_dim
+    common = dict(batch=1, kv_heads=2, max_blocks=eng.max_blocks,
+                  block_size=eng.block_size, head_dim=hd, itemsize=4)
+    gather_ws = modeled_attend_temp_bytes("gather", **common)
+    pallas_ws = modeled_attend_temp_bytes("pallas", groups=2, **common)
+    assert pallas_ws < gather_ws / 10  # block-bounded vs context-bounded
+    capacity = pool + gather_ws // 2
+    assert headroom_verdict(pool + gather_ws, capacity)["verdict"] == "oom_risk"
+    assert headroom_verdict(pool + pallas_ws, capacity)["verdict"] == "ok"

@@ -296,6 +296,28 @@ def test_bench_trend_slo_columns():
     assert not warnings
 
 
+def test_bench_trend_paged_kernel_column():
+    """The PR-12 paged-kernel columns: ``serve-paged-{gather,pallas}``
+    lines gate on tokens/s (``value``) as their own series, and the
+    ``serve-paged-ab`` line renders ``paged_pallas_tok_s`` in the aux
+    trail — a pallas-arm regression trips the gate on its line and stays
+    visible on the A/B roll-up."""
+    from torchdistpackage_tpu.tools.bench_trend import AUX_KEYS, trend
+
+    assert "paged_pallas_tok_s" in AUX_KEYS
+    pallas = {"metric": "serve-paged-pallas", "value": 1850.0,
+              "attn_impl": "pallas", "config": "c"}
+    ab = {"metric": "serve-paged-ab", "value": 1.4,
+          "paged_pallas_tok_s": 1850.0, "config": "c"}
+    report, warnings = trend(
+        [(1, [pallas, ab]),
+         (2, [dict(pallas, value=1200.0),
+              dict(ab, paged_pallas_tok_s=1200.0)])],
+        threshold=0.05)
+    assert any("paged_pallas_tok_s=1850.0" in ln for ln in report)
+    assert any("REGRESSION serve-paged-pallas" in w for w in warnings)
+
+
 def test_bench_trend_comm_bytes_column():
     """The PR-8 wire-bytes column: a line carrying ``comm_bytes_per_dim``
     renders its TOTAL in the aux trail, so a compressed collective

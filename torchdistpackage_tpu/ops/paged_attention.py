@@ -1,0 +1,354 @@
+"""Paged decode attention as a Pallas TPU kernel (vLLM PagedAttention
+lineage): walk the per-slot block table *inside* the kernel.
+
+The serving engine's gather path (serving/paged_cache.py ``gather_kv``)
+materializes every slot's blocks into a contiguous ``[B, Hkv,
+max_blocks*bs, hd]`` view before the dense ``_cached_attention`` — O(max
+context) HBM read AND written per decode tick, whatever the slot's actual
+length, plus an f32 upcast temp of the same size on the int8 pool.  This
+kernel removes that round trip: the grid runs ``(slot, kv_head,
+kv-block-step)`` and each program DMAs ONE pool block into VMEM through a
+scalar-prefetched block table (``PrefetchScalarGridSpec`` — the table IS
+the index map), runs online-softmax flash accumulation against it with
+per-row position masking, and stops issuing fresh fetches past the slot's
+live length (the index map clamps dead steps onto the last live block, so
+Mosaic's block-revisit elision skips the re-fetch).  Per-tick attention
+HBM traffic scales with the tokens a slot actually holds, VMEM per
+program is O(block) — which is what opens 32k+ serving contexts
+(docs/long_context.md) on the same pool.
+
+One entry point covers every serving shape:
+
+- ``S_in = 1`` ordinary decode, ``S_in = K+1`` the speculative verify
+  step, ``S_in = chunk`` chunked prefill — all the same kernel, so both
+  compiled engine programs ride it;
+- scalar or ``[B]``-vector offsets (each slot at its own depth);
+- GQA: q heads grouped per KV head OUTSIDE the kernel (a reshape, not a
+  repeat) — a KV block is fetched once per group;
+- sliding-window masking (Mistral semantics, matching
+  ``_cached_attention``);
+- int8 pools: ``(q8, scale)`` block pairs are dequantized IN-REGISTER —
+  the scale folds into the scores (k) / probabilities (v) exactly as the
+  gather path folds it, but the f32 gathered view is never materialized,
+  extending the EQuARX thesis (PAPERS.md 2506.17615 — keep quantized
+  bytes quantized until the compute that consumes them) from wire
+  collectives to the KV-cache read path.
+
+Numerics: scores and the online softmax run in f32 (matching the gather
+path's f32 softmax); the accumulation ORDER differs (blockwise online
+rescale vs one full-row softmax), so logits agree to float tolerance and
+greedy tokens bit-match the gather goldens (tests/test_paged_attention.py
+locks dense, GQA, sliding-window, vector offsets, and the K+1 verify
+shape).  The gather path stays in-tree as the parity oracle.
+
+On CPU the kernel runs in Pallas interpreter mode automatically (same
+``_interpret`` switch as ops/flash_attention.py), so every test exercises
+the identical code path the TPU compiles.
+
+Tuning: ``fetch_width`` (pool blocks streamed per grid step — each is an
+independent BlockSpec input, so Mosaic pipelines the DMAs) and
+``q_pad_to`` (pad the in-kernel q rows to a tile-friendly multiple; the
+K+1 verify shape lands at awkward row counts like G*(K+1)) come from the
+per-chip autotuned table (tools/flash_tune.py ``--paged``,
+docs/PAGED_TUNE_v5e.json), with conservative fallbacks for unmeasured
+chips and the interpreter.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _interpret, _out_struct
+
+NEG_INF = -1e30  # finite "minus infinity": avoids (-inf) - (-inf) NaNs
+
+_LANES = 128  # m/l scratch keeps a full lane dim for layout friendliness
+
+#: Per-chip tuned kernel parameters, measured by tools/flash_tune.py
+#: ``--paged`` (docs/PAGED_TUNE_v5e.json).  ``fetch_width`` = pool blocks
+#: streamed per grid step; ``q_pad_to`` = q-row padding multiple (the
+#: K+1 verify shape's G*(K+1) rows are rarely tile-aligned).
+_TUNED_PAGED = (
+    ("v5 lite", {"fetch_width": 4, "q_pad_to": 8}),
+    ("v5e", {"fetch_width": 4, "q_pad_to": 8}),
+)
+#: Conservative fallback for unmeasured chips and the CPU interpreter:
+#: one block per step, minimal f32 sublane padding.
+_FALLBACK_PAGED = {"fetch_width": 1, "q_pad_to": 8}
+
+
+@functools.lru_cache(maxsize=None)
+def _paged_params_for(device_kind: str) -> dict:
+    dk = device_kind.lower()
+    for sub, params in _TUNED_PAGED:
+        if sub in dk:
+            return dict(params)
+    if jax.default_backend() != "cpu":
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "paged_attention: no autotuned row for device_kind=%r; serving "
+            "conservative fallback %s — run tools/flash_tune.py --paged on "
+            "this chip and add a _TUNED_PAGED row", device_kind,
+            _FALLBACK_PAGED)
+    return dict(_FALLBACK_PAGED)
+
+
+def default_paged_params() -> dict:
+    """``{fetch_width, q_pad_to}`` for the attached chip — autotuned when
+    measured, :data:`_FALLBACK_PAGED` otherwise.  Device kind re-read per
+    call (only the per-kind lookup is cached), mirroring
+    ``flash_attention.default_tiles``."""
+    try:
+        dk = jax.devices()[0].device_kind
+    except Exception:
+        return dict(_FALLBACK_PAGED)
+    return _paged_params_for(dk)
+
+
+def resolve_attn_impl(impl: Optional[str]) -> str:
+    """``'auto'``/None -> ``'pallas'`` on TPU, ``'gather'`` elsewhere (the
+    interpreter-mode kernel is correct on CPU but slow — tests opt in
+    explicitly).  Explicit values pass through validated."""
+    if impl in (None, "auto"):
+        return "pallas" if jax.default_backend() == "tpu" else "gather"
+    if impl not in ("pallas", "gather"):
+        raise ValueError(
+            f"attn_impl must be 'pallas', 'gather' or 'auto', got {impl!r}")
+    return impl
+
+
+def _compiler_params():
+    if _interpret():
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
+
+
+def _kernel(
+    tab_ref, off_ref, q_ref, *refs,
+    S_in, bs, window, sm_scale, quantized, fetch_width, rows,
+):
+    """Grid ``(slot b, kv-head h, kv-step j)``; ``refs`` carries the
+    ``fetch_width`` per-step KV blocks ((k, v) dense or (k8, ks, v8, vs)
+    quantized, sub-block-major), then the output ref and the (acc, m, l)
+    online-softmax VMEM scratch carried across j steps."""
+    per = 4 if quantized else 2
+    kv_refs = refs[:fetch_width * per]
+    o_ref = refs[fetch_width * per]
+    acc_ref, m_ref, l_ref = refs[fetch_width * per + 1:]
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    off = off_ref[b]
+    hi = (off + S_in + bs - 1) // bs  # live KV blocks for this slot
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0]  # [rows, hd]
+    # row r covers query position off + (r % S_in) (group-major rows);
+    # padded rows past the real R mask everything and are sliced off
+    qpos = off + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 0) % S_in
+
+    for i in range(fetch_width):
+        blk = j * fetch_width + i  # absolute pool-block step
+
+        @pl.when(blk < hi)
+        def _compute(i=i, blk=blk):
+            if quantized:
+                k8 = kv_refs[4 * i][0, 0]
+                ks = kv_refs[4 * i + 1][0, 0]
+                v8 = kv_refs[4 * i + 2][0, 0]
+                vs = kv_refs[4 * i + 3][0, 0]
+                kblk = k8.astype(jnp.float32)
+                s = jnp.dot(q.astype(jnp.float32), kblk.T,
+                            preferred_element_type=jnp.float32)
+                s = s * ks[None, :]
+            else:
+                kblk = kv_refs[2 * i][0, 0]
+                s = jnp.dot(q, kblk.T,
+                            preferred_element_type=jnp.float32)
+            s = s * sm_scale
+            kpos = blk * bs + jax.lax.broadcasted_iota(
+                jnp.int32, (rows, bs), 1)
+            keep = kpos <= qpos
+            if window is not None:  # Mistral: key in (qpos - window, qpos]
+                keep = keep & (kpos > qpos - window)
+            s = jnp.where(keep, s, NEG_INF)
+            m = m_ref[:, :1]
+            l = l_ref[:, :1]
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            l_ref[...] = jnp.broadcast_to(
+                l * corr + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+            if quantized:
+                pv = p * vs[None, :]
+                upd = jnp.dot(pv, v8.astype(jnp.float32),
+                              preferred_element_type=jnp.float32)
+            else:
+                vblk = kv_refs[2 * i + 1][0, 0]
+                upd = jnp.dot(p.astype(vblk.dtype), vblk,
+                              preferred_element_type=jnp.float32)
+            acc_ref[...] = acc_ref[...] * corr + upd
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == (hi - 1) // fetch_width)
+    def _write():
+        # l > 0 for every real row (a query always attends its own
+        # position); padded rows divide garbage that is sliced away
+        o_ref[0, 0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pool: Any,
+    v_pool: Any,
+    tables: jnp.ndarray,
+    offsets,
+    *,
+    window: Optional[int] = None,
+    sm_scale: Optional[float] = None,
+    fetch_width: Optional[int] = None,
+    q_pad_to: Optional[int] = None,
+) -> jnp.ndarray:
+    """Attention of ``q`` [B, H, S_in, hd] against each slot's paged
+    context, walking the block table in-kernel.
+
+    ``k_pool``/``v_pool``: one layer's pool ``[num_blocks, Hkv, bs, hd]``
+    (or its int8 ``(q8 [..., hd], scale [...])`` pair).  ``tables``
+    [B, max_blocks] int32 block tables; ``offsets`` scalar or [B] — slot
+    b's rows sit at positions ``offsets[b] + arange(S_in)`` and attend
+    keys at ``kpos <= qpos`` (``window`` additionally bounds below).
+    Returns [B, H, S_in, hd] in ``q.dtype`` — drop-in for the gather
+    path's ``_cached_attention`` output (float-tolerance equal; the
+    engine goldens assert token bit parity).
+    """
+    B, H, S_in, hd = q.shape
+    quantized = isinstance(k_pool, tuple)
+    k_arr = k_pool[0] if quantized else k_pool
+    nb, Hkv, bs, _hd = k_arr.shape
+    groups, rem = divmod(H, Hkv)
+    if rem:
+        raise ValueError(
+            f"GQA needs q heads divisible by kv heads, got {H} vs {Hkv}")
+    mb = tables.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+    params = default_paged_params()
+    fw = int(fetch_width if fetch_width is not None else
+             params["fetch_width"])
+    fw = max(1, min(fw, mb))
+    pad_to = int(q_pad_to if q_pad_to is not None else params["q_pad_to"])
+
+    offs = jnp.asarray(offsets, jnp.int32)
+    if offs.ndim == 0:
+        offs = jnp.broadcast_to(offs, (B,))
+    # group-major rows: row r = g*S_in + s covers position off + s
+    R = groups * S_in
+    rows = -(-R // pad_to) * pad_to
+    qr = q.reshape(B, Hkv, R, hd)
+    if rows != R:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, rows - R), (0, 0)))
+
+    def qidx(b, h, j, tab, off):
+        return (b, h, 0, 0)
+
+    def kvidx(b, h, j, tab, off, i=0, ndim=4):
+        # clamp dead steps onto the last live block: consecutive grid
+        # steps then revisit the same index and Mosaic skips the re-fetch
+        # — attention HBM traffic scales with the slot's ACTUAL length
+        hi1 = (off[b] + S_in + bs - 1) // bs - 1
+        blk = jnp.minimum(jnp.minimum(j * fw + i, hi1), mb - 1)
+        idx = tab[b, blk]
+        return (idx, h, 0, 0) if ndim == 4 else (idx, h, 0)
+
+    in_specs = [pl.BlockSpec((1, 1, rows, hd), qidx)]
+    operands = [qr]
+    for pool in (k_pool, v_pool):
+        for i in range(fw):
+            if quantized:
+                p8, ps = pool
+                in_specs.append(pl.BlockSpec(
+                    (1, 1, bs, hd), functools.partial(kvidx, i=i)))
+                operands.append(p8)
+                in_specs.append(pl.BlockSpec(
+                    (1, 1, bs), functools.partial(kvidx, i=i, ndim=3)))
+                operands.append(ps)
+            else:
+                in_specs.append(pl.BlockSpec(
+                    (1, 1, bs, hd), functools.partial(kvidx, i=i)))
+                operands.append(pool)
+    # interleave per sub-block: kernel expects (k, v) / (k8, ks, v8, vs)
+    # pairs sub-block-major — reorder the flat k-then-v lists
+    per = 2 if quantized else 1
+    k_ops, v_ops = operands[1:1 + fw * per], operands[1 + fw * per:]
+    k_specs, v_specs = in_specs[1:1 + fw * per], in_specs[1 + fw * per:]
+    ordered_ops, ordered_specs = [operands[0]], [in_specs[0]]
+    for i in range(fw):
+        ordered_ops.extend(k_ops[per * i:per * (i + 1)])
+        ordered_ops.extend(v_ops[per * i:per * (i + 1)])
+        ordered_specs.extend(k_specs[per * i:per * (i + 1)])
+        ordered_specs.extend(v_specs[per * i:per * (i + 1)])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, -(-mb // fw)),
+        in_specs=ordered_specs,
+        out_specs=pl.BlockSpec((1, 1, rows, hd), qidx),
+        scratch_shapes=[
+            pltpu.VMEM((rows, hd), jnp.float32),     # acc
+            pltpu.VMEM((rows, _LANES), jnp.float32),  # m
+            pltpu.VMEM((rows, _LANES), jnp.float32),  # l
+        ],
+    )
+    kernel = functools.partial(
+        _kernel, S_in=S_in, bs=bs, window=window, sm_scale=float(sm_scale),
+        quantized=quantized, fetch_width=fw, rows=rows)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_out_struct((B, Hkv, rows, hd), q.dtype, q),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(tables.astype(jnp.int32), offs, *ordered_ops)
+    return out[:, :, :R].reshape(B, H, S_in, hd)
+
+
+# --------------------------------------------------- modeled HBM footprint
+
+
+def modeled_attend_temp_bytes(
+    impl: str, *, batch: int, kv_heads: int, max_blocks: int,
+    block_size: int, head_dim: int, s_in: int = 1, groups: int = 1,
+    itemsize: int = 4, fetch_width: Optional[int] = None,
+) -> int:
+    """Modeled per-layer attention working-set bytes for one decode step —
+    the MemoryModel-style no-compile estimate the 32k serving test (and a
+    capacity planner) judges against ``obs.mem_ledger.headroom_verdict``.
+
+    ``gather``: the dense per-slot view ``[B, Hkv, max_blocks*bs, hd]``
+    materialized for k AND v (the int8 pool additionally upcasts both to
+    f32 in the einsum, so ``itemsize=4`` models that case too) — O(max
+    context) whatever the slot holds.  ``pallas``: q/out rows plus
+    ``fetch_width`` double-buffered KV blocks per program — O(block),
+    independent of context."""
+    if impl == "gather":
+        return 2 * batch * kv_heads * max_blocks * block_size * head_dim * itemsize
+    if impl == "pallas":
+        fw = int(fetch_width or _FALLBACK_PAGED["fetch_width"])
+        rows = groups * s_in
+        blocks = 2 * 2 * fw * block_size * head_dim * itemsize  # k+v, 2-buf
+        return batch * kv_heads * (2 * rows * head_dim * itemsize + blocks)
+    raise ValueError(f"impl must be 'gather' or 'pallas', got {impl!r}")

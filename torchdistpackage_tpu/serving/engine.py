@@ -327,6 +327,14 @@ class ServingEngine:
         injection seam the recovery paths are proven against.
     watchdog: a :class:`~..resilience.Watchdog`; the engine beats it once
         per tick so a wedged tick escalates to ``hang_suspected``/abort.
+    attn_impl: paged attention implementation (docs/serving.md "Paged
+        attention kernel"): ``'pallas'`` walks the block table inside the
+        fused TPU kernel (per-tick attention HBM scales with live
+        context), ``'gather'`` materializes the dense per-slot view (the
+        parity oracle), ``'auto'`` (default) picks pallas on TPU and
+        gather on CPU (the interpreter-mode kernel is correct but slow —
+        tests opt in explicitly).  Recorded in
+        ``serving_summary()['attn_impl']``.
     metrics_sink: any obs exporter sink (``write(record)`` — e.g.
         :class:`~..obs.exporters.PrometheusTextfileSink` or ``JsonlSink``);
         every ``metrics_every``-th tick writes a ``serving_metrics``
@@ -360,6 +368,7 @@ class ServingEngine:
         watchdog: Optional[Any] = None,
         prefix_cache: bool = False,
         spec_k: int = 0,
+        attn_impl: str = "auto",
         metrics_sink: Optional[Any] = None,
         metrics_every: int = 1,
         tick_history: int = 4096,
@@ -394,6 +403,13 @@ class ServingEngine:
         self.watchdog = watchdog
         self.prefix_cache = bool(prefix_cache)
         self.spec_k = int(spec_k)
+        from ..ops.paged_attention import resolve_attn_impl
+
+        #: 'pallas' (in-kernel block-table walk — the TPU default) or
+        #: 'gather' (dense gathered view — the parity oracle and the CPU
+        #: default; interpreter-mode pallas on CPU is correct but slow).
+        #: docs/serving.md "Paged attention kernel".
+        self.attn_impl = resolve_attn_impl(attn_impl)
         if metrics_every < 1:
             raise ValueError(f"metrics_every must be >= 1, got {metrics_every}")
         self.metrics_sink = metrics_sink
@@ -482,11 +498,12 @@ class ServingEngine:
         return jax.tree.map(spec, cache)
 
     def _fwd(self) -> Callable:
-        if self.cfg.moe_experts:
-            import functools
+        import functools
 
-            return functools.partial(paged_forward_moe, ep_axis=self.ep_axis)
-        return paged_forward
+        if self.cfg.moe_experts:
+            return functools.partial(paged_forward_moe, ep_axis=self.ep_axis,
+                                     attn_impl=self.attn_impl)
+        return functools.partial(paged_forward, attn_impl=self.attn_impl)
 
     def _build_step(self) -> Callable:
         """ONE python step serves both phases: S_in=1 calls are the decode
@@ -2036,6 +2053,10 @@ class ServingEngine:
                     self.cfg, self.dp * self.num_blocks, self.block_size,
                     quantized=self.kv_quant),
             },
+            # which attention implementation the compiled programs traced
+            # (docs/serving.md "Paged attention kernel"): 'pallas' walks
+            # the block table in-kernel, 'gather' is the parity oracle
+            "attn_impl": self.attn_impl,
             "decode_steps": st["decode_steps"],
             "prefill_chunks": st["prefill_chunks"],
             "decode_batch_mean": (

@@ -24,14 +24,21 @@ compiles ONCE):
   Block 0 is the engine's NULL block (never allocated): inactive slots and
   out-of-range clamped writes land there and are never read.
 - **Write** is a vectorized scatter (disjoint blocks per slot — no
-  collisions among live slots); **attend** gathers a slot's blocks into a
-  dense ``[B, Hkv, max_blocks*bs, hd]`` view through the table and runs
-  the SAME ``_cached_attention`` as the contiguous path with per-slot [B]
-  offsets.  Gathered index == slot-relative position (tables list blocks
-  in order), so the causal/sliding-window mask carries over unchanged, and
-  when the gathered view matches the contiguous buffer's length the two
-  paths agree BITWISE (tests/test_serving.py locks this for dense, GQA,
-  sliding-window, and MoE families).
+  collisions among live slots); **attend** has two implementations behind
+  ``attn_impl`` (docs/serving.md "Paged attention kernel"): ``'gather'``
+  gathers a slot's blocks into a dense ``[B, Hkv, max_blocks*bs, hd]``
+  view through the table and runs the SAME ``_cached_attention`` as the
+  contiguous path with per-slot [B] offsets — gathered index ==
+  slot-relative position (tables list blocks in order), so the
+  causal/sliding-window mask carries over unchanged, and when the
+  gathered view matches the contiguous buffer's length the two paths
+  agree BITWISE (tests/test_serving.py locks this for dense, GQA,
+  sliding-window, and MoE families); ``'pallas'``
+  (ops/paged_attention.py, the TPU default) walks the table INSIDE a
+  fused kernel — same semantics, no gathered view, per-tick HBM bounded
+  by live context (tests/test_paged_attention.py locks engine-token bit
+  parity against the gather goldens).  The gather path stays as the
+  parity oracle.
 
 The allocator (:class:`BlockAllocator`) is host-side and O(blocks): the
 hot loop never reallocates device memory — host code only rewrites small
@@ -179,22 +186,34 @@ def gather_kv(c, tables: jnp.ndarray):
 
 def paged_attention(
     q: jnp.ndarray, ck, cv, offset, *, tables: jnp.ndarray, window=None,
+    impl: str = "gather",
 ) -> jnp.ndarray:
-    """Attention of q [B, H, S_in, hd] against each slot's paged context:
-    gather the slot's blocks dense, then the contiguous `_cached_attention`
-    with per-slot [B] offsets — one attention implementation, two cache
-    layouts."""
+    """Attention of q [B, H, S_in, hd] against each slot's paged context.
+
+    ``impl='gather'`` (the parity oracle and CPU fallback): gather the
+    slot's blocks into a dense ``[B, Hkv, max_blocks*bs, hd]`` view, then
+    the contiguous ``_cached_attention`` with per-slot [B] offsets — one
+    attention implementation, two cache layouts, O(max context) HBM per
+    call.  ``impl='pallas'``: the fused Pallas kernel
+    (:func:`~..ops.paged_attention.paged_decode_attention`) walks the
+    block table in-kernel — no gathered view, int8 pools dequantized
+    in-register, HBM traffic bounded by the slot's live length."""
+    if impl == "pallas":
+        from ..ops.paged_attention import paged_decode_attention
+
+        return paged_decode_attention(q, ck, cv, tables, offset,
+                                      window=window)
     return _cached_attention(
         q, gather_kv(ck, tables), gather_kv(cv, tables), offset,
         window=window)
 
 
-def _paged_cache_ops(tables: jnp.ndarray):
+def _paged_cache_ops(tables: jnp.ndarray, attn_impl: str = "gather"):
     """The ``cache_ops`` pair ``cached_block_forward`` needs to run on the
     block pool instead of the contiguous buffer."""
     def attend(q, ck, cv, offset, window=None):
         return paged_attention(q, ck, cv, offset, tables=tables,
-                               window=window)
+                               window=window, impl=attn_impl)
     return functools.partial(paged_write, tables=tables), attend
 
 
@@ -232,6 +251,7 @@ def paged_forward(
     axis: Optional[str] = None,
     last_idx=None,
     all_logits: bool = False,
+    attn_impl: str = "gather",
 ) -> Tuple[Dict[str, Any], jnp.ndarray]:
     """``forward_cached`` over the block pool: run ``tokens`` [B, S_in]
     (slot b's rows occupy global positions ``offset[b] + arange(S_in)``)
@@ -245,14 +265,18 @@ def paged_forward(
     ``all_logits=True`` returns the per-position logits [B, S_in,
     V_local] instead — the multi-position evaluation the speculative
     verify step needs (the model's distribution at EVERY drafted
-    position, one paged-attention pass)."""
+    position, one paged-attention pass).
+
+    ``attn_impl``: ``'gather'`` (table-gather then dense attention — the
+    parity oracle) or ``'pallas'`` (the fused in-kernel table walk,
+    docs/serving.md "Paged attention kernel")."""
     bcfg = cfg.block
     S_in = tokens.shape[1]
     offset = jnp.asarray(offset, jnp.int32)
     positions = offset[:, None] + jnp.arange(S_in)[None, :]
     h = _embed_at(params, tokens, positions, axis)
     rope = _batched_rope(bcfg, positions)
-    ops = _paged_cache_ops(tables)
+    ops = _paged_cache_ops(tables, attn_impl)
 
     def body(hc, xs):
         lp, ck, cv = xs
@@ -282,13 +306,16 @@ def paged_forward_moe(
     last_idx=None,
     ep_axis: Optional[str] = None,
     all_logits: bool = False,
+    attn_impl: str = "gather",
 ) -> Tuple[Dict[str, Any], jnp.ndarray]:
     """:func:`paged_forward` for the MoE family (heterogeneous block list,
     expert FFN every moe_every-th block) — the same exact no-drop serving
     dispatch as ``forward_cached_moe`` (its docstring has the semantics:
     ragged grouped GEMMs when ``ep_axis`` is None, EP-sharded exchange at
     no-drop capacity when set), attending through the block tables.
-    ``all_logits=True``: per-position logits, as in :func:`paged_forward`.
+    ``all_logits=True``: per-position logits, as in :func:`paged_forward`;
+    ``attn_impl`` as in :func:`paged_forward` (the MoE families ride the
+    same kernel — attention is family-independent).
     """
     import dataclasses as _dc
 
@@ -307,7 +334,7 @@ def paged_forward_moe(
     positions = offset[:, None] + jnp.arange(S_in)[None, :]
     h = _embed_at(params, tokens, positions, axis)
     rope = _batched_rope(bcfg, positions)
-    ops = _paged_cache_ops(tables)
+    ops = _paged_cache_ops(tables, attn_impl)
 
     if ep_axis is None:
         def moe_ffn(p, hh):

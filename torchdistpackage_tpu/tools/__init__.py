@@ -29,10 +29,11 @@ from .surgery import (
     replace_params,
 )
 from .slurm_job_monitor import determine_job_is_alive, launch_job, monitor_job
-from .flash_tune import tune_flash_blocks
+from .flash_tune import tune_flash_blocks, tune_paged_params
 
 __all__ = [
     "tune_flash_blocks",
+    "tune_paged_params",
     "BlockProfile",
     "aggregate_levels",
     "get_model_profile",

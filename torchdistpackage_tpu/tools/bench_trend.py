@@ -57,7 +57,7 @@ from typing import Any, Dict, List, Tuple
 AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
             "grad_norm_final", "comm_bytes_per_dim", "shed_rate",
             "preempt_count", "prefix_hit_rate", "spec_accept_rate",
-            "slo_attainment", "goodput_tok_s")
+            "slo_attainment", "goodput_tok_s", "paged_pallas_tok_s")
 
 
 def _aux_str(key: str, val: Any) -> str:

@@ -38,6 +38,13 @@ gating ``value`` (overloaded aggregate tokens/s) plus ``shed_rate``,
 trends all three), and the RUNREPORT ``serving`` section records the
 overload-vs-uncontended A/B (docs/serving.md "Serving under stress").
 
+``--serve --attn-impl {gather,pallas}`` adds the paged-attention-kernel
+A/B (docs/serving.md "Paged attention kernel"): the same fp requests
+through both attention implementations — paired
+``serve-paged-{gather,pallas}`` lines at equal ``config_hash``, token
+bit-parity ASSERTED between the arms, and the ``serve-paged-ab`` line
+carrying ``paged_pallas_tok_s`` (a ``bench_trend`` aux column).
+
 ``--serve --shared-prefix`` and ``--serve --spec K`` add the fast-path
 A/Bs (docs/serving.md "Prefix cache" / "Speculative decoding"): the
 prefix arm replays shared-system-prompt traffic with the prefix cache
@@ -551,6 +558,106 @@ def bench_serve_spec(jax, jnp, cfg, params, tel, *, spec_k, n_requests,
     return on_s
 
 
+def bench_serve_paged(jax, jnp, cfg, params, tel, *, attn_impl, n_requests,
+                      num_slots, block_size, chunk, seed, smoke):
+    """The paged-attention-kernel A/B (docs/serving.md "Paged attention
+    kernel"): the same fp requests through an ``attn_impl='gather'``
+    engine (table-gather then dense attention — the parity oracle) and an
+    ``attn_impl='pallas'`` engine (in-kernel block-table walk) — paired
+    ``serve-paged-{gather,pallas}`` JSON lines at equal ``config_hash``,
+    with token BIT-parity asserted between the arms.  Both arms run the
+    model in f32 (bf16 params upcast): the kernel keeps f32 scores while
+    the gather path's bf16 einsum rounds them through bf16, so at bf16 a
+    rare argmax boundary can legitimately flip — f32 is the dtype the
+    parity claim is exact at (the engine goldens in
+    tests/test_paged_attention.py assert the same), and the arms stay
+    apples-to-apples against each other.  ``attn_impl`` picks which
+    arm's ``serving_summary()`` lands in the RUNREPORT.
+
+    On the CPU sim the pallas arm runs the INTERPRETER (docs/serving.md:
+    correctness story, not a speed story) — wall-clock there only proves
+    the path runs; the kernel's win is a real-chip number."""
+    import hashlib
+
+    import numpy as np
+
+    from ..serving import Request, ServingEngine
+    from ..utils.logging import master_print
+
+    rng = np.random.RandomState(seed + 5)
+    p_lens = [4, 8] if smoke else [16, 32, 64]
+    n_lens = [6, 10] if smoke else [8, 16, 32]
+    reqs = [Request(rng.randint(0, cfg.vocab_size,
+                                size=int(rng.choice(p_lens))).tolist(),
+                    int(rng.choice(n_lens)))
+            for _ in range(n_requests)]
+    # f32 arms: the dtype the bit-parity claim is exact at (see docstring)
+    params = jax.device_put(jax.tree.map(
+        lambda x: x.astype(jnp.float32)
+        if x.dtype == jnp.bfloat16 else x, params))
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    cfg_hash = hashlib.sha1(
+        f"serve-paged|d{cfg.dim}|L{cfg.nlayers}|n{n_requests}|s{num_slots}"
+        f"|bs{block_size}|c{chunk}|seed{seed}".encode()
+    ).hexdigest()[:12]
+
+    results = {}
+    for arm in ("gather", "pallas"):
+        eng = ServingEngine(
+            params, cfg, num_slots=num_slots, block_size=block_size,
+            chunk=chunk, max_ctx=max(p_lens) + max(n_lens),
+            attn_impl=arm)
+        eng.submit(Request(reqs[0].tokens, 2))  # warm the compiled steps
+        eng.run_until_idle()
+        eng.reset_metrics()
+        wall, summary = _closed_loop(eng, [Request(r.tokens, r.max_new_tokens)
+                                           for r in reqs])
+        tok_s = summary["generated_tokens"] / wall if wall > 0 else 0.0
+        line = {
+            "metric": f"serve-paged-{arm}",
+            "value": round(tok_s, 1),
+            "attn_impl": arm,
+            "dtype": "float32",
+            "n_requests": n_requests, "num_slots": num_slots,
+            "block_size": block_size,
+            "decode_steps": summary["decode_steps"],
+            "decode_signatures": summary["decode_signatures"],
+            "prefill_signatures": summary["prefill_signatures"],
+            "config_hash": cfg_hash,
+            **_mem_cols(),
+        }
+        master_print(json.dumps(line), flush=True)
+        results[arm] = (eng, summary, tok_s)
+    # token bit-parity between the arms (fp pool): the kernels differ
+    # only in float accumulation order, and greedy argmax absorbs it
+    g_eng, p_eng = results["gather"][0], results["pallas"][0]
+    g_out = [t for _, t in sorted(
+        (f["rid"], tuple(int(x) for x in f["tokens"]))
+        for f in g_eng.finished.values())]
+    p_out = [t for _, t in sorted(
+        (f["rid"], tuple(int(x) for x in f["tokens"]))
+        for f in p_eng.finished.values())]
+    assert g_out == p_out, (
+        "pallas paged-attention arm diverged from the gather oracle")
+    master_print(json.dumps({
+        "metric": "serve-paged-ab",
+        # value = pallas/gather speedup (the trended series); the pallas
+        # arm's absolute tokens/s rides the aux trail AND its own line
+        "value": round(results["pallas"][2] / results["gather"][2], 3)
+        if results["gather"][2] > 0 else 0.0,
+        "paged_pallas_tok_s": round(results["pallas"][2], 1),
+        "paged_gather_tok_s": round(results["gather"][2], 1),
+        "bit_parity": True,
+        "interpret_mode": jax.default_backend() == "cpu",
+        "config_hash": cfg_hash,
+    }), flush=True)
+    chosen = results[attn_impl][1]
+    tel.record_serving(chosen)
+    return chosen
+
+
 def _parse_args(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m torchdistpackage_tpu.tools.decode_bench",
@@ -583,6 +690,13 @@ def _parse_args(argv=None):
                          "at static draft width K — paired "
                          "serve-spec-{off,on} lines at equal config_hash, "
                          "token bit-parity asserted between the arms")
+    ap.add_argument("--attn-impl", choices=("gather", "pallas"), default=None,
+                    help="with --serve: add the paged-attention-kernel A/B "
+                         "— BOTH arms always run paired at equal "
+                         "config_hash (serve-paged-{gather,pallas} lines, "
+                         "token bit-parity asserted on the fp path); the "
+                         "chosen value picks which arm's summary lands in "
+                         "the RUNREPORT serving section")
     ap.add_argument("--serve-requests", type=int, default=None,
                     metavar="N", help="requests in the --serve schedule "
                     "(default: 8 smoke / 24 full)")
@@ -686,6 +800,12 @@ def main(argv=None):
                 n_requests=args.serve_requests or (12 if smoke else 24),
                 num_slots=args.slots, block_size=args.block_size,
                 chunk=args.chunk, seed=args.seed, smoke=smoke)
+        if args.attn_impl:
+            bench_serve_paged(
+                jax, jnp, cfg, params, tel, attn_impl=args.attn_impl,
+                n_requests=args.serve_requests or (8 if smoke else 24),
+                num_slots=args.slots, block_size=args.block_size,
+                chunk=args.chunk, seed=args.seed, smoke=smoke)
         if trace_path:
             # the tick-level accounting next to the latency tables: where
             # each engine tick's time went, aggregated over every serve
@@ -695,9 +815,10 @@ def main(argv=None):
 
             master_print(phase_table(tel.events.as_list()),
                          file=sys.stderr)
-    elif args.overload or args.shared_prefix or args.spec:
+    elif args.overload or args.shared_prefix or args.spec or args.attn_impl:
         master_print(
-            "decode_bench: --overload/--shared-prefix/--spec need --serve",
+            "decode_bench: --overload/--shared-prefix/--spec/--attn-impl "
+            "need --serve",
             file=sys.stderr)
         return 2
     for B, ctx in cells:

@@ -1,4 +1,4 @@
-"""Flash-attention block-size autotuner.
+"""Flash-attention / paged-attention kernel autotuner.
 
 The Pallas flash kernel (ops/flash_attention.py) takes ``block_q``/``block_k``
 tile sizes whose best values depend on the chip generation (VMEM size, MXU
@@ -13,6 +13,17 @@ Usage (library)::
     best, report = tune_flash_blocks(batch=8, heads=12, seq=2048, head_dim=64)
 
 or CLI: ``python -m torchdistpackage_tpu.tools.flash_tune --seq 2048``.
+
+``--paged`` tunes the paged decode-attention kernel instead
+(ops/paged_attention.py): the candidates are ``fetch_width`` (pool blocks
+streamed per grid step — how wide the in-kernel table walk fetches
+relative to the pool ``block_size``) and ``q_pad_to`` (the q-row padding
+multiple; the speculative K+1 verify shape lands at awkward row counts),
+timed at BOTH serving shapes — ``S_in=1`` ordinary decode and ``S_in=K+1``
+spec verify — so one (fetch_width, q_pad_to) row serves both compiled
+engine programs.  Measured rows land in docs/PAGED_TUNE_v5e.json next to
+the flash table; ``_TUNED_PAGED`` in ops/paged_attention.py is the
+consumer.
 
 Timing uses the same host-transfer sync discipline as bench.py: chain the
 iterations through a data dependency and fetch a scalar at the end
@@ -125,6 +136,120 @@ def tune_flash_blocks(
     return (ok[0]["block_q"], ok[0]["block_k"]), report
 
 
+# ------------------------------------------------- paged-attention tuner
+
+#: (fetch_width, q_pad_to) candidates for the paged decode kernel;
+#: fetch_width is clamped to the table width per shape.
+PAGED_CANDIDATES: Tuple[Tuple[int, int], ...] = (
+    (1, 8),
+    (2, 8),
+    (4, 8),
+    (8, 8),
+    (1, 16),
+    (4, 16),
+)
+
+
+def _time_paged_config(
+    q_shapes, k_pool, v_pool, tables, offsets, fetch_width, q_pad_to,
+    steps: int, warmup: int, seed: int,
+) -> float:
+    """Seconds per decode step for one (fetch_width, q_pad_to), SUMMED
+    over the serving q shapes (S_in=1 decode + S_in=K+1 verify) — the
+    engine compiles both, so the winning row must serve both."""
+    from ..ops.paged_attention import paged_decode_attention
+
+    total = 0.0
+    for shape in q_shapes:
+        q = jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+        step = jax.jit(lambda qq: paged_decode_attention(
+            qq, k_pool, v_pool, tables, offsets,
+            fetch_width=fetch_width, q_pad_to=q_pad_to))
+
+        def chain(qq, n):
+            for _ in range(n):
+                out = step(qq)
+                qq = qq + 0 * out
+            return qq
+
+        q1 = chain(q, warmup)
+        float(jnp.sum(q1[0, 0, 0].astype(jnp.float32)))
+        t0 = time.perf_counter()
+        q2 = chain(q, steps)
+        float(jnp.sum(q2[0, 0, 0].astype(jnp.float32)))
+        total += (time.perf_counter() - t0) / steps
+    return total
+
+
+def tune_paged_params(
+    num_slots: int = 8,
+    kv_heads: int = 8,
+    groups: int = 2,
+    head_dim: int = 64,
+    block_size: int = 64,
+    max_blocks: int = 64,
+    spec_k: int = 2,
+    candidates: Sequence[Tuple[int, int]] = PAGED_CANDIDATES,
+    steps: int = 10,
+    warmup: int = 2,
+    seed: int = 0,
+) -> Tuple[dict, List[dict]]:
+    """Measure every (fetch_width, q_pad_to) candidate at a serving shape:
+    a ``[max_blocks*num_slots + 1, kv_heads, block_size, head_dim]`` pool
+    with per-slot tables at mixed live lengths, q at S_in=1 (decode) AND
+    S_in=spec_k+1 (the verify program).  Returns ``(best, report)`` with
+    ``report`` rows ``{"fetch_width", "q_pad_to", "ms", "rel"}`` sorted
+    fastest-first — the docs/PAGED_TUNE_v5e.json payload."""
+    import numpy as np
+
+    nb = max_blocks * num_slots + 1
+    kp = jax.random.normal(
+        jax.random.PRNGKey(seed + 1),
+        (nb, kv_heads, block_size, head_dim), jnp.float32)
+    vp = jax.random.normal(
+        jax.random.PRNGKey(seed + 2),
+        (nb, kv_heads, block_size, head_dim), jnp.float32)
+    rng = np.random.RandomState(seed)
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, nb))[:num_slots * max_blocks]
+        .reshape(num_slots, max_blocks), jnp.int32)
+    # mixed live depths: slots between 25% and 100% of max context
+    offsets = jnp.asarray(
+        rng.randint(max_blocks * block_size // 4,
+                    max_blocks * block_size - spec_k - 1,
+                    size=num_slots), jnp.int32)
+    H = kv_heads * groups
+    q_shapes = [(num_slots, H, 1, head_dim),
+                (num_slots, H, spec_k + 1, head_dim)]
+
+    rows = []
+    for fw, pad in candidates:
+        if fw > max_blocks:
+            continue
+        try:
+            dt = _time_paged_config(
+                q_shapes, kp, vp, tables, offsets, fw, pad, steps, warmup,
+                seed)
+        except Exception as e:  # one bad config must not kill the sweep
+            rows.append({"fetch_width": fw, "q_pad_to": pad,
+                         "ms": None, "error": repr(e)[:200]})
+            continue
+        rows.append({"fetch_width": fw, "q_pad_to": pad, "ms": dt * 1e3})
+    ok = [r for r in rows if r.get("ms") is not None]
+    if not ok:
+        raise RuntimeError(f"no paged config succeeded: {rows}")
+    ok.sort(key=lambda r: r["ms"])
+    best_ms = ok[0]["ms"]
+    for r in ok:
+        r["rel"] = round(r["ms"] / best_ms, 3)
+        r["ms"] = round(r["ms"], 3)
+    report = ok + [r for r in rows if r.get("ms") is None]
+    best = {"fetch_width": ok[0]["fetch_width"],
+            "q_pad_to": ok[0]["q_pad_to"]}
+    return best, report
+
+
 def main(argv: Optional[Sequence[str]] = None) -> None:
     import argparse
     import json
@@ -136,13 +261,45 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     ap.add_argument("--head-dim", type=int, default=64)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--no-causal", action="store_true")
+    ap.add_argument("--paged", action="store_true",
+                    help="tune the paged decode-attention kernel "
+                         "(fetch_width x q_pad_to at the serving shapes) "
+                         "instead of the flash training kernel")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="--paged: decode-batch width")
+    ap.add_argument("--kv-heads", type=int, default=8,
+                    help="--paged: KV heads (q heads = groups * kv_heads)")
+    ap.add_argument("--block-size", type=int, default=64,
+                    help="--paged: pool block size")
+    ap.add_argument("--max-blocks", type=int, default=64,
+                    help="--paged: table width (max_ctx / block_size)")
+    ap.add_argument("--spec-k", type=int, default=2,
+                    help="--paged: verify draft width (S_in = K+1 shape)")
     args = ap.parse_args(argv)
+    from ..utils.logging import master_print
+
+    if args.paged:
+        best, report = tune_paged_params(
+            num_slots=args.slots, kv_heads=args.kv_heads,
+            head_dim=args.head_dim, block_size=args.block_size,
+            max_blocks=args.max_blocks, spec_k=args.spec_k,
+            steps=args.steps)
+        master_print(json.dumps({
+            "kernel": "paged_attention",
+            "backend": jax.default_backend(),
+            "chip": jax.devices()[0].device_kind,
+            "shape": {"num_slots": args.slots, "kv_heads": args.kv_heads,
+                      "head_dim": args.head_dim,
+                      "block_size": args.block_size,
+                      "max_blocks": args.max_blocks, "spec_k": args.spec_k},
+            "best": best,
+            "report": report,
+        }, indent=1))
+        return
     best, report = tune_flash_blocks(
         batch=args.batch, heads=args.heads, seq=args.seq,
         head_dim=args.head_dim, causal=not args.no_causal, steps=args.steps,
     )
-    from ..utils.logging import master_print
-
     master_print(json.dumps({
         "backend": jax.default_backend(),
         "chip": jax.devices()[0].device_kind,
