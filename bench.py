@@ -200,7 +200,13 @@ def _measure() -> None:
     if gc not in (None, "off", "int8", "auto"):
         raise SystemExit(
             f"--grad-compress must be 'off', 'int8' or 'auto', got {gc!r}")
-    if gc and os.environ.get("JAX_PLATFORMS") == "cpu":
+    # --autoplan: plan the parallelism from the three cost models
+    # (dist/autoplan.py) and run the chosen plan against the hand-picked
+    # default at equal config_hash.  Like --grad-compress, an explicit
+    # JAX_PLATFORMS=cpu run bootstraps the 8-device sim so there is a
+    # mesh to plan over.
+    autoplan = "--autoplan" in sys.argv
+    if (gc or autoplan) and os.environ.get("JAX_PLATFORMS") == "cpu":
         from torchdistpackage_tpu.dist.overlap import cpu_sim
 
         cpu_sim(8)
@@ -209,7 +215,7 @@ def _measure() -> None:
     main(jax, jnp, ab="--ab" in sys.argv, only=_only_index(sys.argv),
          big="--big" in sys.argv, long="--long" in sys.argv,
          moe="--moe" in sys.argv, trace=_flag_value(sys.argv, "--trace"),
-         overlap=ov, grad_compress=gc)
+         overlap=ov, grad_compress=gc, autoplan=autoplan)
 
 
 def _load_baselines(path: str) -> dict:
@@ -521,9 +527,198 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
             flops_per_token, xla_flops_per_token, ledger, mem)
 
 
+def _run_plan_config(jax, jnp, cfg, chosen, batch_size, steps, warmup, remat,
+                     xent_chunk=None):
+    """Time the planner-chosen plan (tokens/sec/chip) through the same
+    model/batch/steps as :func:`_run_config`.  Two runners cover every
+    executable plan (``dist.autoplan.enumerate_candidates(
+    executable_only=True)``):
+
+    - pure dp with grad compression -> ``DataParallel(grad_compress=
+      'int8')`` (the int8 ring only exists on the shard_map path);
+    - everything else (dp / fsdp / tp mixes) -> a GSPMD jit step over the
+      plan's mesh with the plan's param PartitionSpecs — XLA derives the
+      collectives the specs imply, which is exactly the layout the
+      planner scored."""
+    import optax
+
+    from torchdistpackage_tpu.dist import autoplan as _autoplan
+    from torchdistpackage_tpu.models import gpt_loss, init_gpt_params
+
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+
+    def loss_fn(p, batch):
+        return gpt_loss(p, batch, cfg, remat=remat, xent_chunk=xent_chunk)
+
+    opt = optax.adamw(3e-4)
+    state = opt.init(params)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _autoplan.build_mesh(chosen)
+    n_chips = max(1, jax.device_count())
+    specs = _autoplan.plan_param_specs(chosen, cfg)
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: x is None)
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    global_batch = batch_size * n_chips
+    batch = jax.device_put({
+        "tokens": jax.random.randint(
+            k1, (global_batch, cfg.max_seq), 0, cfg.vocab_size),
+        "targets": jax.random.randint(
+            k2, (global_batch, cfg.max_seq), 0, cfg.vocab_size),
+    }, NamedSharding(mesh, _autoplan.batch_partition_spec(chosen)))
+
+    if (chosen["compress"]["grads"] and chosen["layout"] == "dp"
+            and chosen["tp"] == 1):
+        from torchdistpackage_tpu.parallel.data_parallel import DataParallel
+
+        dp = DataParallel(mesh=mesh, grad_compress="int8",
+                          compress_min_size=4096)
+        step = dp.make_train_step(loss_fn, opt)
+    else:
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, state = opt.update(grads, state, params)
+            return jax.tree.map(jnp.add, params, updates), state, loss
+
+    for _ in range(warmup):
+        params, state, loss = step(params, state, batch)[:3]
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, state, loss = step(params, state, batch)[:3]
+    float(loss)
+    dt = time.perf_counter() - t0
+    return global_batch * cfg.max_seq * steps / dt / n_chips, dt / steps
+
+
+def _run_autoplan(jax, jnp, cfg, batch_size, steps, warmup, remat,
+                  xent_chunk, baselines, baseline_path, backend, chip, peak,
+                  size_tag) -> None:
+    """The ``--autoplan`` A/B: measure the hand-picked default, close the
+    loop (the measured step calibrates the compute term; a comm_bench
+    calibration grounds the comm terms incl. the int8 arms), plan, run
+    the chosen plan, and emit the paired ``ap-{default,planned}`` rows at
+    equal ``config_hash``."""
+    import hashlib
+
+    from torchdistpackage_tpu.dist import autoplan as _autoplan
+    from torchdistpackage_tpu.obs.comm_model import CommModel
+
+    n_chips = max(1, jax.device_count())
+    tps_def, global_batch, fpt, fpt_xla, _ledger, _mem = _run_config(
+        jax, jnp, cfg, batch_size, steps, warmup, remat,
+        xent_chunk=xent_chunk)
+    step_def = global_batch * cfg.max_seq / (tps_def * n_chips)
+    fpt_basis = fpt_xla or fpt
+    # sustained per-device FLOP/s the DEFAULT config actually achieved —
+    # the measurement-grounded compute basis (HLO FLOPs / measured step)
+    eff = fpt_basis * global_batch * cfg.max_seq / n_chips / step_def
+
+    # calibrate the comm model on a dp x tp view of the attached chips so
+    # the planner's per-axis alpha/beta (incl. the int8-ring arms) come
+    # from THIS fabric, not the generation tables
+    comm_model = None
+    try:
+        from jax.sharding import Mesh
+
+        import numpy as _np
+
+        tp_cal = 2 if n_chips % 2 == 0 and n_chips > 1 else 1
+        cal_mesh = Mesh(
+            _np.asarray(jax.devices()).reshape(n_chips // tp_cal, tp_cal),
+            axis_names=("data", "tensor"))
+        comm_model = CommModel.calibrate(
+            mesh=cal_mesh, sizes=(1 << 14, 1 << 18), iters=3,
+            ops=("all_reduce", "all_gather"),
+            compressed_ops=("int8_all_reduce", "int8_reduce_scatter",
+                            "int8_all_gather"))
+    except Exception as e:
+        print(f"bench: comm calibration failed ({e!r}); using the table "
+              f"model", file=sys.stderr)
+
+    result = _autoplan.plan(
+        cfg, n_chips, global_batch=global_batch,
+        comm_model=comm_model, effective_flops=eff, fpt=fpt_basis,
+        executable_only=True, device_kind=chip)
+    chosen = result["chosen"]
+    if chosen is None:
+        # every executable candidate over the HBM budget: report the
+        # default arm plus the verdict instead of crashing the child
+        print("bench: autoplan found NO executable plan within the memory "
+              f"budget ({result['n_pruned_oom']}/{result['n_candidates']} "
+              "pruned)", file=sys.stderr)
+        print(json.dumps({
+            "metric": f"gpt-{size_tag}-train-throughput",
+            "value": round(tps_def, 2), "unit": "tokens/sec/chip",
+            "config": f"gpt d{cfg.dim} L{cfg.nlayers} seq{cfg.max_seq} "
+                      f"b{global_batch} ap-default",
+            "chip": chip, "backend": backend, "autoplan": "default",
+            "autoplan_verdict": "all_oom",
+            "plan_pruned_oom": result["n_pruned_oom"],
+        }))
+        return
+    print(f"bench: autoplan chose {chosen['key']} "
+          f"(modeled step {chosen['step_s'] * 1e3:.3f} ms vs default "
+          f"measured {step_def * 1e3:.3f} ms; "
+          f"{result['n_pruned_oom']}/{result['n_candidates']} pruned OOM)",
+          file=sys.stderr)
+
+    tps_plan, step_plan = _run_plan_config(
+        jax, jnp, cfg, chosen, batch_size, steps, warmup, remat,
+        xent_chunk=xent_chunk)
+    _autoplan.attach_measured(result, [{
+        "key": chosen["key"], "modeled_step_s": chosen["step_s"],
+        "measured_step_s": step_plan,
+    }])
+
+    metric = f"gpt-{size_tag}-train-throughput"
+    base_config_str = (
+        f"gpt d{cfg.dim} L{cfg.nlayers} seq{cfg.max_seq} b{global_batch}")
+    config_hash = hashlib.sha1(
+        f"{metric}|{base_config_str}".encode()).hexdigest()[:12]
+    for arm, tps in (("default", tps_def), ("planned", tps_plan)):
+        config_str = f"{base_config_str} ap-{arm}"
+        _record_baseline(baselines, baseline_path, backend, config_str, tps,
+                         chip=chip, metric=metric)
+        line = {
+            "metric": metric,
+            "value": round(tps, 2),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": round(
+                tps / _best_recorded(baselines, backend, tps, metric=metric),
+                4),
+            "config": config_str,
+            "chip": chip,
+            "backend": backend,
+            "config_hash": config_hash,
+            "autoplan": arm,
+        }
+        if peak:
+            line["peak_flops_est"] = peak
+            line["mfu"] = round(tps * fpt / peak, 4)
+        if arm == "planned":
+            mvm = result["modeled_vs_measured"]["rows"][0]
+            line["plan"] = chosen["key"]
+            line["autoplan_tok_s"] = round(tps, 2)
+            line["plan_modeled_step_s"] = round(chosen["step_s"], 6)
+            line["plan_measured_step_s"] = round(step_plan, 6)
+            line["plan_modeled_vs_measured_rel"] = mvm["rel_err"]
+            line["plan_candidates"] = result["n_candidates"]
+            line["plan_pruned_oom"] = result["n_pruned_oom"]
+            line["plan_comm_basis"] = result["basis"]["comm"]
+            line["vs_default"] = round(tps / tps_def, 4)
+        print(json.dumps(line))
+
+
 def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
          long: bool = False, moe: bool = False, trace=None,
-         overlap=None, grad_compress=None) -> None:
+         overlap=None, grad_compress=None, autoplan: bool = False) -> None:
     from torchdistpackage_tpu.models import GPTConfig
 
     # Backend probe with CPU fallback: an accelerator backend that errors at
@@ -587,6 +782,15 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
     baseline_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
     baselines = _load_baselines(baseline_path)
+
+    if autoplan:
+        # --autoplan measures the default config, plans from the three
+        # cost models, and emits the paired ap-{default,planned} rows
+        batch_size, remat, xent_chunk = candidates[0][:3]
+        _run_autoplan(jax, jnp, cfg, batch_size, steps, warmup, remat,
+                      xent_chunk, baselines, baseline_path, backend, chip,
+                      peak, size_tag)
+        return
 
     if only is not None:
         if only >= len(candidates):
@@ -933,6 +1137,10 @@ if __name__ == "__main__":
         # through DataParallel(grad_compress=...) so the reduction is a
         # ledgered collective)
         long_flag = (*long_flag, "--grad-compress", _gc)
+    if "--autoplan" in sys.argv:
+        # forward the planner A/B arm (the child plans from the measured
+        # default step + a comm calibration, then times the chosen plan)
+        long_flag = (*long_flag, "--autoplan")
     if on_cpu:
         ok = _run_child({}, cpu_timeout, long_flag)
     else:

@@ -37,7 +37,11 @@ OBS_EXAMPLES = {
     # numerics_stats into its compiled step (healthy run: timeline + dtype
     # ledger, zero alerts); train_resilient's chaos NaN spike must appear
     # as a numerics_alert BEFORE the rollback event on the timeline
-    "train_tp_dp.py": {"comm": "dp", "memory": True, "numerics": "healthy"},
+    # ``autoplan`` probes the PR-13 section: train_tp_dp's planner phase
+    # plans the layout from the three cost models, proves the chosen plan
+    # trains, and records the validated section + plan_selected event
+    "train_tp_dp.py": {"comm": "dp", "memory": True, "numerics": "healthy",
+                       "autoplan": True},
     "train_pipeline.py": {"counter": "pipeline", "field": "bubble_fraction"},
     "train_interleaved_pipeline.py": {
         "counter": "pipeline", "field": "bubble_fraction"},
@@ -160,6 +164,19 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
             assert 0.0 <= srv["spec_accept_rate"] <= 1.0, srv
             assert srv["spec"]["k"] >= 1, srv
             assert {"prefix_hit", "spec_draft", "spec_verify"} <= kinds, kinds
+
+    if probe.get("autoplan"):
+        # the PR-13 planner section: a chosen plan with per-term score
+        # breakdowns, candidate/pruned accounting, and the selection
+        # event on the timeline (validate_runreport already ranged it)
+        aps = report.get("autoplan")
+        assert aps, (script, "no autoplan section")
+        assert aps["verdict"] == "ok" and aps["chosen"], aps
+        assert aps["chosen"]["terms"] is not None
+        assert aps["n_candidates"] > 0
+        assert 0 <= aps["n_pruned_oom"] <= aps["n_candidates"]
+        kinds = {e["kind"] for e in report["events"]}
+        assert "plan_selected" in kinds, kinds
 
     if probe.get("memory"):
         # the PR-6 memory section: per-program static breakdown captured

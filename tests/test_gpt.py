@@ -988,7 +988,15 @@ def test_streamed_head_loss_matches_full():
     )
 
 
-@pytest.mark.parametrize("num_chunks", [1, 2])
+# num_chunks=1 demoted to slow for tier-1 budget (PR 13): the
+# per-(stage, microbatch, layer) dropout-key threading and its
+# bwd-recompute replay are exercised fast-tier by the interleaved
+# num_chunks=2 variant (the same mask recipe driven through the MORE
+# general schedule, chunk index folded in); the plain-1F1B point keeps
+# running in the slow tier.
+@pytest.mark.parametrize("num_chunks", [
+    pytest.param(1, marks=pytest.mark.slow), 2,
+])
 @pytest.mark.heavy
 def test_gpt_1f1b_dropout(devices8, params, num_chunks):
     """Dropout THROUGH the 1F1B pipeline: per-(stage, microbatch, layer)

@@ -274,7 +274,15 @@ def serial_1f1b_ref():
     return get
 
 
-@pytest.mark.parametrize("pp,m", [(2, 4), (4, 4), (4, 9), (4, 2)])
+# (4, 9) — the odd-M point at depth — demoted to slow for tier-1 budget
+# (PR 13): it was 21 s of mostly compile for one extra (P, M) grid point,
+# while the fast tier keeps P=4 at both a divisible (M=4) and a
+# smaller-than-schedule (M=2) microbatch count plus the P=2 base case.
+@pytest.mark.parametrize("pp,m", [
+    (2, 4), (4, 4),
+    pytest.param(4, 9, marks=pytest.mark.slow),
+    (4, 2),
+])
 @pytest.mark.heavy
 def test_pipeline_1f1b_matches_serial(devices8, serial_1f1b_ref, pp, m):
     """The 1F1B schedule's (loss, grads) must equal serial AD exactly —

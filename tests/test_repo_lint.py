@@ -47,6 +47,9 @@ ALLOWLIST = {
     # A/B run-parity diff CLI (PR 7): jax-free gate over RUNREPORT/JSONL
     # artifacts on disk, same login-node deal as bench_trend.
     "tools/parity_diff.py",
+    # auto-sharding planner CLI (PR 13): jax-free capacity-planning tool
+    # over a JSON model config, same login-node deal as bench_trend.
+    "tools/autoplan.py",
 }
 
 
@@ -317,6 +320,23 @@ def test_numerics_event_kinds_registered_and_emitted():
     assert "numerics_alert" in obs_kinds, obs_kinds
     assert "numerics_alert" in loop_kinds, loop_kinds
     assert {"nan_block_located", "nan_watchdog"} <= nan_kinds, nan_kinds
+
+
+def test_autoplan_event_kinds_registered_and_emitted():
+    """The auto-sharding planner kinds (PR 13) are in the registry AND
+    emitted where the planner lives — ``plan_selected`` is the audit
+    anchor every chosen plan leaves on the timeline, ``plan_rejected_oom``
+    is the before-any-compile pruning evidence the acceptance gates on; a
+    kind that stopped being emitted would silently blind both."""
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+
+    kinds = {"plan_selected", "plan_rejected_oom"}
+    assert kinds <= EVENT_KINDS
+    emitted = {
+        k for _, k in _emit_call_kinds(PKG / "dist" / "autoplan.py")}
+    missing = kinds - emitted
+    assert not missing, (
+        f"autoplan kinds never emitted from dist/autoplan.py: {missing}")
 
 
 def test_compress_policy_event_kind_registered_and_emitted():

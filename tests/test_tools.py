@@ -318,6 +318,29 @@ def test_bench_trend_paged_kernel_column():
     assert any("REGRESSION serve-paged-pallas" in w for w in warnings)
 
 
+def test_bench_trend_autoplan_columns():
+    """The PR-13 planner columns: the ``bench.py --autoplan`` planned
+    arm's line gates on tokens/s (``value``) with ``autoplan_tok_s`` /
+    ``plan_modeled_step_s`` rendered alongside — a throughput hold with a
+    drifting modeled step (the planner steering on stale numbers) is
+    visible in the trend, and a planned-arm regression still trips the
+    gate."""
+    from torchdistpackage_tpu.tools.bench_trend import AUX_KEYS, trend
+
+    assert {"autoplan_tok_s", "plan_modeled_step_s"} <= set(AUX_KEYS)
+    line = {"metric": "gpt-tiny-train-throughput", "value": 530.0,
+            "autoplan": "planned", "plan": "dp8",
+            "autoplan_tok_s": 530.0, "plan_modeled_step_s": 0.0019,
+            "config": "c ap-planned"}
+    report, warnings = trend(
+        [(1, [line]),
+         (2, [dict(line, value=400.0, autoplan_tok_s=400.0)])],
+        threshold=0.05)
+    assert any("autoplan_tok_s=530.0" in ln for ln in report)
+    assert any("plan_modeled_step_s=0.0019" in ln for ln in report)
+    assert any("REGRESSION gpt-tiny-train-throughput" in w for w in warnings)
+
+
 def test_bench_trend_comm_bytes_column():
     """The PR-8 wire-bytes column: a line carrying ``comm_bytes_per_dim``
     renders its TOTAL in the aux trail, so a compressed collective

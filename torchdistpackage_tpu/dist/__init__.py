@@ -11,5 +11,6 @@ from .topology import (
     tpc,
 )
 from .launch import setup_distributed, find_free_port
+from . import autoplan
 from . import comm_bench
 from . import overlap

@@ -1,0 +1,836 @@
+"""Auto-sharding planner — close the loop from cost models to a plan.
+
+The repo owns three cost models that were, until now, only ever consulted
+one at a time: the calibrated per-axis alpha-beta :class:`~..obs.comm_model.
+CommModel` (including the int8-ring ``predict_compressed`` arms), the HLO
+``cost_analysis`` FLOP count captured by the Telemetry AOT hook, and
+:class:`~..obs.mem_ledger.MemoryModel` (per-leaf resident bytes from
+spec x mesh math, no compile).  This module is the consumer that uses all
+three at once: given a model config and a chip count it
+
+1. **enumerates** candidate plans — mesh factorizations ``dp x tp x pp``
+   of the chip count (constrained to what the model family can actually
+   shard: ``tp | nheads/dim/vocab``, ``pp | nlayers``), each crossed with
+   the layer layout for the data axis (``dp`` = replicated params,
+   ``fsdp`` = ZeRO-3 param sharding via the same first-free-divisible-dim
+   rule ``parallel.zero.zero_partition_spec`` applies) and with per-axis
+   int8 compression arms (grad collectives on the data axis, SP boundary
+   activations on the tensor axis — exactly the knobs
+   ``DataParallel(grad_compress=...)`` / ``TransformerConfig(ag_compress=
+   ...)`` expose);
+2. **prunes** candidates whose modeled per-device resident bytes exceed
+   the HBM budget — ``MemoryModel.estimate`` is the judge when jax is
+   importable (``memory='model'``), a byte-identical pure-python mirror
+   (``memory='analytic'``, pinned to the model by tests) serves the
+   jax-free CLI; every pruned plan emits a ``plan_rejected_oom`` event
+   **before anything compiles**;
+3. **scores** the survivors with a modeled step time: an HLO-FLOP (or
+   6N+12LSD formula) compute term over a sustained per-device FLOP/s
+   basis, plus every per-step collective the plan implies priced through
+   the CommModel (grad reduce / ZeRO param gathers over ``data``, SP
+   boundary gathers+scatters over ``tensor``, pipeline p2p over ``pipe``
+   with the 1F1B bubble on the compute term) — compressed arms priced by
+   ``predict_compressed``, so an int8 arm can only win when the
+   (calibrated) model approves it;
+4. **emits** an executable plan: mesh axes, per-leaf param PartitionSpecs
+   (:func:`plan_param_specs` builds the real ``jax.sharding.
+   PartitionSpec`` tree for the winning candidate), the compress policy,
+   and the ranked alternatives with per-term score breakdowns — plus a
+   ``plan_selected`` event and the validated RUNREPORT ``autoplan``
+   section (``Telemetry.record_autoplan``), so every selection is
+   auditable after the fact.
+
+Known gaps vs measured (docs/autoplan.md spells these out): the comm
+terms assume zero compute/comm overlap (the same serialized convention as
+the RUNREPORT comm section's ``modeled_comm_s``), the vocab-parallel
+cross-entropy reductions and optimizer-update traffic are unmodeled, and
+TP compute is assumed to scale perfectly.  The ranking is validated
+against measured CPU-sim steps in ``tests/test_autoplan.py`` and the
+``bench.py --autoplan`` arm; disagreements are disclosed in the section's
+``modeled_vs_measured`` record rather than hidden.
+
+Module scope is deliberately jax-free (``tools/autoplan.py`` is a
+login-node CLI over a JSON model config, like ``bench_trend``): jax is
+imported lazily and only by the executable-side helpers and the
+``memory='model'`` estimator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs.comm_model import CommModel
+from ..obs.mem_ledger import headroom_verdict
+# the schema vocabulary lives in obs (the leaf subsystem) so the RUNREPORT
+# validator never has to import dist; re-exported here for callers
+from ..obs.report import AUTOPLAN_SCHEMA, PLAN_VERDICTS  # noqa: F401
+
+#: Default sustained per-device FLOP/s when nothing better is known (no
+#: measured step, no recognized chip) — only relative comm terms order
+#: plans in that regime, and the basis is recorded so the report says so.
+ASSUMED_FLOPS = 1e12
+
+
+# --------------------------------------------------------- model description
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelDims:
+    """Normalized, jax-free view of a model config — everything the shape
+    table and the FLOP formula need.  Built by :func:`model_dims` from a
+    ``GPTConfig``, a ``TransformerConfig``, or a plain dict (the CLI's
+    JSON config)."""
+
+    family: str  # 'gpt' (embed + stacked blocks + head) | 'transformer'
+    dim: int
+    nheads: int
+    nlayers: int
+    seq: int
+    vocab: Optional[int] = None
+    ffn: int = 0
+    kv_heads: Optional[int] = None
+    act: str = "gelu"
+    norm: str = "layer"
+    pos: str = "learned"
+    dtype_size: int = 4
+
+
+def model_dims(config: Any) -> ModelDims:
+    """Normalize a GPTConfig / TransformerConfig / dict into
+    :class:`ModelDims`.  MoE configs are rejected loudly — expert/routing
+    traffic is not modeled here (the EP all_to_all needs its own terms)."""
+    get = (config.get if isinstance(config, dict)
+           else lambda k, d=None: getattr(config, k, d))
+    if get("moe_experts", 0):
+        raise ValueError(
+            "autoplan does not model MoE configs (EP all_to_all + expert "
+            "capacity terms are unmodeled); plan the dense trunk instead")
+    dim = int(get("dim"))
+    ffn = get("ffn_hidden") or dim * int(get("ffn_mult", 4))
+    dtype = get("dtype", "float32")
+    try:
+        dtype_size = int(np.dtype(dtype).itemsize)
+    except TypeError:
+        dtype_size = int(np.dtype(str(dtype).split(".")[-1]).itemsize)
+    vocab = get("vocab_size")
+    seq = get("max_seq") or get("seq") or 0
+    kv = get("kv_heads")
+    return ModelDims(
+        family="gpt" if vocab else "transformer",
+        dim=dim,
+        nheads=int(get("nheads")),
+        nlayers=int(get("nlayers")),
+        seq=int(seq),
+        vocab=int(vocab) if vocab else None,
+        ffn=int(ffn),
+        kv_heads=int(kv) if kv else None,
+        act=str(get("act", "gelu")),
+        norm=str(get("norm", "layer")),
+        pos=str(get("pos", "learned")),
+        dtype_size=dtype_size,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafRow:
+    """One param leaf of the analytic shape table.  ``tp_dim`` /
+    ``stack_dim`` name the dims the tensor / pipe axes shard (None =
+    replicated on that axis); ``count`` multiplies the leaf (the
+    transformer family keeps per-layer block lists where GPT stacks)."""
+
+    path: str
+    shape: Tuple[int, ...]
+    tp_dim: Optional[int] = None
+    stack_dim: Optional[int] = None
+    count: int = 1
+    matmul: bool = True  # counted by the 6N FLOP formula
+
+
+def _block_rows(d: ModelDims) -> List[LeafRow]:
+    """Unstacked per-block leaves with their TP dims — the analytic mirror
+    of ``tensor_parallel.block_param_specs`` + ``init_block_params``."""
+    D, F = d.dim, d.ffn
+    rows: List[LeafRow] = []
+    norm_leaves = [("scale", (D,))] + (
+        [("bias", (D,))] if d.norm == "layer" else [])
+    for ln in ("ln1", "ln2"):
+        rows += [LeafRow(f"{ln}.{k}", s) for k, s in norm_leaves]
+    if d.kv_heads and d.kv_heads != d.nheads:
+        dkv = d.kv_heads * (D // d.nheads)
+        rows += [
+            LeafRow("attn.wq", (D, D), tp_dim=1),
+            LeafRow("attn.bq", (D,), tp_dim=0),
+            LeafRow("attn.wkv", (2, D, dkv), tp_dim=2),
+            LeafRow("attn.bkv", (2, dkv), tp_dim=1),
+        ]
+    else:
+        rows += [
+            LeafRow("attn.wqkv", (3, D, D), tp_dim=2),
+            LeafRow("attn.bqkv", (3, D), tp_dim=1),
+        ]
+    rows += [
+        LeafRow("attn.wo", (D, D), tp_dim=0),
+        LeafRow("attn.bo", (D,)),
+    ]
+    if d.act == "swiglu":
+        rows += [
+            LeafRow("mlp.w1", (2, D, F), tp_dim=2),
+            LeafRow("mlp.b1", (2, F), tp_dim=1),
+        ]
+    else:
+        rows += [
+            LeafRow("mlp.w1", (D, F), tp_dim=1),
+            LeafRow("mlp.b1", (F,), tp_dim=0),
+        ]
+    rows += [
+        LeafRow("mlp.w2", (F, D), tp_dim=0),
+        LeafRow("mlp.b2", (D,)),
+    ]
+    return rows
+
+
+def param_table(d: ModelDims) -> List[LeafRow]:
+    """The model's full analytic shape table.  GPT stacks block leaves on
+    a leading [L] dim (``stack_dim=0`` — the dim ``pipe`` shards, and a
+    legal FSDP dim, exactly as in the real spec tree); the transformer
+    family keeps per-layer leaves (``count=nlayers``)."""
+    rows: List[LeafRow] = []
+    if d.family == "gpt":
+        assert d.vocab
+        rows.append(LeafRow("tok_emb", (d.vocab, d.dim), tp_dim=0,
+                            matmul=False))
+        if d.pos == "learned":
+            rows.append(LeafRow("pos_emb", (d.seq, d.dim), matmul=False))
+        for r in _block_rows(d):
+            rows.append(LeafRow(
+                f"blocks.{r.path}", (d.nlayers, *r.shape),
+                tp_dim=None if r.tp_dim is None else r.tp_dim + 1,
+                stack_dim=0))
+        rows.append(LeafRow("head", (d.dim, d.vocab), tp_dim=1))
+    else:
+        for r in _block_rows(d):
+            rows.append(dataclasses.replace(
+                r, path=f"blocks.{r.path}", count=d.nlayers))
+    norm_leaves = [("scale", (d.dim,))] + (
+        [("bias", (d.dim,))] if d.norm == "layer" else [])
+    rows += [LeafRow(f"ln_f.{k}", s) for k, s in norm_leaves]
+    return rows
+
+
+def flops_per_token(d: ModelDims) -> float:
+    """The bench.py 6N+12LSD accounting: 6 FLOPs per matmul param per
+    token (embedding tables excluded — gathers, not matmuls) plus the
+    attention score/value matmuls.  ``bench.py --autoplan`` replaces this
+    with the compiled step's own ``cost_analysis`` count when it has one."""
+    n_matmul = sum(
+        r.count * int(np.prod(r.shape, dtype=np.int64))
+        for r in param_table(d) if r.matmul)
+    return 6.0 * n_matmul + 12.0 * d.nlayers * d.seq * d.dim
+
+
+# --------------------------------------------------------------- candidates
+
+
+def _divisors(n: int) -> List[int]:
+    return [k for k in range(1, n + 1) if n % k == 0]
+
+
+def _tp_ok(d: ModelDims, tp: int) -> bool:
+    if tp == 1:
+        return True
+    if d.nheads % tp or d.dim % tp or d.ffn % tp:
+        return False
+    if d.vocab and d.vocab % tp:
+        return False
+    if d.kv_heads and d.kv_heads % tp:
+        return False
+    return True
+
+
+def candidate_key(c: Dict[str, Any]) -> str:
+    parts = [f"{'fsdp' if c['layout'] == 'fsdp' else 'dp'}{c['dp']}"]
+    if c["tp"] > 1:
+        parts.append(f"tp{c['tp']}")
+    if c["pp"] > 1:
+        parts.append(f"pp{c['pp']}")
+    key = "·".join(parts)
+    if c["compress"]["grads"]:
+        key += "+gc8"
+    if c["compress"]["acts"]:
+        key += "+ac8"
+    return key
+
+
+def enumerate_candidates(
+    d: ModelDims,
+    n_chips: int,
+    global_batch: int,
+    allow_pp: bool = True,
+    executable_only: bool = False,
+    compression: bool = True,
+    layouts: Sequence[str] = ("dp", "fsdp"),
+) -> List[Dict[str, Any]]:
+    """Every legal ``dp x tp x pp`` factorization of ``n_chips`` crossed
+    with layer layout and compression arms — deterministic order.  Awkward
+    chip counts still always yield at least pure DP (``dp = n_chips``
+    divides any batch multiple of it; batch-indivisible dp values are
+    skipped).  ``executable_only`` restricts to plans bench's timed
+    runners can execute: ``pp == 1`` (pipeline plans need the 1F1B
+    scheduler, which the timed step does not drive) and compression only
+    on the pure-dp arm (``DataParallel(grad_compress='int8')`` — the
+    GSPMD jit runner for tp/fsdp plans cannot express the int8 rings)."""
+    out: List[Dict[str, Any]] = []
+    for pp in _divisors(n_chips):
+        if pp > 1 and (
+                not allow_pp or executable_only or d.family != "gpt"
+                or d.nlayers % pp):
+            continue
+        for tp in _divisors(n_chips // pp):
+            if not _tp_ok(d, tp):
+                continue
+            dp = n_chips // pp // tp
+            if global_batch % dp:
+                continue
+            arm_layouts = [
+                l for l in layouts if l == "dp" or (l == "fsdp" and dp > 1)]
+            for layout in arm_layouts:
+                can_gq = compression and dp > 1 and not (
+                    executable_only and (tp > 1 or layout == "fsdp"))
+                grad_arms = (False, True) if can_gq else (False,)
+                act_arms = (False, True) if (
+                    compression and tp > 1 and not executable_only) else (False,)
+                for gq in grad_arms:
+                    for aq in act_arms:
+                        out.append({
+                            "dp": dp, "tp": tp, "pp": pp,
+                            "layout": layout,
+                            "mesh_axes": {"pipe": pp, "data": dp,
+                                          "tensor": tp},
+                            "compress": {"grads": gq, "acts": aq},
+                        })
+    for c in out:
+        c["key"] = candidate_key(c)
+    return out
+
+
+# ----------------------------------------------------------------- sharding
+
+
+def _axis_assignment(
+    row: LeafRow, c: Dict[str, Any]
+) -> List[Optional[str]]:
+    """Per-dim mesh-axis assignment for one leaf under candidate ``c`` —
+    the analytic mirror of ``plan_param_specs``: tp/pipe dims from the
+    table, then (fsdp layout) the data axis on the first free dim whose
+    size divides dp, exactly ``parallel.zero.zero_partition_spec``'s rule."""
+    entries: List[Optional[str]] = [None] * len(row.shape)
+    if c["pp"] > 1 and row.stack_dim is not None:
+        entries[row.stack_dim] = "pipe"
+    if c["tp"] > 1 and row.tp_dim is not None:
+        entries[row.tp_dim] = "tensor"
+    if c["layout"] == "fsdp" and c["dp"] > 1:
+        for dim, (size, used) in enumerate(zip(row.shape, entries)):
+            if used is None and size > 0 and size % c["dp"] == 0:
+                entries[dim] = "data"
+                break
+    return entries
+
+
+def _leaf_shards(row: LeafRow, c: Dict[str, Any]) -> int:
+    n = 1
+    for axis in _axis_assignment(row, c):
+        if axis is not None:
+            n *= c["mesh_axes"][axis]
+    return n
+
+
+def _spec_str(entries: Sequence[Optional[str]]) -> str:
+    trimmed = list(entries)
+    while trimmed and trimmed[-1] is None:
+        trimmed.pop()
+    return "P(" + ", ".join(a or "None" for a in trimmed) + ")"
+
+
+def spec_table(d: ModelDims, c: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Per-leaf spec rows of a candidate (rendered, audit-friendly) —
+    the ``param_specs`` payload of an emitted plan."""
+    rows = []
+    for r in param_table(d):
+        entries = _axis_assignment(r, c)
+        rows.append({
+            "path": r.path,
+            "shape": list(r.shape),
+            "spec": _spec_str(entries),
+            "shard_count": _leaf_shards(r, c),
+        })
+    return rows
+
+
+# ------------------------------------------------------------------- memory
+
+
+def estimate_memory_analytic(
+    d: ModelDims,
+    c: Dict[str, Any],
+    global_batch: int,
+    seq_len: Optional[int] = None,
+    capacity_bytes: Optional[int] = None,
+    optimizer_slots: int = 2,
+    act_factor: float = 1.0,
+) -> Dict[str, Any]:
+    """Pure-python per-device resident-bytes estimate — byte-identical to
+    ``MemoryModel.estimate`` over the real (config, mesh, specs) triple
+    (``tests/test_autoplan.py`` pins the two): per-leaf ceil over the
+    spec'd shard product, grads at param sharding, f32 optimizer moments,
+    the same B_local*S*D*L activation term."""
+    params_bytes = 0
+    elems_resident = 0
+    for r in param_table(d):
+        n_elems = int(np.prod(r.shape, dtype=np.int64))
+        shards = _leaf_shards(r, c)
+        resident = -(-n_elems // shards)
+        params_bytes += r.count * resident * d.dtype_size
+        elems_resident += r.count * resident
+    grads_bytes = params_bytes
+    opt_bytes = optimizer_slots * elems_resident * 4
+    S = seq_len if seq_len is not None else d.seq
+    batch_per_device = global_batch // c["dp"]
+    act_bytes = int(
+        batch_per_device * S * d.dim * d.nlayers * act_factor * d.dtype_size)
+    total = params_bytes + grads_bytes + opt_bytes + act_bytes
+    hv = headroom_verdict(total, capacity_bytes)
+    return {
+        "params_bytes": params_bytes,
+        "grads_bytes": grads_bytes,
+        "opt_bytes": opt_bytes,
+        "act_bytes": act_bytes,
+        "total_bytes": total,
+        "capacity_bytes": capacity_bytes,
+        "frac": hv["frac"],
+        "headroom_frac": hv["headroom_frac"],
+        "verdict": hv["verdict"],
+        "basis": "analytic",
+    }
+
+
+class _MiniMesh:
+    """Duck-typed mesh for ``MemoryModel.estimate`` (it reads only
+    ``axis_names`` + ``shape``) — scores mesh shapes no device has to
+    back."""
+
+    def __init__(self, sizes: Dict[str, int]) -> None:
+        self.shape = dict(sizes)
+        self.axis_names = tuple(sizes)
+
+
+def estimate_memory_model(
+    config: Any,
+    c: Dict[str, Any],
+    global_batch: int,
+    seq_len: Optional[int] = None,
+    capacity_bytes: Optional[int] = None,
+    optimizer_slots: int = 2,
+    act_factor: float = 1.0,
+) -> Dict[str, Any]:
+    """``MemoryModel.estimate`` over the candidate's REAL spec tree — the
+    acceptance path: the same model that judges compiled layouts judges
+    the plan, before anything compiles."""
+    from ..obs.mem_ledger import MemoryModel
+
+    specs = plan_param_specs(c, config)
+    est = MemoryModel(
+        capacity_bytes=capacity_bytes,
+        optimizer_slots=optimizer_slots,
+        act_factor=act_factor,
+    ).estimate(
+        config, _MiniMesh(c["mesh_axes"]), specs,
+        batch_per_device=global_batch // c["dp"],
+        seq_len=seq_len,
+    )
+    est = {k: est[k] for k in (
+        "params_bytes", "grads_bytes", "opt_bytes", "act_bytes",
+        "total_bytes", "capacity_bytes", "frac", "headroom_frac",
+        "verdict")}
+    est["basis"] = "memory-model"
+    return est
+
+
+# ------------------------------------------------------------------ scoring
+
+
+def _grad_payload_bytes(d: ModelDims, c: Dict[str, Any]) -> float:
+    """Per-device grad bytes entering the data-axis collective: each
+    leaf's bytes after the NON-data shards (tp/pp) — the fsdp data shard
+    is the collective's OUTPUT, not its payload."""
+    total = 0
+    for r in param_table(d):
+        n_elems = int(np.prod(r.shape, dtype=np.int64))
+        shards = 1
+        for axis in _axis_assignment(r, c):
+            if axis in ("tensor", "pipe"):
+                shards *= c["mesh_axes"][axis]
+        total += r.count * -(-n_elems // shards) * d.dtype_size
+    return float(total)
+
+
+def comm_terms(
+    d: ModelDims,
+    c: Dict[str, Any],
+    global_batch: int,
+    model: CommModel,
+    seq_len: Optional[int] = None,
+    microbatches: int = 8,
+) -> List[Dict[str, Any]]:
+    """The per-step collectives candidate ``c`` implies, priced through
+    the CommModel.  Per term: op, axes, full-payload bytes (the same
+    nccl-tests convention ``CommModel.predict`` expects), op count per
+    step, per-op and total predicted seconds, and — for compressed arms —
+    the ``predict_compressed`` record (so the report shows whether the
+    calibrated model actually approved the ring)."""
+    S = seq_len if seq_len is not None else d.seq
+    dp, tp, pp = c["dp"], c["tp"], c["pp"]
+    terms: List[Dict[str, Any]] = []
+
+    def price(name, op, axes, n, payload, count, compressed):
+        if n <= 1 or payload <= 0 or count <= 0:
+            return
+        row: Dict[str, Any] = {
+            "name": name, "op": op, "axes": list(axes), "n": int(n),
+            "payload_bytes": int(payload), "count": int(count),
+            "compressed": bool(compressed),
+        }
+        if compressed:
+            rec = model.predict_compressed(
+                op, payload, n, axes=axes, elem_bytes=d.dtype_size)
+            row["per_op_s"] = rec["compressed_s"]
+            row["model_approves"] = rec["compress"]
+            row["basis"] = rec["basis"]
+            row["exact_s"] = rec["exact_s"]
+        else:
+            row["per_op_s"] = model.predict(op, payload, n, axes=axes)
+        row["total_s"] = row["per_op_s"] * count
+        terms.append(row)
+
+    gq = c["compress"]["grads"]
+    grad_bytes = _grad_payload_bytes(d, c)
+    if dp > 1:
+        if c["layout"] == "fsdp":
+            # ZeRO-3: param all-gather fwd + bwd re-gather, grad
+            # reduce-scatter inside the backward
+            price("fsdp-param-gather", "all_gather", ("data",), dp,
+                  grad_bytes, 2, gq)
+            price("fsdp-grad-scatter", "reduce_scatter", ("data",), dp,
+                  grad_bytes, 1, gq)
+        else:
+            price("dp-grad-reduce", "all_reduce", ("data",), dp,
+                  grad_bytes, 1, gq)
+    if tp > 1:
+        # SP boundaries: 2 gathers + 2 scatters per block forward, the
+        # transposed pair in the backward -> 4 of each per layer per step
+        act_bytes = (global_batch // dp) * S * d.dim * d.dtype_size
+        n_each = 4 * d.nlayers
+        aq = c["compress"]["acts"]
+        price("sp-act-gather", "all_gather", ("tensor",), tp,
+              act_bytes, n_each, aq)
+        price("sp-act-scatter", "reduce_scatter", ("tensor",), tp,
+              act_bytes, n_each, aq)
+    if pp > 1:
+        # 1F1B critical path: ~2(M + pp - 2) boundary transfers of one
+        # microbatch's boundary activation
+        micro_act = ((global_batch // dp) / microbatches) * S * d.dim \
+            * d.dtype_size
+        price("pp-boundary", "ppermute", ("pipe",), pp, micro_act,
+              2 * (microbatches + pp - 2), False)
+    return terms
+
+
+def score_candidate(
+    d: ModelDims,
+    c: Dict[str, Any],
+    global_batch: int,
+    model: CommModel,
+    effective_flops: float,
+    fpt: float,
+    seq_len: Optional[int] = None,
+    microbatches: int = 8,
+) -> Dict[str, Any]:
+    """Modeled step time = compute term (HLO/formula FLOPs over the
+    sustained per-device FLOP/s, inflated by the 1F1B bubble for pp
+    plans) + the serialized comm terms.  Returned dict is the ranked-row
+    payload (per-term breakdown included)."""
+    S = seq_len if seq_len is not None else d.seq
+    n_chips = c["dp"] * c["tp"] * c["pp"]
+    flops_step = fpt * global_batch * S
+    bubble = (c["pp"] - 1) / microbatches if c["pp"] > 1 else 0.0
+    compute_s = flops_step / n_chips / effective_flops * (1.0 + bubble)
+    terms = comm_terms(d, c, global_batch, model, seq_len=S,
+                       microbatches=microbatches)
+    comm_s = sum(t["total_s"] for t in terms)
+    return {
+        "compute_s": compute_s,
+        "comm_s": comm_s,
+        "step_s": compute_s + comm_s,
+        "bubble_fraction": round(bubble, 4),
+        "terms": terms,
+    }
+
+
+# --------------------------------------------------------------- the planner
+
+
+def plan(
+    config: Any,
+    n_chips: int,
+    global_batch: int,
+    seq_len: Optional[int] = None,
+    comm_model: Optional[CommModel] = None,
+    capacity_bytes: Optional[int] = None,
+    effective_flops: Optional[float] = None,
+    fpt: Optional[float] = None,
+    optimizer_slots: int = 2,
+    act_factor: float = 1.0,
+    microbatches: int = 8,
+    allow_pp: bool = True,
+    executable_only: bool = False,
+    compression: bool = True,
+    layouts: Sequence[str] = ("dp", "fsdp"),
+    memory: str = "auto",
+    device_kind: Optional[str] = None,
+    top: int = 8,
+    emit: bool = True,
+) -> Dict[str, Any]:
+    """Plan the parallelism for ``config`` on ``n_chips`` chips.
+
+    Returns the RUNREPORT-shaped ``autoplan`` section: ``chosen`` (the
+    executable winner: mesh axes, layout, compress policy, rendered
+    per-leaf specs, score + memory breakdowns), ``ranked`` (top
+    alternatives), ``pruned`` + ``n_pruned_oom`` (the OOM evidence), the
+    scoring ``basis``, and ``verdict`` (``ok`` | ``all_oom``).
+
+    - ``comm_model``: a calibrated :class:`CommModel` grounds the comm
+      terms (and the int8 arms) in measurement; default = the
+      per-generation table model for ``device_kind``.
+    - ``effective_flops``: sustained per-device FLOP/s.  Feed the value a
+      measured step implies (``bench.py --autoplan`` does: HLO FLOPs /
+      measured step time) to close the loop; default = 40% of the chip's
+      table peak when recognized, else :data:`ASSUMED_FLOPS`.
+    - ``fpt``: FLOPs/token for the compute term — pass the compiled
+      step's ``cost_analysis`` count when one exists; default = the
+      6N+12LSD formula.
+    - ``memory``: ``'model'`` (``MemoryModel.estimate`` over the real
+      spec tree — needs jax importable), ``'analytic'`` (the pure-python
+      mirror, for the jax-free CLI), ``'auto'`` = model when config is a
+      real config object and jax imports, else analytic.
+    - ``emit``: a ``plan_rejected_oom`` event per pruned candidate and one
+      ``plan_selected`` event for the winner land on the default event
+      timeline.
+    """
+    d = model_dims(config)
+    if global_batch < 1:
+        raise ValueError(f"global_batch must be >= 1, got {global_batch}")
+    if memory not in ("auto", "model", "analytic"):
+        raise ValueError(f"memory must be auto|model|analytic, got {memory!r}")
+    use_model = memory == "model"
+    if memory == "auto":
+        use_model = not isinstance(config, dict) and _jax_importable()
+    model = comm_model or CommModel.from_defaults(
+        device_kind=device_kind or "unknown")
+    fpt_val = float(fpt) if fpt else flops_per_token(d)
+    eff, compute_basis = _resolve_effective_flops(
+        effective_flops, device_kind)
+
+    cands = enumerate_candidates(
+        d, n_chips, global_batch, allow_pp=allow_pp,
+        executable_only=executable_only, compression=compression,
+        layouts=layouts)
+    ranked: List[Dict[str, Any]] = []
+    pruned: List[Dict[str, Any]] = []
+    for c in cands:
+        if use_model:
+            mem = estimate_memory_model(
+                config, c, global_batch, seq_len=seq_len,
+                capacity_bytes=capacity_bytes,
+                optimizer_slots=optimizer_slots, act_factor=act_factor)
+        else:
+            mem = estimate_memory_analytic(
+                d, c, global_batch, seq_len=seq_len,
+                capacity_bytes=capacity_bytes,
+                optimizer_slots=optimizer_slots, act_factor=act_factor)
+        if mem["verdict"] == "oom_risk":
+            row = {"key": c["key"], "total_bytes": mem["total_bytes"],
+                   "capacity_bytes": mem["capacity_bytes"],
+                   "frac": mem["frac"]}
+            pruned.append(row)
+            if emit:
+                from ..obs.events import emit_event
+
+                emit_event("plan_rejected_oom", **row)
+            continue
+        score = score_candidate(
+            d, c, global_batch, model, eff, fpt_val,
+            seq_len=seq_len, microbatches=microbatches)
+        ranked.append({**c, **score, "memory": mem})
+    ranked.sort(key=lambda r: (r["step_s"], r["key"]))
+
+    chosen = None
+    if ranked:
+        chosen = dict(ranked[0])
+        chosen["param_specs"] = spec_table(d, chosen)[:64]
+        if emit:
+            from ..obs.events import emit_event
+
+            emit_event(
+                "plan_selected", key=chosen["key"],
+                modeled_step_s=chosen["step_s"],
+                n_candidates=len(cands), n_pruned_oom=len(pruned))
+    return {
+        "schema": AUTOPLAN_SCHEMA,
+        "verdict": "ok" if chosen else "all_oom",
+        "n_candidates": len(cands),
+        "n_pruned_oom": len(pruned),
+        "pruned": pruned[:16],
+        "chosen": chosen,
+        "ranked": [
+            {k: v for k, v in r.items() if k != "terms"}
+            if i else r  # full per-term breakdown on the winner only
+            for i, r in enumerate(ranked[:top])
+        ],
+        "params": {
+            "n_chips": n_chips, "global_batch": global_batch,
+            "seq_len": seq_len if seq_len is not None else d.seq,
+            "family": d.family, "microbatches": microbatches,
+        },
+        "basis": {
+            "comm": model.source,
+            "compute": compute_basis,
+            "memory": ("memory-model" if use_model else "analytic"),
+            "flops_per_token": fpt_val,
+            "effective_flops": eff,
+        },
+    }
+
+
+def _jax_importable() -> bool:
+    try:
+        import jax  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def _resolve_effective_flops(
+    effective_flops: Optional[float], device_kind: Optional[str]
+) -> Tuple[float, str]:
+    if effective_flops:
+        return float(effective_flops), "measured"
+    if device_kind:
+        from ..obs.telemetry import peak_flops_for
+
+        peak = peak_flops_for(device_kind)
+        if peak:
+            # sustained ~= 40% of peak: the repo's own measured MFU band
+            return 0.4 * peak, "peak-table@0.4"
+    return ASSUMED_FLOPS, "assumed"
+
+
+def attach_measured(
+    result: Dict[str, Any], rows: Sequence[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Record measured step times for (some of) the ranked plans into the
+    section's ``modeled_vs_measured`` — the audit record the acceptance
+    reads: per-plan modeled vs measured with rel err, and whether the
+    measured ordering agrees with the modeled one.  ``rows``: dicts with
+    ``key``, ``modeled_step_s``, ``measured_step_s``."""
+    out_rows = []
+    for r in rows:
+        mo, me = float(r["modeled_step_s"]), float(r["measured_step_s"])
+        out_rows.append({
+            "key": r["key"], "modeled_step_s": mo, "measured_step_s": me,
+            "rel_err": round((mo - me) / me, 4) if me > 0 else None,
+        })
+    modeled_order = [r["key"] for r in sorted(
+        out_rows, key=lambda r: r["modeled_step_s"])]
+    measured_order = [r["key"] for r in sorted(
+        out_rows, key=lambda r: r["measured_step_s"])]
+    result["modeled_vs_measured"] = {
+        "rows": out_rows,
+        "modeled_order": modeled_order,
+        "measured_order": measured_order,
+        "ordering_agrees": modeled_order == measured_order,
+    }
+    return result
+
+
+# ---------------------------------------------------------- executable side
+
+
+def build_mesh(c: Dict[str, Any], devices: Optional[Sequence[Any]] = None):
+    """A real ``jax.sharding.Mesh`` for a candidate/chosen plan: the
+    plan's axis sizes over the attached (or given) devices, ICI-aware via
+    ``mesh_utils`` when more than one axis is non-trivial."""
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    sizes = c["mesh_axes"]
+    names = tuple(sizes)
+    shape = tuple(sizes[a] for a in names)
+    devs = list(devices) if devices is not None else jax.devices()
+    n = int(np.prod(shape))
+    if len(devs) != n:
+        raise ValueError(
+            f"plan wants {n} chips ({dict(sizes)}), have {len(devs)}")
+    try:
+        arr = mesh_utils.create_device_mesh(shape, devices=devs)
+    except Exception:
+        arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, axis_names=names)
+
+
+def plan_param_specs(c: Dict[str, Any], config: Any):
+    """The candidate's REAL per-leaf PartitionSpec tree (jax side): the
+    family's TP/PP specs composed with the ZeRO first-free-divisible-dim
+    data-axis insertion for the fsdp layout.  ``tests/test_autoplan.py``
+    pins this against the analytic :func:`spec_table`."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..obs.mem_ledger import _shapes_for_config
+    from ..parallel.zero import zero_partition_spec
+
+    d = model_dims(config)
+    tp_axis = "tensor" if c["tp"] > 1 else None
+    pipe_axis = "pipe" if c["pp"] > 1 else None
+    shapes = _shapes_for_config(config)
+    if d.family == "gpt":
+        from ..models.gpt import gpt_param_specs
+
+        base = gpt_param_specs(config, tp_axis=tp_axis, pipe_axis=pipe_axis)
+    else:
+        if tp_axis:
+            from ..parallel.tensor_parallel import transformer_param_specs
+
+            base = transformer_param_specs(config, axis=tp_axis)
+        else:
+            base = jax.tree.map(lambda _: P(), shapes)
+    if c["layout"] != "fsdp" or c["dp"] <= 1:
+        return base
+    flat_p, treedef = jax.tree_util.tree_flatten(shapes)
+    flat_s = treedef.flatten_up_to(base)
+    out = [
+        zero_partition_spec(tuple(p.shape), s, "data", c["dp"])[0]
+        for p, s in zip(flat_p, flat_s)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def batch_partition_spec(c: Dict[str, Any]):
+    """Batch leaves shard their leading dim over the data axis."""
+    from jax.sharding import PartitionSpec as P
+
+    return P("data") if c["dp"] > 1 else P()

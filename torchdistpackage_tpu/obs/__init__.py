@@ -82,6 +82,8 @@ from .aggregate import (
     step_time_stats,
 )
 from .report import (
+    AUTOPLAN_SCHEMA,
+    PLAN_VERDICTS,
     RESILIENCE_VERDICTS,
     RUNREPORT_SCHEMA,
     SERVING_VERDICTS,

@@ -164,6 +164,18 @@ PR 11 — request-lifecycle tracing + tick accounting; docs/serving.md
                             only for ticks that did work)
 ==========================  =============================================
 
+Auto-sharding planner kinds (``dist/autoplan.py``, PR 13):
+
+==========================  =============================================
+``plan_selected``           the planner chose a plan: record carries the
+                            plan key, its modeled step time, and the
+                            candidate/pruned counts (the RUNREPORT
+                            ``autoplan`` section is the full audit)
+``plan_rejected_oom``       a candidate's modeled per-device resident
+                            bytes (``MemoryModel.estimate``) crossed the
+                            OOM-risk line — pruned BEFORE any compile
+==========================  =============================================
+
 A module-level default log lets deep call sites (signal handlers, debug
 callbacks) emit without plumbing a handle through every layer:
 ``emit_event("preemption", signum=15)``.
@@ -210,6 +222,8 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     "numerics_alert", "nan_block_located",
     # quantized collectives (PR 8)
     "compress_policy",
+    # auto-sharding planner (PR 13)
+    "plan_selected", "plan_rejected_oom",
 })
 
 

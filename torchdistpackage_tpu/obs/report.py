@@ -24,6 +24,13 @@ RESILIENCE_VERDICTS = ("clean", "recovered", "preempted", "aborted")
 # the engine preempted or healed faults to keep serving, healthy = neither
 SERVING_VERDICTS = ("healthy", "degraded", "overloaded")
 
+# the auto-sharding planner's end states (dist/autoplan.py imports these —
+# obs is a leaf subsystem, so the schema vocabulary lives here): ``ok`` = a
+# plan was chosen, ``all_oom`` = every candidate was pruned by the memory
+# budget (a clean no-plan verdict, not a crash)
+AUTOPLAN_SCHEMA = "tdp-autoplan/v1"
+PLAN_VERDICTS = ("ok", "all_oom")
+
 # the memory section's headroom verdicts (obs/mem_ledger.py owns the
 # thresholds; re-exported here next to the other verdict vocabularies)
 from .mem_ledger import MEM_VERDICTS  # noqa: E402
@@ -108,6 +115,72 @@ def validate_runreport(report: Any) -> List[str]:
             errs.append("resilience.rollbacks missing/negative")
     errs.extend(_validate_serving(report.get("serving")))
     errs.extend(_validate_compression(report.get("compression")))
+    errs.extend(_validate_autoplan(report.get("autoplan")))
+    return errs
+
+
+def _validate_autoplan(ap: Any) -> List[str]:
+    """The optional ``autoplan`` section (dist/autoplan.py ``plan``): the
+    candidate/pruned counts, the chosen plan (None only on the all-OOM
+    verdict), ranked alternatives, and the optional modeled-vs-measured
+    audit record."""
+    if ap is None:
+        return []
+    if not isinstance(ap, dict):
+        return [f"autoplan is {type(ap).__name__}, expected dict"]
+    errs: List[str] = []
+    if ap.get("schema") != AUTOPLAN_SCHEMA:
+        errs.append(f"autoplan.schema {ap.get('schema')!r} invalid")
+    if ap.get("verdict") not in PLAN_VERDICTS:
+        errs.append(f"autoplan.verdict {ap.get('verdict')!r} invalid")
+    nc, npr = ap.get("n_candidates"), ap.get("n_pruned_oom")
+    if not isinstance(nc, int) or nc < 0:
+        errs.append("autoplan.n_candidates missing/negative")
+    if not isinstance(npr, int) or npr < 0 or (
+            isinstance(nc, int) and npr > nc):
+        errs.append("autoplan.n_pruned_oom missing/out of range")
+    chosen = ap.get("chosen")
+    if ap.get("verdict") == "all_oom":
+        if chosen is not None:
+            errs.append("autoplan.chosen set despite all_oom verdict")
+        if isinstance(nc, int) and isinstance(npr, int) and npr != nc:
+            errs.append("autoplan all_oom but n_pruned_oom != n_candidates")
+    elif not isinstance(chosen, dict):
+        errs.append("autoplan.chosen missing/non-dict")
+    else:
+        for k in ("key", "step_s", "compute_s", "comm_s"):
+            if k == "key":
+                if not isinstance(chosen.get(k), str) or not chosen[k]:
+                    errs.append("autoplan.chosen.key missing")
+            elif not isinstance(chosen.get(k), (int, float)) or chosen[k] < 0:
+                errs.append(f"autoplan.chosen.{k} missing/negative")
+        if not isinstance(chosen.get("mesh_axes"), dict):
+            errs.append("autoplan.chosen.mesh_axes missing")
+        if not isinstance(chosen.get("terms"), list):
+            errs.append("autoplan.chosen.terms missing (per-term breakdown)")
+    ranked = ap.get("ranked")
+    if not isinstance(ranked, list):
+        errs.append("autoplan.ranked missing/non-list")
+        ranked = []
+    for i, r in enumerate(ranked):
+        if not isinstance(r, dict) or not r.get("key") or not isinstance(
+                r.get("step_s"), (int, float)):
+            errs.append(f"autoplan.ranked[{i}] lacks key/step_s")
+            break
+    mvm = ap.get("modeled_vs_measured")
+    if mvm is not None:
+        if not isinstance(mvm, dict) or not isinstance(
+                mvm.get("rows"), list) or not mvm["rows"]:
+            errs.append("autoplan.modeled_vs_measured lacks rows")
+        elif not isinstance(mvm.get("ordering_agrees"), bool):
+            errs.append("autoplan.modeled_vs_measured lacks ordering_agrees")
+        else:
+            for i, r in enumerate(mvm["rows"]):
+                if not all(isinstance(r.get(k), (int, float)) and r[k] > 0
+                           for k in ("modeled_step_s", "measured_step_s")):
+                    errs.append(
+                        f"autoplan.modeled_vs_measured.rows[{i}] invalid")
+                    break
     return errs
 
 
@@ -436,6 +509,18 @@ def render_summary_line(report: Dict[str, Any]) -> str:
         parts.append(
             f"compress={cmpx.get('mode', '?')}"
             f"({pol.get('n_compressed', 0)}/{pol.get('n_leaves', 0)} leaves)")
+    ap = report.get("autoplan")
+    if ap:
+        if ap.get("verdict") == "all_oom":
+            parts.append(f"AUTOPLAN=all_oom({ap.get('n_pruned_oom', 0)} pruned)")
+        elif ap.get("chosen"):
+            tail = ""
+            mvm = ap.get("modeled_vs_measured")
+            if mvm and mvm.get("rows"):
+                r0 = mvm["rows"][0]
+                if isinstance(r0.get("rel_err"), (int, float)):
+                    tail = f"(model {r0['rel_err']:+.0%} vs measured)"
+            parts.append(f"plan={ap['chosen']['key']}{tail}")
     srv = report.get("serving")
     if srv and isinstance(srv.get("tokens_per_sec"), (int, float)):
         tail = ""
@@ -688,6 +773,74 @@ def render_markdown(report: Dict[str, Any]) -> str:
                     f"| {r['axes']} | "
                     + (f"{pred:,} | " if isinstance(pred, int) else "- | ")
                     + (f"{meas:,} |" if isinstance(meas, int) else "- |"))
+        L.append("")
+
+    ap = report.get("autoplan")
+    if ap:
+        L.append("## Auto-sharding plan")
+        L.append("")
+        L.append(
+            f"- {ap.get('n_candidates', 0)} candidate(s) enumerated, "
+            f"**{ap.get('n_pruned_oom', 0)} pruned over-budget** before any "
+            f"compile (`plan_rejected_oom` events carry each)")
+        basis = ap.get("basis") or {}
+        if basis:
+            L.append(
+                f"- scoring basis: comm `{basis.get('comm', '?')}`, compute "
+                f"`{basis.get('compute', '?')}`, memory "
+                f"`{basis.get('memory', '?')}`")
+        chosen = ap.get("chosen")
+        if ap.get("verdict") == "all_oom":
+            L.append("- **no plan fits the memory budget** (verdict "
+                     "`all_oom`) — every candidate pruned")
+        elif chosen:
+            mem = chosen.get("memory") or {}
+            L.append(
+                f"- chosen: **`{chosen['key']}`** — modeled step "
+                f"{chosen['step_s'] * 1e3:.3f} ms (compute "
+                f"{chosen['compute_s'] * 1e3:.3f} + comm "
+                f"{chosen['comm_s'] * 1e3:.3f}), modeled resident "
+                f"{mem.get('total_bytes', 0) / 1e6:.1f} MB/device")
+            terms = chosen.get("terms") or []
+            if terms:
+                L.append("")
+                L.append("| term | op | axes | payload | x | modeled |")
+                L.append("|---|---|---|---|---|---|")
+                for t in terms:
+                    tag = " (int8)" if t.get("compressed") else ""
+                    L.append(
+                        f"| {t['name']}{tag} | {t['op']} | "
+                        f"{'+'.join(t['axes'])} | {t['payload_bytes']:,} B "
+                        f"| {t['count']} | {t['total_s'] * 1e3:.3f} ms |")
+        ranked = ap.get("ranked") or []
+        if len(ranked) > 1:
+            L.append("")
+            L.append("| rank | plan | modeled step | comm | resident | "
+                     "verdict |")
+            L.append("|---|---|---|---|---|---|")
+            for i, r in enumerate(ranked):
+                mem = r.get("memory") or {}
+                L.append(
+                    f"| {i + 1} | `{r['key']}` | {r['step_s'] * 1e3:.3f} ms "
+                    f"| {r['comm_s'] * 1e3:.3f} ms "
+                    f"| {mem.get('total_bytes', 0) / 1e6:.1f} MB "
+                    f"| {mem.get('verdict', '?')} |")
+        mvm = ap.get("modeled_vs_measured")
+        if mvm and mvm.get("rows"):
+            agree = mvm.get("ordering_agrees")
+            L.append("")
+            L.append(
+                "- modeled vs measured: ordering "
+                + ("**agrees**" if agree else "**DISAGREES** (per-term "
+                   "breakdowns above are the audit trail)"))
+            for r in mvm["rows"]:
+                re_ = r.get("rel_err")
+                L.append(
+                    f"  - `{r['key']}`: modeled "
+                    f"{r['modeled_step_s'] * 1e3:.3f} ms vs measured "
+                    f"{r['measured_step_s'] * 1e3:.3f} ms"
+                    + (f" ({re_:+.1%})" if isinstance(re_, (int, float))
+                       else ""))
         L.append("")
 
     res = report.get("resilience")

@@ -261,6 +261,7 @@ class Telemetry:
         self.counters: Dict[str, Any] = {}
         self.resilience: Optional[Dict[str, Any]] = None
         self.serving: Optional[Dict[str, Any]] = None
+        self.autoplan: Optional[Dict[str, Any]] = None
         self.history: List[Dict[str, Any]] = []
         self._history_max = history_max
 
@@ -567,6 +568,14 @@ class Telemetry:
         validated by ``validate_runreport``)."""
         self.compression = dict(section)
 
+    def record_autoplan(self, section: Dict[str, Any]) -> None:
+        """Attach a ``dist.autoplan.plan`` result as the report's optional
+        ``autoplan`` section (candidates considered, pruned-OOM count,
+        chosen plan with per-term score breakdowns, and — when the caller
+        ran plans through measured steps — the ``modeled_vs_measured``
+        audit record; validated by ``validate_runreport``)."""
+        self.autoplan = dict(section)
+
     def record_serving(self, summary: Dict[str, Any]) -> None:
         """Attach a ``ServingEngine.serving_summary()`` as the report's
         optional ``serving`` section (TTFT/TPOT percentiles, aggregate
@@ -725,6 +734,8 @@ class Telemetry:
             report["serving"] = self.serving
         if self.compression is not None:
             report["compression"] = self.compression
+        if self.autoplan is not None:
+            report["autoplan"] = self.autoplan
         if extra:
             report.update(extra)
         if self._is_master:

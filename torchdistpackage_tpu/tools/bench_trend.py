@@ -54,10 +54,16 @@ from typing import Any, Dict, List, Tuple
 #: which can hold while every deadline is missed — goodput (tokens/s of
 #: deadline-meeting requests only) and attainment are the columns that
 #: catch a scheduler trading SLOs for throughput.
+#: ``autoplan_tok_s`` / ``plan_modeled_step_s`` (PR 13) ride the
+#: ``bench.py --autoplan`` planned arm's line: the planner-chosen plan's
+#: measured tokens/s next to its modeled step time — a throughput hold
+#: with a drifting model (the planner steering on stale numbers) is
+#: visible here before it mis-ranks a real decision.
 AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
             "grad_norm_final", "comm_bytes_per_dim", "shed_rate",
             "preempt_count", "prefix_hit_rate", "spec_accept_rate",
-            "slo_attainment", "goodput_tok_s", "paged_pallas_tok_s")
+            "slo_attainment", "goodput_tok_s", "paged_pallas_tok_s",
+            "autoplan_tok_s", "plan_modeled_step_s")
 
 
 def _aux_str(key: str, val: Any) -> str:
