@@ -527,20 +527,92 @@ def _run_config(jax, jnp, cfg, batch_size, steps, warmup, remat, xent_chunk=None
             flops_per_token, xla_flops_per_token, ledger, mem)
 
 
+def _run_pp_plan_config(jax, jnp, cfg, chosen, batch_size, steps, warmup,
+                        remat, microbatches=8, schedule="1f1b"):
+    """Time a pp>1 plan (tokens/sec/chip, mean step seconds) through the
+    PIPELINE runner: the plan's mesh + PartitionSpecs drive
+    ``gpt_pipeline_1f1b`` (or ``gpt_pipeline_zb`` for ``schedule='zb'``)
+    inside a ``DataParallel`` train step — the schedule the planner's pp
+    compute term models is the schedule that runs, so pp plans are now
+    *measured*, not just scored (the ROADMAP item-1 follow-up).  The
+    batch rides ``[M, global_batch/M, S]`` with dim 1 sharded over
+    ``data``; ``xent_chunk`` does not apply (the pipelined last stage
+    streams per-microbatch already)."""
+    import optax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from torchdistpackage_tpu.dist import autoplan as _autoplan
+    from torchdistpackage_tpu.models import (
+        gpt_pipeline_1f1b, gpt_pipeline_zb, init_gpt_params)
+    from torchdistpackage_tpu.parallel.data_parallel import DataParallel
+
+    M = microbatches
+    n_chips = max(1, jax.device_count())
+    global_batch = batch_size * n_chips
+    if global_batch % M or (global_batch // M) % chosen["dp"]:
+        raise ValueError(
+            f"pp runner needs microbatches ({M}) | global batch "
+            f"({global_batch}) and dp ({chosen['dp']}) | per-microbatch "
+            f"rows ({global_batch // M})")
+    mesh = _autoplan.build_mesh(chosen)
+    specs = _autoplan.plan_param_specs(chosen, cfg)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    tp_axis = "tensor" if chosen["tp"] > 1 else None
+    sched_fn = gpt_pipeline_zb if schedule == "zb" else gpt_pipeline_1f1b
+
+    def vg_fn(p, b):
+        return sched_fn(p, b, cfg, num_microbatches=M, tp_axis=tp_axis,
+                        sp=tp_axis is not None, remat=remat)
+
+    opt = optax.adamw(3e-4)
+    dp = DataParallel(mesh=mesh)
+    sharded = dp.broadcast_params(params, param_specs=specs)
+    state = opt.init(sharded)
+    step = dp.make_train_step(
+        value_and_grad_fn=vg_fn, optimizer=opt, param_specs=specs,
+        batch_spec={"tokens": P(None, "data"), "targets": P(None, "data")})
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    shape = (M, global_batch // M, cfg.max_seq)
+    batch = jax.device_put({
+        "tokens": jax.random.randint(k1, shape, 0, cfg.vocab_size),
+        "targets": jax.random.randint(k2, shape, 0, cfg.vocab_size),
+    }, NamedSharding(mesh, P(None, "data")))
+
+    for _ in range(warmup):
+        sharded, state, loss = step(sharded, state, batch)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        sharded, state, loss = step(sharded, state, batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return global_batch * cfg.max_seq * steps / dt / n_chips, dt / steps
+
+
 def _run_plan_config(jax, jnp, cfg, chosen, batch_size, steps, warmup, remat,
-                     xent_chunk=None):
+                     xent_chunk=None, microbatches=8):
     """Time the planner-chosen plan (tokens/sec/chip) through the same
-    model/batch/steps as :func:`_run_config`.  Two runners cover every
+    model/batch/steps as :func:`_run_config`.  Three runners cover every
     executable plan (``dist.autoplan.enumerate_candidates(
     executable_only=True)``):
 
     - pure dp with grad compression -> ``DataParallel(grad_compress=
       'int8')`` (the int8 ring only exists on the shard_map path);
+    - ``pp > 1`` -> the pipeline runner (:func:`_run_pp_plan_config`)
+      driving the schedule the plan's ``pp_schedule`` names;
     - everything else (dp / fsdp / tp mixes) -> a GSPMD jit step over the
       plan's mesh with the plan's param PartitionSpecs — XLA derives the
       collectives the specs imply, which is exactly the layout the
       planner scored."""
     import optax
+
+    if chosen["pp"] > 1:
+        return _run_pp_plan_config(
+            jax, jnp, cfg, chosen, batch_size, steps, warmup, remat,
+            microbatches=microbatches,
+            schedule=chosen.get("pp_schedule") or "1f1b")
 
     from torchdistpackage_tpu.dist import autoplan as _autoplan
     from torchdistpackage_tpu.models import gpt_loss, init_gpt_params
@@ -604,10 +676,22 @@ def _run_autoplan(jax, jnp, cfg, batch_size, steps, warmup, remat,
     loop (the measured step calibrates the compute term; a comm_bench
     calibration grounds the comm terms incl. the int8 arms), plan, run
     the chosen plan, and emit the paired ``ap-{default,planned}`` rows at
-    equal ``config_hash``."""
+    equal ``config_hash``.
+
+    Pipeline plans are executable now (the ``_run_pp_plan_config``
+    runner): when the chosen plan has pp>1 it is timed under the schedule
+    the planner picked, and in EITHER case the best-ranked pp>1 plan is
+    additionally timed under BOTH schedules (classic 1F1B and zero-
+    bubble) so ``attach_measured`` carries the bubble audit — modeled
+    slot-accounting bubble fractions next to a measured one
+    (``measured_bubble_fraction`` for the zb arm = ``1 - t_ideal/t_zb``
+    with ``t_ideal`` the no-bubble extrapolation ``t_1f1b * (1 -
+    bf_1f1b)`` from the measured 1F1B arm's own slot model)."""
     import hashlib
 
     from torchdistpackage_tpu.dist import autoplan as _autoplan
+    from torchdistpackage_tpu.obs.aggregate import (
+        pipeline_bubble_fraction, pipeline_time_inflation)
     from torchdistpackage_tpu.obs.comm_model import CommModel
 
     n_chips = max(1, jax.device_count())
@@ -642,10 +726,16 @@ def _run_autoplan(jax, jnp, cfg, batch_size, steps, warmup, remat,
         print(f"bench: comm calibration failed ({e!r}); using the table "
               f"model", file=sys.stderr)
 
+    # microbatch count for pp candidates: the largest power of two <= 8
+    # dividing the global batch (the pp runner reshapes [M, B/M, S])
+    M_plan = 8
+    while M_plan > 1 and global_batch % M_plan:
+        M_plan //= 2
+
     result = _autoplan.plan(
         cfg, n_chips, global_batch=global_batch,
         comm_model=comm_model, effective_flops=eff, fpt=fpt_basis,
-        executable_only=True, device_kind=chip)
+        executable_only=True, device_kind=chip, microbatches=M_plan)
     chosen = result["chosen"]
     if chosen is None:
         # every executable candidate over the HBM budget: report the
@@ -671,11 +761,60 @@ def _run_autoplan(jax, jnp, cfg, batch_size, steps, warmup, remat,
 
     tps_plan, step_plan = _run_plan_config(
         jax, jnp, cfg, chosen, batch_size, steps, warmup, remat,
-        xent_chunk=xent_chunk)
-    _autoplan.attach_measured(result, [{
+        xent_chunk=xent_chunk, microbatches=M_plan)
+    rows = [{
         "key": chosen["key"], "modeled_step_s": chosen["step_s"],
         "measured_step_s": step_plan,
-    }])
+    }]
+    if chosen["pp"] > 1:
+        rows[0]["pp_schedule"] = chosen["pp_schedule"]
+        rows[0]["modeled_bubble_fraction"] = chosen["bubble_fraction"]
+        rows[0]["microbatches"] = M_plan
+
+    # the bubble audit: time the best-ranked pp>1 plan under BOTH
+    # schedules (one measurement is reused when the chosen plan IS that
+    # pp plan) so the modeled 1F1B-vs-ZB tick accounting meets wall clock
+    pp_row = chosen if chosen["pp"] > 1 else next(
+        (r for r in result["ranked"] if r["pp"] > 1), None)
+    pp_audit = None
+    if pp_row is not None:
+        try:
+            infl = {s: pipeline_time_inflation(M_plan, pp_row["pp"], s)
+                    for s in ("1f1b", "zb")}
+            bf = {s: pipeline_bubble_fraction(
+                M_plan, pp_row["pp"], schedule=s) for s in ("1f1b", "zb")}
+            meas = {}
+            for sched in ("1f1b", "zb"):
+                if pp_row is chosen and sched == chosen["pp_schedule"]:
+                    meas[sched] = step_plan
+                else:
+                    _, meas[sched] = _run_pp_plan_config(
+                        jax, jnp, cfg, pp_row, batch_size, steps, warmup,
+                        remat, microbatches=M_plan, schedule=sched)
+            t_ideal = meas["1f1b"] * (1.0 - bf["1f1b"])
+            for sched in ("1f1b", "zb"):
+                rows.append({
+                    "key": f"{pp_row['key']}·{sched}",
+                    "modeled_step_s": (
+                        pp_row["compute_s"] / infl[pp_row["pp_schedule"]]
+                        * infl[sched] + pp_row["comm_s"]),
+                    "measured_step_s": meas[sched],
+                    "pp_schedule": sched,
+                    "modeled_bubble_fraction": round(bf[sched], 4),
+                    "measured_bubble_fraction": round(
+                        max(0.0, 1.0 - t_ideal / meas[sched]), 4),
+                    "microbatches": M_plan,
+                })
+            pp_audit = {
+                "key": pp_row["key"], "microbatches": M_plan,
+                "zb_vs_1f1b_measured": round(meas["zb"] / meas["1f1b"], 4),
+                "zb_vs_1f1b_modeled": round(infl["zb"] / infl["1f1b"], 4),
+                "bubble_fraction_zb": round(bf["zb"], 4),
+                "bubble_fraction_1f1b": round(bf["1f1b"], 4),
+            }
+        except ValueError as e:
+            print(f"bench: pp bubble audit skipped ({e})", file=sys.stderr)
+    _autoplan.attach_measured(result, rows)
 
     metric = f"gpt-{size_tag}-train-throughput"
     base_config_str = (
@@ -713,6 +852,17 @@ def _run_autoplan(jax, jnp, cfg, batch_size, steps, warmup, remat,
             line["plan_pruned_oom"] = result["n_pruned_oom"]
             line["plan_comm_basis"] = result["basis"]["comm"]
             line["vs_default"] = round(tps / tps_def, 4)
+            if chosen["pp"] > 1:
+                line["plan_pp_schedule"] = chosen["pp_schedule"]
+                line["bubble_fraction"] = chosen["bubble_fraction"]
+                line["plan_microbatches"] = M_plan
+            if pp_audit is not None:
+                # the 1F1B-vs-ZB pair timed through the pipeline runner:
+                # modeled vs measured schedule ratio + both tick-model
+                # bubble fractions (bench_trend trends bubble_fraction)
+                line["pp_audit"] = pp_audit
+                line.setdefault(
+                    "bubble_fraction", pp_audit["bubble_fraction_zb"])
         print(json.dumps(line))
 
 

@@ -110,6 +110,60 @@ def test_parse_hlo_async_start_counted_once():
     assert recs[0]["bytes"] == 32
 
 
+def test_parse_hlo_overlap_window_records_collectives_inside():
+    """TP-under-PP overlap evidence (PR 14): collectives issued between an
+    async op's -start and -done land in its ``overlapped_idx``, and
+    ``tp_pp_overlap`` classifies them per dimension — here a tensor-axis
+    all-gather + reduce-scatter pair inside a pipeline collective-permute
+    window, the synergy-schedule ordering zero_bubble.py arranges."""
+    from torchdistpackage_tpu.obs.comm_ledger import tp_pp_overlap
+
+    hlo = "\n".join([
+        "%cp-start = f32[8]{0} collective-permute-start(f32[8]{0} %x), "
+        "channel_id=1, source_target_pairs={{0,2},{2,0},{1,3},{3,1}}",
+        "%ag = f32[16]{0} all-gather(f32[8]{0} %a), channel_id=2, "
+        "replica_groups={{0,1},{2,3}}, dimensions={0}",
+        "%rs = f32[8]{0} reduce-scatter(f32[16]{0} %b), channel_id=3, "
+        "replica_groups={{0,1},{2,3}}, dimensions={0}, to_apply=%add",
+        "%cp-done = f32[8]{0} collective-permute-done(f32[8]{0} %cp-start)",
+        "%ag2 = f32[16]{0} all-gather(f32[8]{0} %c), channel_id=4, "
+        "replica_groups={{0,1},{2,3}}, dimensions={0}",
+    ])
+    recs = parse_hlo_collectives(hlo)
+    assert len(recs) == 4
+    cp = recs[0]
+    assert cp["async"] is True
+    # the window holds exactly the two collectives before -done; the
+    # post-done all-gather is outside it
+    assert cp["overlapped_idx"] == [1, 2]
+    assert cp["sched_distance"] == 2
+    assert recs[1]["overlapped_idx"] is None  # sync ops carry no window
+
+    # classified through a 2x2 pipe x tensor mesh, the summary reports
+    # the tp pair (all payload bytes) inside the pp permute's slack
+    import numpy as np
+
+    class _M:
+        devices = np.arange(4).reshape(2, 2)
+        axis_names = ("pipe", "tensor")
+        shape = {"pipe": 2, "tensor": 2}
+
+    class _D:
+        def __init__(self, i):
+            self.id = i
+
+    _M.devices = np.array([[_D(0), _D(1)], [_D(2), _D(3)]], dtype=object)
+    ledger = ledger_from_hlo(hlo, mesh=_M())
+    rep = tp_pp_overlap(ledger)
+    assert rep["pp_async_ops"] == 1
+    assert rep["pp_windows_with_tp"] == 1
+    assert rep["tp_ops_in_pp_windows"] == 2
+    assert rep["tp_bytes_in_pp_windows"] == (16 * 4) + (16 * 4)
+    assert rep["mean_pp_sched_distance"] == 2
+    # an all-sync ledger (the CPU sim's shape) reports cleanly as zero
+    assert tp_pp_overlap(None)["pp_async_ops"] == 0
+
+
 def test_expand_replica_groups_iota():
     assert _expand_replica_groups("{{0,1},{2,3}}") == [[0, 1], [2, 3]]
     assert _expand_replica_groups("[2,4]<=[8]") == [

@@ -45,6 +45,11 @@ OBS_EXAMPLES = {
     "train_pipeline.py": {"counter": "pipeline", "field": "bubble_fraction"},
     "train_interleaved_pipeline.py": {
         "counter": "pipeline", "field": "bubble_fraction"},
+    # zero-bubble A/B (PR 14): the report's pipeline section must carry
+    # the validated zb-vs-1f1b bubble pair (validate_runreport enforces
+    # zb strictly below the 1f1b reference) and the schedule-build events
+    "train_zb_pipeline.py": {
+        "counter": "pipeline", "field": "bubble_fraction", "zb": True},
     "train_moe.py": {"counter": "moe", "field": "imbalance", "comm": "moe"},
     # overlap-audited examples (PR 3): GSPMD FSDP's param all-gathers and
     # the ZeRO owner-scatter both ledger onto the data axis.  ``memory``
@@ -122,6 +127,17 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
             assert val < 1.0
         if probe["counter"] == "moe":
             assert sum(counters["moe"]["expert_tokens"]) > 0
+
+    if probe.get("zb"):
+        # the zero-bubble A/B's evidence: schedule named, the zb bubble
+        # strictly below the paired 1f1b reference, timed arms recorded,
+        # and the schedule-build events on the timeline
+        pipe = report["counters"]["pipeline"]
+        assert pipe["schedule"] == "zb", pipe
+        assert pipe["bubble_fraction"] < pipe["bubble_fraction_1f1b"], pipe
+        assert pipe["step_time_zb_s"] > 0 and pipe["step_time_1f1b_s"] > 0
+        kinds = {e["kind"] for e in report["events"]}
+        assert {"zb_wgrad_deferred", "zb_cooldown_filled"} <= kinds, kinds
 
     if probe.get("resilience"):
         res = report.get("resilience")

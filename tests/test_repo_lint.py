@@ -339,6 +339,27 @@ def test_autoplan_event_kinds_registered_and_emitted():
         f"autoplan kinds never emitted from dist/autoplan.py: {missing}")
 
 
+def test_zb_event_kinds_registered_and_emitted():
+    """The zero-bubble schedule kinds (PR 14) are in the registry AND
+    emitted from the pipeline package — ``zb_wgrad_deferred`` is the
+    trace-time record that the backward was actually split (M wgrad work
+    items queued, not fused), ``zb_cooldown_filled`` carries the tick
+    accounting the RUNREPORT pipeline section and the bench A/B rows are
+    checked against; a kind that stopped being emitted would silently
+    blind both."""
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+
+    zb_kinds = {"zb_wgrad_deferred", "zb_cooldown_filled"}
+    assert zb_kinds <= EVENT_KINDS
+    emitted = set()
+    for path in sorted(
+            (PKG / "parallel" / "pipeline_parallel").rglob("*.py")):
+        emitted.update(k for _, k in _emit_call_kinds(path))
+    missing = zb_kinds - emitted
+    assert not missing, (
+        f"zb kinds never emitted from parallel/pipeline_parallel/: {missing}")
+
+
 def test_compress_policy_event_kind_registered_and_emitted():
     """The quantized-collectives kind (PR 8) is in the registry AND
     emitted where the auto policy lives: ``compress_policy`` fires from

@@ -341,6 +341,31 @@ def test_bench_trend_autoplan_columns():
     assert any("REGRESSION gpt-tiny-train-throughput" in w for w in warnings)
 
 
+def test_bench_trend_bubble_columns():
+    """The PR-14 pipeline columns (mirrors the ``autoplan_tok_s``
+    pattern): a pp-plan line gates on tokens/s (``value``) with
+    ``bubble_fraction`` / ``plan_pp_schedule`` rendered alongside — a
+    throughput hold whose bubble crept back up, or whose schedule arm
+    silently flipped from ``zb`` back to classic ``1f1b``, is visible in
+    the trend, and a pp-line regression still trips the gate."""
+    from torchdistpackage_tpu.tools.bench_trend import AUX_KEYS, trend
+
+    assert {"bubble_fraction", "plan_pp_schedule"} <= set(AUX_KEYS)
+    line = {"metric": "gpt-tiny-train-throughput", "value": 520.0,
+            "autoplan": "planned", "plan": "dp2·pp4",
+            "bubble_fraction": 0.5, "plan_pp_schedule": "zb",
+            "config": "c ap-planned"}
+    report, warnings = trend(
+        [(1, [line]),
+         (2, [dict(line, value=430.0, bubble_fraction=0.6,
+                   plan_pp_schedule="1f1b")])],
+        threshold=0.05)
+    assert any("bubble_fraction=0.5" in ln for ln in report)
+    assert any("plan_pp_schedule=zb" in ln for ln in report)
+    assert any("plan_pp_schedule=1f1b" in ln for ln in report)
+    assert any("REGRESSION gpt-tiny-train-throughput" in w for w in warnings)
+
+
 def test_bench_trend_comm_bytes_column():
     """The PR-8 wire-bytes column: a line carrying ``comm_bytes_per_dim``
     renders its TOTAL in the aux trail, so a compressed collective

@@ -279,15 +279,16 @@ def enumerate_candidates(
     chip counts still always yield at least pure DP (``dp = n_chips``
     divides any batch multiple of it; batch-indivisible dp values are
     skipped).  ``executable_only`` restricts to plans bench's timed
-    runners can execute: ``pp == 1`` (pipeline plans need the 1F1B
-    scheduler, which the timed step does not drive) and compression only
-    on the pure-dp arm (``DataParallel(grad_compress='int8')`` — the
-    GSPMD jit runner for tp/fsdp plans cannot express the int8 rings)."""
+    runners can execute: compression only on the pure-dp ``pp == 1`` arm
+    (``DataParallel(grad_compress='int8')`` — the GSPMD jit runner for
+    tp/fsdp plans cannot express the int8 rings), and ``pp > 1`` plans
+    restricted to the ``dp`` layout (bench's pipeline runner drives the
+    1F1B/ZB schedules through ``DataParallel``, which replicates params
+    over ``data`` — the fsdp spec insertion has no pipelined runner)."""
     out: List[Dict[str, Any]] = []
     for pp in _divisors(n_chips):
         if pp > 1 and (
-                not allow_pp or executable_only or d.family != "gpt"
-                or d.nlayers % pp):
+                not allow_pp or d.family != "gpt" or d.nlayers % pp):
             continue
         for tp in _divisors(n_chips // pp):
             if not _tp_ok(d, tp):
@@ -297,9 +298,12 @@ def enumerate_candidates(
                 continue
             arm_layouts = [
                 l for l in layouts if l == "dp" or (l == "fsdp" and dp > 1)]
+            if executable_only and pp > 1:
+                arm_layouts = [l for l in arm_layouts if l == "dp"]
             for layout in arm_layouts:
                 can_gq = compression and dp > 1 and not (
-                    executable_only and (tp > 1 or layout == "fsdp"))
+                    executable_only and (tp > 1 or pp > 1
+                                         or layout == "fsdp"))
                 grad_arms = (False, True) if can_gq else (False,)
                 act_arms = (False, True) if (
                     compression and tp > 1 and not executable_only) else (False,)
@@ -559,14 +563,40 @@ def score_candidate(
     microbatches: int = 8,
 ) -> Dict[str, Any]:
     """Modeled step time = compute term (HLO/formula FLOPs over the
-    sustained per-device FLOP/s, inflated by the 1F1B bubble for pp
-    plans) + the serialized comm terms.  Returned dict is the ranked-row
-    payload (per-term breakdown included)."""
+    sustained per-device FLOP/s, inflated by the pipeline schedule's
+    modeled wall-clock multiplier for pp plans) + the serialized comm
+    terms.  Returned dict is the ranked-row payload (per-term breakdown
+    included).
+
+    pp plans are priced under BOTH pipeline schedules the executable side
+    can drive — classic 1F1B and the zero-bubble split
+    (``obs.aggregate.pipeline_time_inflation``, which charges zb's extra
+    dgrad/wgrad recompute honestly) — and the row records the cheaper one
+    as ``pp_schedule`` plus its slot-accounting ``bubble_fraction``
+    (``obs.aggregate.pipeline_bubble_fraction``), so the planner's
+    schedule choice is auditable against the measured pair
+    ``bench.py --autoplan`` attaches."""
+    from ..obs.aggregate import (
+        pipeline_bubble_fraction,
+        pipeline_time_inflation,
+    )
+
     S = seq_len if seq_len is not None else d.seq
     n_chips = c["dp"] * c["tp"] * c["pp"]
     flops_step = fpt * global_batch * S
-    bubble = (c["pp"] - 1) / microbatches if c["pp"] > 1 else 0.0
-    compute_s = flops_step / n_chips / effective_flops * (1.0 + bubble)
+    if c["pp"] > 1:
+        inflations = {
+            sched: pipeline_time_inflation(microbatches, c["pp"],
+                                           schedule=sched)
+            for sched in ("1f1b", "zb")
+        }
+        pp_schedule = min(inflations, key=inflations.get)
+        inflation = inflations[pp_schedule]
+        bubble = pipeline_bubble_fraction(
+            microbatches, c["pp"], schedule=pp_schedule)
+    else:
+        pp_schedule, inflation, bubble = None, 1.0, 0.0
+    compute_s = flops_step / n_chips / effective_flops * inflation
     terms = comm_terms(d, c, global_batch, model, seq_len=S,
                        microbatches=microbatches)
     comm_s = sum(t["total_s"] for t in terms)
@@ -575,6 +605,7 @@ def score_candidate(
         "comm_s": comm_s,
         "step_s": compute_s + comm_s,
         "bubble_fraction": round(bubble, 4),
+        "pp_schedule": pp_schedule,
         "terms": terms,
     }
 
@@ -745,7 +776,12 @@ def attach_measured(
     section's ``modeled_vs_measured`` — the audit record the acceptance
     reads: per-plan modeled vs measured with rel err, and whether the
     measured ordering agrees with the modeled one.  ``rows``: dicts with
-    ``key``, ``modeled_step_s``, ``measured_step_s``."""
+    ``key``, ``modeled_step_s``, ``measured_step_s``; pp rows may carry
+    the bubble audit alongside (``pp_schedule``,
+    ``modeled_bubble_fraction`` from the slot accounting,
+    ``measured_bubble_fraction`` estimated from the timed 1F1B/ZB pair)
+    — passed through verbatim so the RUNREPORT shows the bubble term's
+    modeled-vs-measured agreement, not just the step time's."""
     out_rows = []
     for r in rows:
         mo, me = float(r["modeled_step_s"]), float(r["measured_step_s"])
@@ -753,6 +789,10 @@ def attach_measured(
             "key": r["key"], "modeled_step_s": mo, "measured_step_s": me,
             "rel_err": round((mo - me) / me, 4) if me > 0 else None,
         })
+        for extra in ("pp_schedule", "modeled_bubble_fraction",
+                      "measured_bubble_fraction", "microbatches"):
+            if extra in r:
+                out_rows[-1][extra] = r[extra]
     modeled_order = [r["key"] for r in sorted(
         out_rows, key=lambda r: r["modeled_step_s"])]
     measured_order = [r["key"] for r in sorted(

@@ -7,6 +7,7 @@ from .gpt import (
     gpt_param_specs,
     gpt_pipeline_1f1b,
     gpt_pipeline_loss,
+    gpt_pipeline_zb,
     init_gpt_params,
     interleave_stage_params,
     llama_config,

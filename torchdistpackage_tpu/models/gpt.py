@@ -660,6 +660,69 @@ def gpt_pipeline_1f1b(
     )
 
 
+def gpt_pipeline_zb(
+    params: Dict[str, PyTree],
+    batch: Dict[str, jnp.ndarray],
+    cfg: GPTConfig,
+    num_microbatches: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+    sp: bool = False,
+    remat: RematMode = True,
+    dropout_key: Optional[jax.Array] = None,
+    shard_transfers: Optional[bool] = None,
+):
+    """Zero-bubble GPT training step core: the :func:`gpt_pipeline_1f1b`
+    contract (returns ``(loss, grads)`` directly) on the
+    :func:`...pipeline_parallel.pipeline_zb_1f1b` schedule — backward
+    split into a dgrad wavefront plus an M-tick wgrad drain; same stage
+    ownership (stage 0 embeds, last stage runs LN + head + vocab-parallel
+    CE), same dropout-key recipe (the key folds (stage, microbatch), so
+    the dgrad AND wgrad recomputes replay identical masks).  No
+    interleaved (``num_chunks``) variant; ``shard_transfers`` defaults on
+    exactly when ``tp_axis`` is set and ``sp`` is off, as in the classic
+    schedule."""
+    from ..parallel.pipeline_parallel import pipeline_zb_1f1b
+
+    if shard_transfers is None:
+        shard_transfers = tp_axis is not None and not sp
+
+    def first_fn(p, toks):
+        h = gpt_embed(p, toks, tp_axis, context_axis=cfg.context_axis,
+                      cp_layout=cfg.cp_layout)
+        if tp_axis is not None and sp:
+            h = split_to_sp(h, tp_axis)
+        return h
+
+    def stage_fn(p, x, m):
+        k = None
+        if dropout_key is not None and cfg.dropout_rate > 0.0:
+            k = jax.random.fold_in(
+                dropout_key, jax.lax.axis_index(pipe_axis))
+            k = jax.random.fold_in(k, m)
+        return scan_blocks(
+            p["blocks"], x, cfg.block, tp_axis, sp, remat=remat,
+            dropout_key=k,
+        )
+
+    def last_fn(p, y, tgt):
+        logits = gpt_head(p, y, tp_axis, sp, eps=cfg.norm_eps)
+        return vocab_parallel_xent(logits, tgt, tp_axis)
+
+    return pipeline_zb_1f1b(
+        params,
+        batch["tokens"],
+        batch["targets"],
+        first_fn=first_fn,
+        stage_fn=stage_fn,
+        last_fn=last_fn,
+        num_microbatches=num_microbatches,
+        pipe_axis=pipe_axis,
+        stage_takes_mb=True,
+        transfer_shard_axis=tp_axis if shard_transfers else None,
+    )
+
+
 # ----------------------------------------------------------------- init/specs
 
 

@@ -79,6 +79,7 @@ from .aggregate import (
     moe_load_stats,
     percentiles,
     pipeline_bubble_fraction,
+    pipeline_time_inflation,
     step_time_stats,
 )
 from .report import (
@@ -98,6 +99,7 @@ from .comm_ledger import (
     comm_record,
     ledger_from_compiled,
     ledger_from_hlo,
+    tp_pp_overlap,
 )
 from .comm_model import (
     COMPRESSION_SCHEMA,
@@ -163,6 +165,7 @@ __all__ = [
     "moe_load_stats",
     "percentiles",
     "pipeline_bubble_fraction",
+    "pipeline_time_inflation",
     "step_time_stats",
     "RESILIENCE_VERDICTS",
     "SERVING_VERDICTS",
@@ -176,6 +179,7 @@ __all__ = [
     "comm_record",
     "ledger_from_compiled",
     "ledger_from_hlo",
+    "tp_pp_overlap",
     "CommModel",
     "comm_report",
     "fit_alpha_beta",

@@ -138,9 +138,10 @@ def pipeline_bubble_fraction(
     num_chunks: int = 1,
     schedule: str = "1f1b",
 ) -> float:
-    """Fraction of schedule ticks a stage spends idle (fill + drain).
+    """Fraction of schedule slot executions a stage spends idle.
 
-    Derived from the package's own schedules (``pipeline_sched.py``):
+    Derived from the package's own schedules (``pipeline_sched.py``,
+    ``zero_bubble.py``):
 
     - ``'forward'`` (``pipeline_forward``/``pipeline_loss`` scan):
       ``M + P - 1`` ticks for M units of work -> ``(P-1)/(M+P-1)``.
@@ -149,6 +150,11 @@ def pipeline_bubble_fraction(
       ``(PV + P - 2)/(VM + PV + P - 2)`` (classic ``2(P-1)/(M+2P-2)``
       at V=1 — equivalently the Megatron ``(P-1)/(M+P-1)`` accounting
       with bwd counted at fwd cost).
+    - ``'zb'`` (``pipeline_zb_1f1b``, V=1 only): the fwd and dgrad slots
+      each execute ``M + 2(P-1)`` times for M useful units, the wgrad
+      slot exactly ``M`` times (the drain has no wavefront) ->
+      ``4(P-1)/(3M + 4(P-1))`` — strictly below the 1F1B fraction at
+      every (P >= 2, M), 2/3 of it as M grows.
     """
     M, P_, V = int(num_microbatches), int(pipe_size), int(num_chunks)
     if M < 1 or P_ < 1 or V < 1:
@@ -158,6 +164,41 @@ def pipeline_bubble_fraction(
     if schedule == "1f1b":
         ticks = V * M + P_ * V + P_ - 2
         return (P_ * V + P_ - 2) / ticks
+    if schedule == "zb":
+        if V != 1:
+            raise ValueError("the zb schedule has no interleaved variant")
+        return (4 * (P_ - 1)) / (3 * M + 4 * (P_ - 1))
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def pipeline_time_inflation(
+    num_microbatches: int,
+    pipe_size: int,
+    schedule: str = "1f1b",
+) -> float:
+    """Modeled wall-clock multiplier of a pipelined step over the ideal
+    bubble-free step — the factor the autoplan pp compute term applies.
+
+    Cost model in forward-units (fwd = dgrad = wgrad = recompute = 1; the
+    remat convention every schedule here pays), ideal per microbatch =
+    fwd + recompute + dgrad + wgrad = 4:
+
+    - ``'1f1b'``: ``M + 2(P-1)`` ticks of cost 4 (the SPMD scan executes
+      both slots every tick) -> ``(M + 2(P-1))/M``.
+    - ``'zb'``: ``M + 2(P-1)`` main ticks of cost 3 (fwd + recompute +
+      dgrad; the wgrad ops are not in that scan) plus ``M`` drain ticks
+      of cost 2 (recompute + wgrad) -> ``(5M + 6(P-1))/(4M)``.  The
+      split's extra recompute is IN this number: zb models faster than
+      1f1b exactly when ``M < 2(P-1)`` — the deep-pipeline small-M
+      regime where the cooldown bubble dominates.
+    """
+    M, P_ = int(num_microbatches), int(pipe_size)
+    if M < 1 or P_ < 1:
+        raise ValueError(f"bad schedule shape M={M} P={P_}")
+    if schedule == "1f1b":
+        return (M + 2 * (P_ - 1)) / M
+    if schedule == "zb":
+        return (5 * M + 6 * (P_ - 1)) / (4 * M)
     raise ValueError(f"unknown schedule {schedule!r}")
 
 

@@ -231,9 +231,17 @@ def parse_hlo_collectives(hlo_text: str) -> List[Dict[str, Any]]:
     ``-done`` immediately follows the ``-start`` (async in name only);
     the latency-hiding presets of ``dist/overlap.py`` exist to push this
     number up.
+
+    ``overlapped_idx`` (async ops only, else None): indices (into the
+    returned list) of OTHER collective instructions issued inside this
+    op's start->done window — the instruction-level evidence of
+    collective-under-collective overlap (e.g. a TP all-gather issuing
+    inside a pipeline ppermute's slack, the synergy-paper ordering
+    ``zero_bubble.py`` arranges; :func:`tp_pp_overlap` summarizes it).
     """
     out: List[Dict[str, Any]] = []
     starts: Dict[str, Dict[str, Any]] = {}
+    open_starts: List[Dict[str, Any]] = []
     instr_idx = 0
     for line in hlo_text.splitlines():
         is_instr = _ANY_INSTR_RE.match(line) is not None
@@ -250,6 +258,8 @@ def parse_hlo_collectives(hlo_text: str) -> List[Dict[str, Any]]:
             rec = starts.get(onm.group(1)) if onm else None
             if rec is not None:
                 rec["sched_distance"] = max(0, instr_idx - rec["_idx"] - 1)
+                if rec in open_starts:
+                    open_starts.remove(rec)
             continue
         op = m.group("op")
         rest = m.group("rest")
@@ -280,10 +290,17 @@ def parse_hlo_collectives(hlo_text: str) -> List[Dict[str, Any]]:
             "op_name": nm.group(1) if nm else None,
             "async": bool(m.group("start")),
             "sched_distance": None,
+            "overlapped_idx": None,
             "_idx": instr_idx,
         }
+        # this collective was issued inside every currently-open async
+        # window — record it as overlapped work those transfers can hide
+        for open_rec in open_starts:
+            open_rec["overlapped_idx"].append(len(out))
         if rec["async"]:
+            rec["overlapped_idx"] = []
             starts[m.group("name")] = rec
+            open_starts.append(rec)
         out.append(rec)
     for rec in out:
         rec.pop("_idx", None)
@@ -378,6 +395,7 @@ def ledger_from_hlo(hlo_text: str, mesh=None) -> Dict[str, Any]:
             "op_name": rec["op_name"],
             "async": rec["async"],
             "sched_distance": rec["sched_distance"],
+            "overlapped_idx": rec["overlapped_idx"],
         }
         collectives.append(entry)
         d = per_dim.setdefault(dim, {"bytes": 0, "ops": 0})
@@ -414,6 +432,50 @@ def ledger_from_hlo(hlo_text: str, mesh=None) -> Dict[str, Any]:
             if mesh is not None else None
         ),
     }
+
+
+def tp_pp_overlap(ledger: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """TP-under-PP overlap evidence from a ledger: for every async
+    pipeline collective-permute, which tensor-dimension collectives were
+    issued inside its start->done window.
+
+    The synergy schedule (``zero_bubble.py``, arXiv 2510.27257) orders
+    each boundary ``ppermute`` so a TP stage's SP all-gather/
+    reduce-scatter pairs are the independent work between its start and
+    done; this report reads the achieved ordering back out of the
+    compiled HLO.  On backends whose scheduler never splits the permute
+    into -start/-done (the CPU sim), ``pp_async_ops`` is 0 and the rest
+    is vacuously 0 — the structure is only *provable* where async
+    collectives exist (TPU with the ``dist/overlap.py`` presets).
+    """
+    out = {
+        "pp_async_ops": 0,
+        "pp_windows_with_tp": 0,
+        "tp_ops_in_pp_windows": 0,
+        "tp_bytes_in_pp_windows": 0,
+        "mean_pp_sched_distance": None,
+    }
+    if not ledger or not ledger.get("collectives"):
+        return out
+    colls = ledger["collectives"]
+    distances = []
+    for c in colls:
+        if c["dim"] != "pp" or not c["async"]:
+            continue
+        out["pp_async_ops"] += 1
+        if c["sched_distance"] is not None:
+            distances.append(c["sched_distance"])
+        inside = [colls[i] for i in (c.get("overlapped_idx") or [])
+                  if i < len(colls)]
+        tp_inside = [o for o in inside if o["dim"] == "tp"]
+        if tp_inside:
+            out["pp_windows_with_tp"] += 1
+        out["tp_ops_in_pp_windows"] += len(tp_inside)
+        out["tp_bytes_in_pp_windows"] += sum(o["bytes"] for o in tp_inside)
+    if distances:
+        out["mean_pp_sched_distance"] = round(
+            sum(distances) / len(distances), 2)
+    return out
 
 
 def ledger_from_compiled(compiled, mesh=None) -> Optional[Dict[str, Any]]:

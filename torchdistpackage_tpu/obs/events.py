@@ -176,6 +176,21 @@ Auto-sharding planner kinds (``dist/autoplan.py``, PR 13):
                             OOM-risk line — pruned BEFORE any compile
 ==========================  =============================================
 
+Zero-bubble pipeline kinds (``parallel/pipeline_parallel/zero_bubble.py``,
+PR 14 — emitted at schedule-build (trace) time, once per compile):
+
+==========================  =============================================
+``zb_wgrad_deferred``       the ZB schedule queued its per-microbatch
+                            wgrad work items (x, g, dx) instead of fusing
+                            them into the backward wavefront — record
+                            carries the unit and queue-slot counts
+``zb_cooldown_filled``      the schedule's tick accounting: main-scan vs
+                            wgrad-drain tick counts plus the modeled zb
+                            and 1f1b bubble fractions at this (P, M) —
+                            the numbers the RUNREPORT pipeline counters
+                            and the bench A/B rows are checked against
+==========================  =============================================
+
 A module-level default log lets deep call sites (signal handlers, debug
 callbacks) emit without plumbing a handle through every layer:
 ``emit_event("preemption", signum=15)``.
@@ -224,6 +239,8 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     "compress_policy",
     # auto-sharding planner (PR 13)
     "plan_selected", "plan_rejected_oom",
+    # zero-bubble pipeline schedule (PR 14)
+    "zb_wgrad_deferred", "zb_cooldown_filled",
 })
 
 

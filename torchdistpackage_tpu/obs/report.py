@@ -116,6 +116,49 @@ def validate_runreport(report: Any) -> List[str]:
     errs.extend(_validate_serving(report.get("serving")))
     errs.extend(_validate_compression(report.get("compression")))
     errs.extend(_validate_autoplan(report.get("autoplan")))
+    errs.extend(_validate_pipeline(report["counters"].get("pipeline")))
+    return errs
+
+
+#: schedules the pipeline counters section may name (obs/aggregate.py's
+#: ``pipeline_bubble_fraction`` vocabulary)
+PIPELINE_SCHEDULES = ("forward", "1f1b", "zb")
+
+
+def _validate_pipeline(pipe: Any) -> List[str]:
+    """The optional ``counters.pipeline`` section (the pipelined examples
+    and the ZB A/B record it): schedule-shape fields must be coherent,
+    bubble fractions in range, and a ``zb`` record claiming a win over
+    1F1B must actually show one — a section whose own numbers contradict
+    the schedule it names is a reporting bug, surfaced here."""
+    if pipe is None:
+        return []
+    if not isinstance(pipe, dict):
+        return [f"counters.pipeline is {type(pipe).__name__}, expected dict"]
+    errs: List[str] = []
+    for key in ("pipe_size", "num_microbatches"):
+        v = pipe.get(key)
+        if not isinstance(v, int) or v < 1:
+            errs.append(f"counters.pipeline.{key} missing/invalid: {v!r}")
+    bf = pipe.get("bubble_fraction")
+    if not isinstance(bf, (int, float)) or not (0.0 <= bf < 1.0):
+        errs.append(f"counters.pipeline.bubble_fraction out of [0,1): {bf!r}")
+    sched = pipe.get("schedule")
+    if sched is not None and sched not in PIPELINE_SCHEDULES:
+        errs.append(
+            f"counters.pipeline.schedule {sched!r} not in "
+            f"{PIPELINE_SCHEDULES}")
+    ref = pipe.get("bubble_fraction_1f1b")
+    if ref is not None:
+        if not isinstance(ref, (int, float)) or not (0.0 <= ref < 1.0):
+            errs.append(
+                f"counters.pipeline.bubble_fraction_1f1b out of [0,1): "
+                f"{ref!r}")
+        elif sched == "zb" and isinstance(bf, (int, float)) and bf >= ref:
+            errs.append(
+                f"counters.pipeline: zb bubble_fraction {bf} not below the "
+                f"1f1b reference {ref} — the zero-bubble claim is "
+                f"contradicted by the section's own numbers")
     return errs
 
 

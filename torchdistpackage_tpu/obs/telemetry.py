@@ -282,6 +282,7 @@ class Telemetry:
         )
 
         self._compiled: Dict[Tuple, Dict[str, Any]] = {}
+        self._wrap_n = 0  # wrap_step counter: scopes the AOT cache per fn
         self._aot_ok = True
         self._pending_out: Any = None
         self._pending_spans: Dict[str, float] = {}
@@ -289,6 +290,7 @@ class Telemetry:
         self._last_fetch_end: Optional[float] = None
         self._step_n = 0
         self.n_compiles = 0
+        self.n_recompiles = 0
         self.compile_time_s = 0.0
         self.xla_cost: Dict[str, float] = {}
         self._peak_bytes = 0
@@ -309,10 +311,18 @@ class Telemetry:
         rejects a call (sharding/donation edge the signature key can't
         see), the wrapper permanently falls back to the original callable —
         telemetry must never change what the loop computes.
+
+        The executable cache is scoped PER WRAPPED CALLABLE: two different
+        step fns wrapped by the same Telemetry (e.g. the 1F1B and ZB arms
+        of a schedule A/B) may share an abstract input signature, and a
+        signature-only key would silently hand arm B arm A's executable —
+        an A/B that measures one program twice.
         """
         import jax
 
         jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+        self._wrap_n += 1
+        wrap_id = self._wrap_n
 
         def wrapped(*args, **kwargs):
             now = time.perf_counter()
@@ -323,7 +333,7 @@ class Telemetry:
             entry = None
             sig = None
             if not kwargs:  # kwargs: skip AOT, plain call below
-                sig = _abstract_signature(args)
+                sig = (wrap_id, _abstract_signature(args))
                 entry = self._compiled.get(sig)
                 if entry is None:
                     entry = self._compile_entry(jfn, sig, args, cost_analysis)
@@ -348,6 +358,10 @@ class Telemetry:
 
     def _compile_entry(self, jfn, sig, args, cost_analysis) -> Dict[str, Any]:
         first = not self._compiled
+        # a RE-compile is the same wrapped step seeing a new input
+        # signature (the silent throughput killer); a different wrapped
+        # step's first compile is a plain compile
+        re_sig = any(k[0] == sig[0] for k in self._compiled)
         compiled = None
         cost: Dict[str, float] = {}
         t0 = time.perf_counter()
@@ -406,10 +420,11 @@ class Telemetry:
                         hlo_text, mesh=self.mesh)
                 except Exception:
                     self.comm_ledger = None
-        else:
+        if re_sig:
             self._recompiled = True
+            self.n_recompiles += 1
         self.events.emit(
-            "compile" if first else "recompile",
+            "compile" if not re_sig else "recompile",
             run=self.run,
             compile_time_s=round(dt, 4),
             flops=cost.get("flops"),
@@ -721,7 +736,9 @@ class Telemetry:
             "compile": {
                 "count": self.n_compiles,
                 "time_s": round(self.compile_time_s, 3),
-                "recompiles": max(0, self.n_compiles - 1),
+                # same-step re-signature compiles only: two DIFFERENT
+                # wrapped steps (a schedule A/B) are two first compiles
+                "recompiles": self.n_recompiles,
             },
             "hosts": hosts,
             "comm": comm,

@@ -25,3 +25,7 @@ from .pipeline_sched import (
     shift_right,
     stage_index,
 )
+from .zero_bubble import (
+    pipeline_zb_1f1b,
+    zb_schedule_ticks,
+)

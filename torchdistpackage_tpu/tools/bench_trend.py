@@ -59,11 +59,18 @@ from typing import Any, Dict, List, Tuple
 #: measured tokens/s next to its modeled step time — a throughput hold
 #: with a drifting model (the planner steering on stale numbers) is
 #: visible here before it mis-ranks a real decision.
+#: ``bubble_fraction`` / ``plan_pp_schedule`` (PR 14) ride pipeline A/B
+#: lines and the ``--autoplan`` planned arm when a pp plan is in play:
+#: the schedule's tick-model bubble fraction and which schedule arm
+#: (``1f1b`` vs ``zb``) produced the number — a throughput hold whose
+#: bubble fraction crept back up (or whose arm silently flipped back to
+#: classic 1F1B) is visible next to the tokens/s it costs.
 AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
             "grad_norm_final", "comm_bytes_per_dim", "shed_rate",
             "preempt_count", "prefix_hit_rate", "spec_accept_rate",
             "slo_attainment", "goodput_tok_s", "paged_pallas_tok_s",
-            "autoplan_tok_s", "plan_modeled_step_s")
+            "autoplan_tok_s", "plan_modeled_step_s", "bubble_fraction",
+            "plan_pp_schedule")
 
 
 def _aux_str(key: str, val: Any) -> str:
