@@ -1,0 +1,172 @@
+"""End-to-end example: a multi-replica serving FLEET behind the Router.
+
+`serve_gpt.py` saturates one engine; this example runs the tier above it
+(docs/serving.md "Multi-replica routing and disaggregation"): a
+disaggregated fleet — one PREFILL-tier replica feeding two DECODE
+replicas — behind `torchdistpackage_tpu.serving.Router`.
+
+Phase 1 (routing + disaggregation): shared-system-prompt traffic from
+two prompt families submits through the router.  Prefix-affinity
+routing lands every warm request where its KV already lives
+(``affinity_hit_rate`` asserted > 0), the prefill tier runs chunked
+prefill to the first token and hands each request to a decode replica
+by migrating the paged KV blocks themselves (``migrate_blocks`` — one
+fixed-signature compiled program per replica pair), and warm handoffs
+ship only the unshared TAIL blocks because imports match the target's
+prefix cache first (shared blocks asserted > 0 after the first wave).
+The prefill replica never dispatches a decode step; the decode replicas
+never prefill — asserted from the engines' own signature evidence.
+
+Phase 2 (replica failure): a chaos ``table_corrupt`` fault poisons one
+decode replica mid-decode.  The router's evacuate-on-fault policy
+drains it — queue and in-flight requests unwound into the PR-9
+exact-parity descriptors — takes it out of rotation, and resumes
+everything on the survivors.  Every request still completes, the
+cross-allocator audit stays green, and the fleet verdict reports
+``degraded`` with the dead replica visible in the replica table.
+
+The RUNREPORT carries the validated ``router`` section (per-replica
+serving sections + the fleet roll-up) next to the usual telemetry; CI
+(tests/test_examples.py) validates all of it.
+
+- real TPU chips:      python examples/serve_router.py
+- 8-device CPU sim:    TDP_CPU_SIM=8 python examples/serve_router.py
+"""
+
+import os
+
+if os.environ.get("TDP_CPU_SIM"):
+    from torchdistpackage_tpu.dist.overlap import cpu_sim
+
+    cpu_sim(os.environ["TDP_CPU_SIM"])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchdistpackage_tpu import setup_distributed
+from torchdistpackage_tpu.models import init_gpt_params, llama_config
+from torchdistpackage_tpu.obs import Telemetry
+from torchdistpackage_tpu.resilience import ChaosMonkey, Fault
+from torchdistpackage_tpu.serving import Request, Router, ServingEngine
+from torchdistpackage_tpu.utils.logging import master_print
+
+
+def main():
+    setup_distributed()
+    on_cpu = jax.default_backend() == "cpu"
+    smoke = bool(os.environ.get("TDP_SMOKE"))
+    cfg = llama_config(
+        vocab_size=256 if on_cpu else 32768,
+        dim=64 if on_cpu else 512,
+        nheads=4 if on_cpu else 8,
+        kv_heads=2 if on_cpu else 4,
+        nlayers=2 if on_cpu else 8,
+        max_seq=128 if on_cpu else 1024,
+        dtype=jnp.float32 if on_cpu else jnp.bfloat16,
+        attn_impl="naive" if on_cpu else "flash",
+    )
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    tel = Telemetry(run="serve_router", poll_memory=not on_cpu)
+
+    block_size, chunk = 8, 8
+
+    def replica(slots):
+        return ServingEngine(
+            params, cfg, num_slots=slots, block_size=block_size,
+            chunk=chunk, max_ctx=96, prefix_cache=True, telemetry=tel)
+
+    replicas = [replica(2), replica(3), replica(3)]
+    router = Router(replicas, roles=["prefill", "decode", "decode"],
+                    evacuate_on_fault=True, telemetry=tel)
+    master_print(
+        f"fleet: {len(replicas)} replicas (1 prefill + 2 decode), "
+        f"{sum(r.num_slots for r in replicas)} total slots")
+
+    # --- phase 1: shared-prefix traffic, routed + disaggregated --------
+    rng = np.random.RandomState(0)
+    sys_prompts = [rng.randint(0, cfg.vocab_size,
+                               size=3 * block_size).tolist()
+                   for _ in range(2)]
+    n_requests = 8 if smoke else 16
+    rids = []
+
+    def wave(n, seed0):
+        """Submit n shared-prefix requests and drain, auditing every
+        tick (each engine's own audit + the cross-allocator check)."""
+        for i in range(n):
+            sysp = sys_prompts[i % 2]
+            tail = rng.randint(0, cfg.vocab_size,
+                               size=int(rng.choice([2, 3]))).tolist()
+            rids.append(router.submit(Request(
+                tokens=sysp + tail,
+                max_new_tokens=int(rng.choice([6, 8, 12])),
+                temperature=float(rng.choice([0.0, 0.8])),
+                seed=seed0 + i,
+            )))
+        while router.has_work():
+            router.step()
+            rep = router.audit()
+            assert rep["ok"], rep["violations"]
+
+    # cold wave: one request per prompt family prefills its prefix onto
+    # the fleet; warm wave: everything after lands on resident KV
+    wave(2, 0)
+    wave(n_requests - 2, 2)
+    assert all(rid in router.finished for rid in rids)
+    assert not router.rejected
+
+    s = router.summary()
+    fleet = s["fleet"]
+    assert fleet["affinity"]["hit_rate"] > 0, fleet["affinity"]
+    mig = fleet["migrations"]
+    assert mig["handoffs"] == n_requests and mig["bytes"] > 0, mig
+    assert mig["shared_blocks"] > 0, "warm handoffs should share blocks"
+    # strict tier separation, from the engines' own compiled evidence
+    assert replicas[0].stats["decode_steps"] == 0
+    assert all(replicas[j].stats["prefill_chunks"] == 0 for j in (1, 2))
+    master_print(
+        f"phase 1: {len(rids)} requests — affinity hit rate "
+        f"{fleet['affinity']['hit_rate']:.0%}, {mig['handoffs']} handoffs "
+        f"({mig['blocks']} blocks migrated / {mig['shared_blocks']} "
+        f"prefix-shared, {mig['bytes'] / 1e3:.1f} kB wire)")
+
+    # --- phase 2: kill a decode replica mid-decode, evacuate -----------
+    victim = 1
+    replicas[victim].chaos = ChaosMonkey(
+        faults=[Fault("table_corrupt",
+                      step=replicas[victim]._tick + 4, slot=0)], seed=0)
+    rids2 = []
+    for i in range(4 if smoke else 8):
+        sysp = sys_prompts[i % 2]
+        tail = rng.randint(0, cfg.vocab_size, size=2).tolist()
+        rids2.append(router.submit(Request(
+            tokens=sysp + tail, max_new_tokens=8, seed=100 + i)))
+    while router.has_work():
+        router.step()
+        rep = router.audit()
+        assert rep["ok"], rep["violations"]
+    assert not router.alive[victim], "faulted replica left in rotation"
+    assert all(rid in router.finished for rid in rids2)
+    assert replicas[victim].chaos.fired_count == 1
+
+    s = router.summary()
+    assert s["fleet"]["verdict"] == "degraded"
+    assert s["fleet"]["evacuations"] == 1
+    assert s["fleet"]["n_alive"] == len(replicas) - 1
+    for row in s["replicas"]:
+        if row["role"] == "decode" and row["alive"]:
+            assert row["decode_signatures"] == 1, row
+    master_print(
+        f"phase 2: replica {victim} poisoned -> evacuated "
+        f"({s['fleet']['evacuated_requests']} requests rehomed); fleet "
+        f"verdict {s['fleet']['verdict']}, "
+        f"{s['fleet']['n_alive']}/{len(replicas)} alive, all "
+        f"{len(rids2)} requests completed on the survivors")
+
+    tel.record_router(s)
+    tel.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,11 @@ OBS_EXAMPLES = {
     # the per-priority percentiles + verdict and the SIGTERM drain demo's
     # engine_drained event
     "serve_gpt.py": {"serving": "stress"},
+    # multi-replica router (PR 15): the report must carry the validated
+    # ``router`` section — per-replica serving sections + the fleet
+    # roll-up with affinity/migration evidence — and the routing /
+    # handoff / degradation events on the timeline
+    "serve_router.py": {"router": True},
 }
 
 
@@ -180,6 +185,29 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
             assert 0.0 <= srv["spec_accept_rate"] <= 1.0, srv
             assert srv["spec"]["k"] >= 1, srv
             assert {"prefix_hit", "spec_draft", "spec_verify"} <= kinds, kinds
+
+    if probe.get("router"):
+        rt = report.get("router")
+        assert rt, (script, "no router section")
+        fleet = rt["fleet"]
+        # disaggregation + affinity did the work: warm traffic landed on
+        # its KV, every request handed prefill->decode by block
+        # migration, warm handoffs shared prefix blocks on arrival
+        assert fleet["affinity"]["hit_rate"] > 0, fleet["affinity"]
+        assert fleet["migrations"]["handoffs"] >= 1, fleet["migrations"]
+        assert fleet["migrations"]["bytes"] > 0, fleet["migrations"]
+        assert fleet["migrations"]["shared_blocks"] > 0, fleet["migrations"]
+        # the chaos phase killed a replica: evacuated, fleet degraded
+        assert fleet["verdict"] == "degraded", fleet
+        assert fleet["evacuations"] >= 1 and fleet["n_alive"] < len(
+            rt["replicas"]), fleet
+        # compile-once per live decode replica
+        for row in rt["replicas"]:
+            if row["alive"] and row["role"] in ("decode", "both"):
+                assert row["decode_signatures"] == 1, row
+        kinds = {e["kind"] for e in report["events"]}
+        assert {"request_routed", "blocks_migrated", "request_migrated",
+                "replica_degraded"} <= kinds, kinds
 
     if probe.get("autoplan"):
         # the PR-13 planner section: a chosen plan with per-term score

@@ -446,6 +446,29 @@ def test_serving_obs_event_kinds_registered_and_emitted():
     assert _tracing.SERVING_METRICS_SCHEMA.startswith("tdp-serving-metrics")
 
 
+def test_router_event_kinds_registered_and_emitted():
+    """The multi-replica router kinds (PR 15) are in the registry AND
+    each is actually emitted from ``serving/router.py`` —
+    ``request_routed`` is the affinity/fallback evidence every routing
+    assertion (and the fleet hit-rate roll-up) is built on,
+    ``request_migrated``/``blocks_migrated`` are the rebalance/handoff
+    trail the migration accounting reads, and ``replica_degraded`` is
+    the router's degradation watch; a kind that stopped being emitted
+    would silently blind the fleet section and the bench_trend columns."""
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+
+    router_kinds = {
+        "request_routed", "request_migrated", "replica_degraded",
+        "blocks_migrated",
+    }
+    assert router_kinds <= EVENT_KINDS
+    emitted = {
+        k for _, k in _emit_call_kinds(PKG / "serving" / "router.py")}
+    missing = router_kinds - emitted
+    assert not missing, (
+        f"router kinds never emitted from serving/router.py: {missing}")
+
+
 def test_fastpath_event_kinds_registered_and_emitted():
     """The serving fast-path kinds (PR 10) are in the registry AND each
     is actually emitted from ``serving/`` — the prefix-cache hit/COW/

@@ -296,6 +296,33 @@ def test_bench_trend_slo_columns():
     assert not warnings
 
 
+def test_bench_trend_router_columns():
+    """The PR-15 fleet columns: the ``serve-router-fleet`` line gates on
+    fleet tokens/s (``value``) with ``fleet_goodput_tok_s`` /
+    ``affinity_hit_rate`` / ``migration_bytes`` rendered alongside — a
+    throughput hold with a collapsed affinity hit rate (warm traffic no
+    longer landing on its KV) or ballooning migration bytes (handoffs
+    shipping whole contexts instead of tails) is visible in the trend,
+    and a fleet-line regression still trips the gate."""
+    from torchdistpackage_tpu.tools.bench_trend import AUX_KEYS, trend
+
+    assert {"fleet_goodput_tok_s", "affinity_hit_rate",
+            "migration_bytes"} <= set(AUX_KEYS)
+    line = {"metric": "serve-router-fleet", "value": 900.0,
+            "fleet_goodput_tok_s": 900.0, "affinity_hit_rate": 0.88,
+            "migration_bytes": 147456, "config": "c"}
+    report, warnings = trend(
+        [(1, [line]),
+         (2, [dict(line, value=500.0, affinity_hit_rate=0.05,
+                   migration_bytes=1200000)])],
+        threshold=0.05)
+    assert any("affinity_hit_rate=0.88" in ln for ln in report)
+    assert any("fleet_goodput_tok_s=900.0" in ln for ln in report)
+    assert any("migration_bytes=147456" in ln for ln in report)
+    assert any("affinity_hit_rate=0.05" in ln for ln in report)
+    assert any("REGRESSION serve-router-fleet" in w for w in warnings)
+
+
 def test_bench_trend_paged_kernel_column():
     """The PR-12 paged-kernel columns: ``serve-paged-{gather,pallas}``
     lines gate on tokens/s (``value``) as their own series, and the

@@ -164,6 +164,35 @@ PR 11 — request-lifecycle tracing + tick accounting; docs/serving.md
                             only for ticks that did work)
 ==========================  =============================================
 
+Multi-replica router kinds (``serving/router.py``, PR 15 — prefix-affinity
+routing, prefill/decode disaggregation, cross-replica KV migration;
+docs/serving.md "Multi-replica routing and disaggregation"):
+
+==========================  =============================================
+``request_routed``          the router placed a submit on a replica:
+                            record carries the replica index, its
+                            resident-prefix affinity (tokens), the
+                            replica's biased TTFT estimate, and the
+                            fallback rank (0 = first choice; >0 = a
+                            better-ranked replica shed it first)
+``request_migrated``        a request moved between replicas — queued
+                            (``rebalance`` / ``evacuation``: KV-free
+                            drain-descriptor resume, exact-parity
+                            replay) or in-flight (``prefill_handoff``:
+                            the disaggregation path, KV travels by
+                            ``blocks_migrated``)
+``replica_degraded``        the router observed a replica degrading
+                            (fault counter moved, or new shed/expired
+                            demand = the overloaded verdict) and what it
+                            did about it (observed / rebalance /
+                            evacuate)
+``blocks_migrated``         one cross-pool KV migration ran: src/dst
+                            replica, blocks copied vs prefix-shared on
+                            arrival, wire bytes, and the comm-model
+                            pricing verdict (int8 wire iff the model
+                            approved the DCN-crossing leg)
+==========================  =============================================
+
 Auto-sharding planner kinds (``dist/autoplan.py``, PR 13):
 
 ==========================  =============================================
@@ -231,6 +260,9 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     "prefix_hit", "block_cow", "spec_draft", "spec_verify", "cache_evict",
     # serving observability (PR 11)
     "request_submitted", "request_resumed", "engine_tick",
+    # multi-replica router (PR 15)
+    "request_routed", "request_migrated", "replica_degraded",
+    "blocks_migrated",
     # memory observability (PR 6)
     "mem_snapshot", "oom_risk",
     # numerics observability (PR 7)

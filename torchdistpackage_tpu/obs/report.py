@@ -114,6 +114,7 @@ def validate_runreport(report: Any) -> List[str]:
         elif not isinstance(res.get("rollbacks"), int) or res["rollbacks"] < 0:
             errs.append("resilience.rollbacks missing/negative")
     errs.extend(_validate_serving(report.get("serving")))
+    errs.extend(_validate_router(report.get("router")))
     errs.extend(_validate_compression(report.get("compression")))
     errs.extend(_validate_autoplan(report.get("autoplan")))
     errs.extend(_validate_pipeline(report["counters"].get("pipeline")))
@@ -501,6 +502,83 @@ def _validate_serving_slo(srv: Dict[str, Any]) -> List[str]:
     return errs
 
 
+def _validate_router(rt: Any) -> List[str]:
+    """The optional ``router`` section (a serving Router drove the run):
+    one full serving section per replica — each re-validated through
+    :func:`_validate_serving` — plus the fleet roll-up, whose invariants
+    are cross-replica: fleet goodput cannot exceed the sum of the
+    replica token rates (goodput counts a subset of the same tokens over
+    a span at least as long as any replica's), the affinity hit rate is
+    a fraction of routed requests, and the per-replica verdict list must
+    agree with the replica sections it rolls up."""
+    if rt is None:
+        return []
+    if not isinstance(rt, dict):
+        return [f"router is {type(rt).__name__}, expected dict"]
+    errs: List[str] = []
+    reps = rt.get("replicas")
+    if not isinstance(reps, list) or not reps:
+        return ["router.replicas missing/empty"]
+    for i, row in enumerate(reps):
+        if not isinstance(row, dict):
+            errs.append(f"router.replicas[{i}] non-dict")
+            continue
+        for key in ("index", "role", "alive"):
+            if key not in row:
+                errs.append(f"router.replicas[{i}].{key} missing")
+        errs.extend(f"router.replicas[{i}]: {e}"
+                    for e in _validate_serving(row))
+    fleet = rt.get("fleet")
+    if not isinstance(fleet, dict):
+        errs.append("router.fleet missing/non-dict")
+        return errs
+    if fleet.get("verdict") not in SERVING_VERDICTS:
+        errs.append(
+            f"router.fleet.verdict {fleet.get('verdict')!r} not in "
+            f"{SERVING_VERDICTS}")
+    verdicts = fleet.get("verdicts")
+    if (not isinstance(verdicts, list) or len(verdicts) != len(reps)
+            or any(v not in SERVING_VERDICTS for v in verdicts)):
+        errs.append("router.fleet.verdicts missing/mislengthed/invalid")
+    elif verdicts != [row.get("verdict") for row in reps
+                      if isinstance(row, dict)]:
+        errs.append(
+            "router.fleet.verdicts disagree with the replica sections")
+    gp = fleet.get("goodput_tok_s")
+    if not isinstance(gp, (int, float)) or gp < 0:
+        errs.append("router.fleet.goodput_tok_s missing/negative")
+    else:
+        cap = sum(row.get("tokens_per_sec", 0.0) for row in reps
+                  if isinstance(row, dict))
+        if gp > cap * 1.001 + 1e-9:
+            errs.append(
+                f"router.fleet.goodput_tok_s {gp} exceeds the sum of "
+                f"replica tokens_per_sec {cap}")
+    aff = fleet.get("affinity")
+    if not isinstance(aff, dict):
+        errs.append("router.fleet.affinity missing/non-dict")
+    else:
+        hr = aff.get("hit_rate")
+        if not isinstance(hr, (int, float)) or not (0.0 <= hr <= 1.0):
+            errs.append("router.fleet.affinity.hit_rate out of [0, 1]")
+        for k in ("routed", "affinity_routed"):
+            if not isinstance(aff.get(k), int) or aff[k] < 0:
+                errs.append(f"router.fleet.affinity.{k} missing/negative")
+    mig = fleet.get("migrations")
+    if not isinstance(mig, dict):
+        errs.append("router.fleet.migrations missing/non-dict")
+    else:
+        for k in ("handoffs", "blocks", "bytes", "compressed"):
+            v = mig.get(k)
+            if not isinstance(v, int) or v < 0:
+                errs.append(f"router.fleet.migrations.{k} missing/negative")
+    for k in ("rebalances", "evacuations"):
+        v = fleet.get(k)
+        if not isinstance(v, int) or v < 0:
+            errs.append(f"router.fleet.{k} missing/negative")
+    return errs
+
+
 def render_summary_line(report: Dict[str, Any]) -> str:
     """One line for stdout at end of run."""
     parts = [f"[obs] run={report['run']} steps={report['steps']}"]
@@ -584,6 +662,20 @@ def render_summary_line(report: Dict[str, Any]) -> str:
                 if reqs.get(k))
             parts.append(
                 f"SERVING={srv['verdict']}" + (f"({detail})" if detail else ""))
+    rt = report.get("router")
+    if rt and isinstance(rt.get("fleet"), dict):
+        fleet = rt["fleet"]
+        aff = fleet.get("affinity") or {}
+        mig = fleet.get("migrations") or {}
+        parts.append(
+            f"fleet={fleet.get('n_alive', '?')}/"
+            f"{fleet.get('n_replicas', '?')}rep "
+            f"{fleet.get('tokens_per_sec', 0.0):.1f}tok/s"
+            f"(aff {aff.get('hit_rate', 0.0):.0%}, "
+            f"mig {mig.get('handoffs', 0)}/"
+            f"{mig.get('bytes', 0) / 1e6:.2f}MB)")
+        if fleet.get("verdict") and fleet["verdict"] != "healthy":
+            parts.append(f"FLEET={fleet['verdict']}")
     return "  ".join(parts)
 
 
@@ -1030,6 +1122,59 @@ def render_markdown(report: Dict[str, Any]) -> str:
                 + ", ".join(f"{k} {v * 1e3:.2f}" for k, v in pm.items()
                             if v > 0)
                 + " ms)")
+        L.append("")
+
+    rt = report.get("router")
+    if rt and isinstance(rt.get("fleet"), dict):
+        fleet = rt["fleet"]
+        L.append("## Router fleet")
+        L.append("")
+        L.append(
+            f"- verdict: **{fleet.get('verdict', '?')}** "
+            f"({fleet.get('n_alive', '?')}/{fleet.get('n_replicas', '?')} "
+            f"replicas alive)")
+        L.append(
+            f"- fleet throughput: "
+            f"**{fleet.get('tokens_per_sec', 0.0):.1f} tok/s** "
+            f"({fleet.get('generated_tokens', 0)} tokens), goodput "
+            f"{fleet.get('goodput_tok_s', 0.0):.1f} tok/s")
+        aff = fleet.get("affinity") or {}
+        L.append(
+            f"- prefix affinity: hit rate "
+            f"**{aff.get('hit_rate', 0.0):.0%}** "
+            f"({aff.get('affinity_routed', 0)}/{aff.get('routed', 0)} "
+            f"routed warm, {aff.get('fallbacks', 0)} shed-fallbacks)")
+        mig = fleet.get("migrations") or {}
+        L.append(
+            f"- KV migrations: {mig.get('handoffs', 0)} handoffs "
+            f"({mig.get('blocks', 0)} blocks copied, "
+            f"{mig.get('shared_blocks', 0)} prefix-shared on arrival, "
+            f"{mig.get('bytes', 0) / 1e6:.2f} MB wire, "
+            f"{mig.get('compressed', 0)} int8-compressed) over "
+            f"{mig.get('signatures', 0)} compiled pair program(s)")
+        L.append(
+            f"- rebalances: {fleet.get('rebalances', 0)} "
+            f"({fleet.get('rebalanced_requests', 0)} requests moved), "
+            f"evacuations: {fleet.get('evacuations', 0)} "
+            f"({fleet.get('evacuated_requests', 0)} rehomed)")
+        reps = rt.get("replicas") or []
+        if reps:
+            L.append("")
+            L.append("| replica | role | zone | alive | verdict | tok/s "
+                     "| completed | migrated in/out | hit rate |")
+            L.append("|---|---|---|---|---|---|---|---|---|")
+            for row in reps:
+                reqs = row.get("requests") or {}
+                L.append(
+                    f"| {row.get('index', '?')} | {row.get('role', '?')} "
+                    f"| {row.get('zone', '?')} "
+                    f"| {'yes' if row.get('alive') else 'DEAD'} "
+                    f"| {row.get('verdict', '?')} "
+                    f"| {row.get('tokens_per_sec', 0.0):.1f} "
+                    f"| {reqs.get('completed', 0)} "
+                    f"| {reqs.get('migrated_in', 0)}/"
+                    f"{reqs.get('migrated_out', 0)} "
+                    f"| {row.get('prefix_hit_rate', 0.0):.0%} |")
         L.append("")
 
     counters = report.get("counters", {})

@@ -261,6 +261,7 @@ class Telemetry:
         self.counters: Dict[str, Any] = {}
         self.resilience: Optional[Dict[str, Any]] = None
         self.serving: Optional[Dict[str, Any]] = None
+        self.router: Optional[Dict[str, Any]] = None
         self.autoplan: Optional[Dict[str, Any]] = None
         self.history: List[Dict[str, Any]] = []
         self._history_max = history_max
@@ -598,6 +599,14 @@ class Telemetry:
         ``validate_runreport``)."""
         self.serving = dict(summary)
 
+    def record_router(self, summary: Dict[str, Any]) -> None:
+        """Attach a ``serving.Router.summary()`` as the report's optional
+        ``router`` section: one full serving section per replica plus
+        the fleet roll-up (fleet tokens/s + goodput, affinity hit rate,
+        migration count/bytes, rebalance/evacuation counts, per-replica
+        verdicts — validated by ``validate_runreport``)."""
+        self.router = dict(summary)
+
     # ------------------------------------------------------------- finalize
 
     def _steady_steps(self) -> List[Dict[str, Any]]:
@@ -749,6 +758,8 @@ class Telemetry:
             report["resilience"] = self.resilience
         if self.serving is not None:
             report["serving"] = self.serving
+        if self.router is not None:
+            report["router"] = self.router
         if self.compression is not None:
             report["compression"] = self.compression
         if self.autoplan is not None:

@@ -46,6 +46,7 @@ programs.
 """
 
 from .engine import Request, ServingEngine
+from .router import ROLES, Router
 from .tracing import (
     REQUEST_PHASES,
     REQUEST_TERMINALS,
@@ -69,6 +70,8 @@ from .paged_cache import (
     expected_pool_bytes,
     gather_kv,
     init_paged_kv,
+    migrate_blocks,
+    migration_wire_bytes,
     paged_attention,
     paged_forward,
     paged_forward_moe,
@@ -79,6 +82,8 @@ from .paged_cache import (
 __all__ = [
     "Request",
     "ServingEngine",
+    "ROLES",
+    "Router",
     "REQUEST_PHASES",
     "REQUEST_TERMINALS",
     "SERVING_METRICS_SCHEMA",
@@ -99,6 +104,8 @@ __all__ = [
     "expected_pool_bytes",
     "gather_kv",
     "init_paged_kv",
+    "migrate_blocks",
+    "migration_wire_bytes",
     "paged_attention",
     "paged_forward",
     "paged_forward_moe",

@@ -401,6 +401,14 @@ class ServingEngine:
         self.max_queue = max_queue
         self.chaos = chaos
         self.watchdog = watchdog
+        #: host-side scheduler bit for a DISAGGREGATED prefill tier
+        #: (serving/router.py): True = the decode tick is skipped, so a
+        #: slot that finishes prefill PARKS in the DECODE state (first
+        #: token sampled, KV complete) until the router exports it to a
+        #: decode replica — this engine's compiled decode program is then
+        #: never dispatched at all.  Plain scheduler state: flipping it
+        #: traces nothing.
+        self.hold_decode = False
         self.prefix_cache = bool(prefix_cache)
         self.spec_k = int(spec_k)
         from ..ops.paged_attention import resolve_attn_impl
@@ -1181,6 +1189,10 @@ class ServingEngine:
         return len(rids)
 
     def _decode_tick(self) -> int:
+        if self.hold_decode:
+            # disaggregated prefill tier: decoding is another replica's
+            # job — parked slots wait for the router's export
+            return 0
         if self.spec_k:
             return self._spec_decode_tick()
         mask, tables = self._masked(DECODE)
@@ -1847,6 +1859,221 @@ class ServingEngine:
             rids.append(rid)
         return rids
 
+    # ------------------------------------------------- cross-replica migration
+
+    def prefix_lookup(self, tokens: Sequence[int]) -> int:
+        """Prompt tokens of ``tokens`` already RESIDENT in this engine's
+        prefix cache (the longest content-hash-chained full-block match,
+        capped the way admission caps it: a whole-prompt hit still
+        recomputes its last token).  0 with the cache off — the router's
+        affinity signal, a pure host read with no side effects."""
+        if not self.prefix_cache:
+            return 0
+        hashes = self._prefix_hashes(tokens)
+        if not hashes:
+            return 0
+        n_hit = max(len(a.match(hashes)) for a in self._allocs)
+        return min(n_hit * self.block_size, max(0, len(tokens) - 1))
+
+    def decode_slots(self) -> List[Tuple[int, int]]:
+        """``(rid, slot)`` for every slot in the DECODE phase — what a
+        disaggregating router scans after a prefill tick to find requests
+        whose prefill just completed (first token sampled, KV fully
+        written) and are ready to hand off."""
+        return [(s.rid, i) for i, s in enumerate(self._slots)
+                if s.state == DECODE]
+
+    def export_slot(self, rid: int) -> Tuple[Dict[str, Any], Any]:
+        """Unwind one DECODE-state slot into a migration descriptor — the
+        drain descriptor (prompt, emitted tokens, sampling state, carried
+        PRNG key) EXTENDED with the device-side KV location: the slot's
+        block list, its committed length, and ``n_live`` (blocks holding
+        real KV — positions ``0..length-1``; trailing table blocks are
+        only budget).  Returns ``(desc, cache)`` where ``cache`` is the
+        engine's CURRENT pool value: jax arrays are immutable, so the
+        snapshot stays valid as a ``migrate_blocks`` source even after
+        this engine frees and reuses the blocks.  The slot is released
+        immediately (blocks freed refcount-aware, rows cleared) — the
+        request now lives only in the descriptor, which the router must
+        either import somewhere or resume (never both: the
+        block-conservation audit spans both allocators)."""
+        for i, s in enumerate(self._slots):
+            if s.state == DECODE and s.rid == rid:
+                break
+        else:
+            raise ValueError(
+                f"rid {rid} is not a decoding slot (only DECODE-state "
+                f"requests carry migratable KV — queued requests move "
+                f"KV-free via drain descriptors)")
+        length = int(self._lengths[i])
+        desc = self._descriptor(
+            s.req, emitted=s.generated,
+            key=np.array(self._keys[i], copy=True),
+            orig_prompt_len=s.orig_prompt_len, pre_gen=s.pre_gen)
+        desc.update({
+            "length": length,
+            "blocks": [int(b) for b in s.blocks],
+            "n_live": -(-length // self.block_size),
+            "t_submit": s.t_submit,
+            "ttft_s": s.ttft_s,
+            "tpot_s": [float(t) for t in s.tpot_s],
+        })
+        cache = self.cache  # immutable pool snapshot: the copy source
+        alloc = self._allocs[i // self.slots_per_group]
+        self._release_blocks(alloc, s.blocks)
+        self._clear_slot_rows(i)
+        self._inject.pop(s.rid, None)
+        self._ttft_pred.pop(s.rid, None)
+        s.reset()
+        self.stats["migrated_out"] += 1
+        return desc, cache
+
+    def import_slot(self, desc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Admit an :meth:`export_slot` descriptor directly into the
+        DECODE phase — no prefill: the KV content arrives by
+        ``migrate_blocks`` instead.  Finds a free slot, maps the longest
+        RESIDENT prefix of the full context (prompt + emitted, content-
+        hash chained — equal hash ⇒ equal KV, the prefix-cache argument)
+        via ``share`` so warm migrations only ship the tail, allocates
+        the remainder, and writes the slot rows (table, length, last
+        token, sampling params, carried key).  Shared blocks are safe
+        because every future write lands at positions ``>= length`` —
+        always past the matched full blocks.  Migrated full blocks are
+        registered so later same-prefix imports share instead of copying.
+
+        Returns ``{rid, slot, blocks, n_shared, n_live}`` — the caller
+        must copy src blocks ``[n_shared:n_live]`` onto dst blocks
+        ``[n_shared:n_live]`` (``migrate_blocks``) and install the
+        returned cache BEFORE this engine's next step.  ``None`` = no
+        capacity (free slot or blocks), nothing partially admitted."""
+        emitted = [int(t) for t in desc.get("emitted") or []]
+        if not emitted:
+            raise ValueError(
+                "import_slot needs an emitted prefix (a request with no "
+                "sampled token has no decode state — resume() it instead)")
+        if desc.get("key") is None:
+            raise ValueError("import_slot descriptor lacks the carried key")
+        prompt_full = [int(t) for t in desc["prompt"]] + emitted
+        remaining = int(desc["max_new_tokens"]) - len(emitted)
+        if remaining < 1:
+            raise ValueError(
+                f"descriptor has no budget left ({desc['max_new_tokens']} "
+                f"total, {len(emitted)} emitted) — it should have retired")
+        req = Request(
+            tokens=prompt_full,
+            max_new_tokens=remaining,
+            temperature=float(desc.get("temperature", 0.0)),
+            top_k=desc.get("top_k"),
+            top_p=desc.get("top_p"),
+            eos_id=desc.get("eos_id"),
+            seed=int(desc.get("seed", 0)),
+            priority=int(desc.get("priority", 0)),
+            deadline_s=desc.get("deadline_s"),
+        )
+        if len(prompt_full) + remaining > self.max_ctx:
+            raise ValueError(
+                f"context {len(prompt_full)} + remaining {remaining} "
+                f"exceeds max_ctx {self.max_ctx}")
+        # the committed KV length: the LAST emitted token's KV has not
+        # been written yet (the next decode step writes it at position
+        # ``length`` before attending — the engine's own accounting:
+        # lengths == admitted_prompt + generated - 1 while decoding)
+        length = int(desc["length"])
+        if length != len(prompt_full) - 1:
+            raise ValueError(
+                f"descriptor length {length} inconsistent with context "
+                f"{len(prompt_full)} (expect length == context - 1: the "
+                f"pending token's KV is not written yet)")
+        need = self._blocks_needed(req)
+        n_live = -(-length // self.block_size)
+        # affinity match over the WRITTEN context only: the pending
+        # token's position has no KV, so its (partial or full) block must
+        # never be taken from the cache
+        hashes = self._prefix_hashes(prompt_full[:length])
+        now = time.perf_counter()
+        for i, s in enumerate(self._slots):
+            if s.state != FREE:
+                continue
+            alloc = self._allocs[i // self.slots_per_group]
+            hit = alloc.match(hashes) if hashes else []
+            for b in hit:
+                alloc.share(b)
+            fresh = alloc.alloc(need - len(hit))
+            if fresh is None:
+                for b in hit:
+                    alloc.free([b])
+                continue
+            evicted = alloc.pop_evicted()
+            blocks = hit + fresh
+            rid = self._next_rid
+            self._next_rid += 1
+            self._seq[rid] = rid
+            s.state, s.rid = DECODE, rid
+            s.req = dataclasses.replace(req, rid=rid)
+            s.blocks = blocks
+            s.prompt = np.asarray(prompt_full, np.int32)
+            s.off = length
+            s.generated = []
+            s.t_submit = float(desc.get("t_submit", now))
+            s.t_admit = s.t_last = now
+            s.ttft_s = desc.get("ttft_s")
+            s.tpot_s = [float(t) for t in desc.get("tpot_s") or []]
+            s.orig_prompt_len = len(desc["prompt"])
+            s.pre_gen = len(emitted)
+            self._tables[i] = 0
+            self._tables[i, :need] = blocks
+            self._lengths[i] = length
+            self._last_tok[i] = emitted[-1]
+            self._temps[i] = req.temperature
+            self._top_k[i] = (
+                req.top_k if req.top_k is not None else self.cfg.vocab_size)
+            self._top_p[i] = req.top_p if req.top_p is not None else 1.0
+            self._keys[i] = np.asarray(desc["key"], np.uint32)
+            if self.prefix_cache:
+                # migrated FULL blocks now hold KV for their chain hashes:
+                # register so the next same-prefix import shares instead
+                # of copying (first registration wins, as in prefill)
+                for j, bh in enumerate(hashes):
+                    if j >= len(hit):
+                        alloc.register(blocks[j], bh)
+                self.stats["prefix_prompt_tokens"] += length
+                if hit:
+                    self.stats["prefix_hits"] += 1
+                    self.stats["prefix_cached_tokens"] += (
+                        len(hit) * self.block_size)
+            if evicted:
+                self.stats["cache_evictions"] += len(evicted)
+                self._ev.emit(
+                    "cache_evict", tick=self._tick, n_blocks=len(evicted),
+                    group=i // self.slots_per_group)
+            self.stats["migrated_in"] += 1
+            return {"rid": rid, "slot": i, "blocks": list(blocks),
+                    "n_shared": len(hit), "n_live": n_live}
+        return None
+
+    def steal_queued(self, max_n: int) -> List[Dict[str, Any]]:
+        """Pop up to ``max_n`` queued requests off the TAIL of the
+        priority order (youngest of the lowest class — the requests that
+        would wait longest here) into drain-style restartable descriptors
+        for KV-free cross-replica migration: the router ``resume()``s
+        them on a less-loaded replica with exact-parity replay (the PR-9
+        drain/resume contract).  Injection state (a previously resumed
+        request's carried key/prefix) travels in the descriptor."""
+        out: List[Dict[str, Any]] = []
+        while self.queue and len(out) < max_n:
+            req, _t = self.queue.pop()
+            inj = self._inject.pop(req.rid, None)
+            self._ttft_pred.pop(req.rid, None)
+            out.append(self._descriptor(
+                req, emitted=[],
+                key=(np.asarray(inj["key"], np.uint32)
+                     if inj and inj.get("key") is not None else None),
+                orig_prompt_len=(inj["orig_prompt_len"] if inj
+                                 else len(req.tokens)),
+                pre_gen=inj["pre_gen"] if inj else 0))
+            self.stats["migrated_out"] += 1
+        return out
+
     @staticmethod
     def _load_drain(path: str) -> Dict[str, Any]:
         import json
@@ -1883,7 +2110,8 @@ class ServingEngine:
                       "prefix_hits": 0, "prefix_cached_tokens": 0,
                       "prefix_prompt_tokens": 0, "cow_copies": 0,
                       "cache_evictions": 0,
-                      "spec_drafted": 0, "spec_accepted": 0}
+                      "spec_drafted": 0, "spec_accepted": 0,
+                      "migrated_in": 0, "migrated_out": 0}
         self._decode_sigs: set = set()
         self._prefill_sigs: set = set()
         self._cow_sigs: set = set()
@@ -2016,7 +2244,12 @@ class ServingEngine:
                          "shed": st["shed"], "expired": st["expired"],
                          "cancelled": st["cancelled"],
                          "preempted": st["preempted"],
-                         "resumed": st["resumed"]},
+                         "resumed": st["resumed"],
+                         # cross-replica migration traffic (router tier):
+                         # requests that left with their KV (export_slot /
+                         # steal_queued) and arrived with it (import_slot)
+                         "migrated_in": st["migrated_in"],
+                         "migrated_out": st["migrated_out"]},
             "generated_tokens": st["generated_tokens"],
             "tokens_per_sec": (
                 st["generated_tokens"] / span

@@ -378,6 +378,63 @@ def copy_blocks(cache: Dict[str, Any], src: jnp.ndarray,
     return jax.tree.map(cp, cache)
 
 
+def migrate_blocks(
+    src_cache: Dict[str, Any],
+    dst_cache: Dict[str, Any],
+    src_ids: jnp.ndarray,
+    dst_ids: jnp.ndarray,
+    compress: bool = False,
+) -> Dict[str, Any]:
+    """Cross-pool block copy: ``dst[:, dst_ids[i]] = src[:, src_ids[i]]``
+    for every leaf pair — :func:`copy_blocks` generalized from one pool to
+    two, the device half of a prefill→decode handoff or any cross-replica
+    KV migration (serving/router.py).  ``src_ids``/``dst_ids`` are
+    fixed-width int32 lane vectors so the copy is ONE compiled program per
+    (src, dst) pool pair whatever a migration needs moved; unused lanes
+    are padded ``NULL -> NULL`` (the write-off block is never read, so
+    colliding pad writes are harmless).  Returns the updated dst cache;
+    the src cache is read-only (jax arrays are immutable, so a snapshot
+    taken before the source engine reuses the blocks stays valid).
+
+    ``compress=True`` models the int8 WIRE format of a DCN-crossing
+    transfer on an fp pool: the payload is quantized per position-vector
+    (the ``_kv_quant`` scheme — exactly what an int8 block ring would
+    serialize) and dequantized into the destination's dtype, so the
+    destination holds what the compressed wire would have delivered.
+    Quantized ``(q8, scale)`` pools are ALREADY the wire format — their
+    pairs copy verbatim and ``compress`` changes nothing (bit-exact
+    migration either way)."""
+    # a quantized pool's leaves are (q8, scale) pairs — already the wire
+    # format; its f32 scale sideband must never be re-quantized
+    compress = compress and not isinstance(dst_cache["k"], tuple)
+
+    def cp(s_leaf, d_leaf):
+        payload = s_leaf[:, src_ids]
+        if compress:
+            q, scale = _kv_quant(payload)
+            payload = q.astype(jnp.float32) * scale[..., None]
+        return d_leaf.at[:, dst_ids].set(payload.astype(d_leaf.dtype))
+
+    return jax.tree.map(cp, src_cache, dst_cache)
+
+
+def migration_wire_bytes(
+    cfg: GPTConfig, n_blocks: int, block_size: int, axis_size: int = 1,
+    quantized: bool = False, compressed: bool = False,
+) -> int:
+    """Bytes a migration of ``n_blocks`` pool blocks puts on the wire:
+    the k+v payload of the blocks in the pool's storage format
+    (``quantized`` pools ship their int8 pairs verbatim), or the int8
+    ``(q8, scale)`` wire format when ``compressed`` — the quantity the
+    router prices through ``CommModel`` and reports as
+    ``migration_bytes``."""
+    if n_blocks <= 0:
+        return 0
+    return expected_pool_bytes(
+        cfg, n_blocks, block_size, axis_size=axis_size,
+        quantized=quantized or compressed)
+
+
 def chain_block_hashes(tokens, block_size: int) -> List[Any]:
     """Per-full-block content hashes, chained from position 0 (vLLM
     style): ``h_i = H(h_{i-1}, tokens[i*bs:(i+1)*bs])``, so a hash names

@@ -1,0 +1,685 @@
+"""Multi-replica serving router: prefix-affinity routing, prefill/decode
+disaggregation, and cross-replica KV migration.
+
+One :class:`~.engine.ServingEngine` is one saturation point; a
+million-user deployment is N of them.  The :class:`Router` is the host
+tier that owns N replicas and makes them behave like one bigger, smarter
+engine, built entirely from primitives the engines already prove:
+
+- **Prefix-affinity routing.**  Every submit hashes the prompt's
+  full-block chain prefix (``chain_block_hashes`` — the PR-10 prefix
+  index) and prefers the replica whose prefix cache owns the LONGEST
+  resident match (:meth:`ServingEngine.prefix_lookup`): warm
+  shared-system-prompt traffic keeps landing where its KV already lives,
+  so the fleet prefills each prefix once per REPLICA-that-needs-it
+  instead of once per request.  Ties (and cold traffic) fall to the load
+  signal: warm-aware :meth:`~.engine.ServingEngine.estimate_ttft` —
+  which already folds in the PR-11 TTFT calibration bias, so the router
+  inherits each replica's self-correcting latency model — then queue
+  depth.  A replica that SHEDS the submit (bounded queue, deadline gate,
+  draining) is not the end: the router retries the next-best replica and
+  only records a router-level rejection when every candidate refused
+  (``request_routed`` / the rejection verdict carry the whole story).
+- **Rebalancing (KV-free).**  When a replica degrades — its verdict goes
+  ``overloaded`` (new shed/expired demand) or its queue runs
+  ``rebalance_watermark`` deeper than the shallowest peer — the router
+  moves QUEUED requests off it with
+  :meth:`~.engine.ServingEngine.steal_queued` →
+  :meth:`~.engine.ServingEngine.resume` on the target: the PR-9 drain
+  descriptor is an exact-parity request-migration format (replay is
+  deterministic), so a moved request's tokens BIT-equal its unmoved run.
+  ``replica_degraded`` / ``request_migrated`` events are the evidence.
+- **Prefill/decode disaggregation (DistServe-style).**  Replicas carry a
+  role: ``'prefill'`` replicas admit and run chunked prefill to
+  completion (first token sampled — TTFT stops ticking there), then the
+  router hands the request to a ``'decode'`` replica by migrating the
+  paged KV blocks themselves: :meth:`~.engine.ServingEngine.export_slot`
+  (descriptor + immutable pool snapshot) →
+  :meth:`~.engine.ServingEngine.import_slot` (decode-phase admission, no
+  prefill) → :func:`~.paged_cache.migrate_blocks` (the ``copy_blocks``
+  NULL-padded-lane idiom generalized across pools, ONE fixed-signature
+  compiled program per replica pair).  Imports match the full context's
+  chain hashes against the target's prefix cache first, so a warm
+  handoff ships only the unique TAIL blocks — affinity applies to the
+  migration leg too, and migrated full blocks register on arrival so the
+  next same-prefix handoff ships even less.  Decode replicas never
+  prefill, prefill replicas never decode (asserted in tests): each
+  tier's compiled program stays sized for its own phase.
+- **Migration pricing (the comm-model loop).**  A ``comm_model`` plus
+  per-replica ``zones`` price every migration leg: same-zone (ICI-ish)
+  legs ship the pool's native format; a DCN-crossing leg is scored
+  through ``CommModel.predict_compressed`` (the migration is one
+  all-gather hop of the block payload across the 2-member src/dst pair —
+  the EQuARX int8-ring lineage the PR-8 collectives calibrated) and
+  ships the int8 ``(q8, scale)`` wire format when the model approves
+  (``migrate_blocks(compress=True)``).  int8 pools are already the wire
+  format and migrate bit-exactly either way; fp-pool compression trades
+  exactness for wire bytes only where the calibrated model says the
+  trade wins (``blocks_migrated`` records the decision and both
+  predictions).
+- **Replica failure.**  ``evacuate_on_fault=True`` turns a replica's
+  fault evidence (``faults_detected`` moving — the chaos
+  ``ENGINE_FAULT_KINDS`` drive exactly this) into an evacuation: the
+  replica is drained (queue + in-flight → descriptors), taken out of
+  rotation, and every descriptor resumes on the surviving replicas —
+  temp-0 token streams BIT-equal the unfaulted run (the PR-9 resume
+  parity), audit green throughout.
+- **Audit across allocators.**  :meth:`Router.audit` runs every
+  replica's block-conservation audit plus the cross-replica invariant a
+  migration could break: a router-tracked request is live on AT MOST ONE
+  replica (a double-owned request would decode twice and double-free
+  blocks).  The engines' per-tick self-audits keep running untouched.
+
+Everything here is host-side scheduler code: no new traced values, no
+new per-engine signatures — each replica's ``decode_signatures`` stays 1
+through routing, rebalancing, handoff, and evacuation (asserted), and
+the only new compiled program is the per-pair ``migrate_blocks`` copy.
+:meth:`Router.summary` is the RUNREPORT ``router`` section: every
+replica's full ``serving_summary()`` plus the validated fleet roll-up
+(fleet tokens/s + goodput, affinity hit rate, migration count/bytes,
+rebalance/evacuation counts, per-replica verdicts) —
+``obs.report._validate_router`` checks it, ``decode_bench --router``
+measures it against one big engine at equal total slots.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs.events import EventLog, default_event_log
+from .engine import DRAIN_SCHEMA, Request, ServingEngine
+from .paged_cache import migrate_blocks, migration_wire_bytes
+
+#: Replica roles.  ``'both'`` replicas admit, prefill, and decode (the
+#: pure-routing fleet); ``'prefill'`` replicas admit + prefill and hand
+#: every request off at its first token; ``'decode'`` replicas only ever
+#: receive imports.
+ROLES = ("both", "prefill", "decode")
+
+# fleet verdict = the worst replica verdict under this ordering
+_VERDICT_RANK = {"healthy": 0, "degraded": 1, "overloaded": 2}
+
+
+class Router:
+    """Host-side router over N :class:`~.engine.ServingEngine` replicas —
+    see the module docstring for the design.  Typical driver::
+
+        router = Router([eng_a, eng_b], telemetry=tel)
+        rid = router.submit(Request(prompt_ids, max_new_tokens=64))
+        router.run_until_idle()
+        out = router.finished[rid]["tokens"]
+        tel.record_router(router.summary())
+
+    Parameters
+    ----------
+    replicas: the engine replicas.  Migration requires identical
+        geometry (block_size / max_blocks / kv_quant / spec_k) — checked.
+    roles: per-replica role in :data:`ROLES` (default all ``'both'``).
+        Any ``'prefill'`` replica requires at least one import-capable
+        (``'decode'`` or ``'both'``) peer.
+    zones: per-replica placement label (default all ``'local'``).  A
+        migration between different zones is DCN-crossing: priced through
+        ``comm_model.predict_compressed`` and shipped int8 when approved.
+    comm_model: an ``obs.CommModel`` for migration pricing; None =
+        never compress, no pricing recorded.
+    dcn_axis: the comm-model axis name the DCN leg is priced on
+        (default ``'dcn'`` — calibrate or table that axis).
+    rebalance_every: router ticks between queue-depth rebalance scans
+        (degradation-triggered rebalances run every tick regardless).
+    rebalance_watermark: queue-depth spread (deepest - shallowest) that
+        triggers a rebalance.
+    evacuate_on_fault: drain-and-redistribute a replica whose
+        ``faults_detected`` counter moves (the chaos / dead-replica
+        policy).  Off by default: the engines self-heal routine faults.
+    telemetry: an ``obs.Telemetry`` — router events land on its timeline.
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[ServingEngine],
+        *,
+        roles: Optional[Sequence[str]] = None,
+        zones: Optional[Sequence[str]] = None,
+        comm_model: Optional[Any] = None,
+        dcn_axis: str = "dcn",
+        rebalance_every: int = 8,
+        rebalance_watermark: int = 4,
+        evacuate_on_fault: bool = False,
+        telemetry: Optional[Any] = None,
+    ) -> None:
+        if not replicas:
+            raise ValueError("Router needs at least one replica")
+        self.replicas: List[ServingEngine] = list(replicas)
+        n = len(self.replicas)
+        self.roles = list(roles) if roles is not None else ["both"] * n
+        if len(self.roles) != n or any(r not in ROLES for r in self.roles):
+            raise ValueError(
+                f"roles must be {n} of {ROLES}, got {self.roles}")
+        if "prefill" in self.roles and not any(
+                r in ("both", "decode") for r in self.roles):
+            raise ValueError(
+                "a 'prefill' replica needs a 'decode'/'both' peer to hand "
+                "off to")
+        self.zones = list(zones) if zones is not None else ["local"] * n
+        if len(self.zones) != n:
+            raise ValueError(f"zones must have {n} entries")
+        ref = self.replicas[0]
+        for i, r in enumerate(self.replicas):
+            if (r.block_size, r.max_blocks, r.kv_quant, r.spec_k) != (
+                    ref.block_size, ref.max_blocks, ref.kv_quant,
+                    ref.spec_k):
+                raise ValueError(
+                    f"replica {i} geometry (block_size/max_blocks/kv_quant/"
+                    f"spec_k) differs from replica 0 — KV migration needs "
+                    f"identical pool geometry")
+        self.comm_model = comm_model
+        self.dcn_axis = dcn_axis
+        self.rebalance_every = int(rebalance_every)
+        self.rebalance_watermark = int(rebalance_watermark)
+        self.evacuate_on_fault = bool(evacuate_on_fault)
+        self.telemetry = telemetry
+        self._ev: EventLog = (
+            telemetry.events if telemetry is not None else
+            default_event_log())
+        self.alive = [True] * n
+        for i, role in enumerate(self.roles):
+            # the prefill tier never dispatches its decode program: slots
+            # that finish prefill PARK (first token sampled, KV complete)
+            # until the handoff exports them — engine.hold_decode
+            self.replicas[i].hold_decode = role == "prefill"
+        #: compiled migrate_blocks programs, one per ((src, dst), compress)
+        self._mig_fns: Dict[Tuple[int, int, bool], Any] = {}
+        self.reset_metrics()
+
+    # ------------------------------------------------------------- bookkeeping
+
+    def reset_metrics(self) -> None:
+        """Zero router counters and every replica's serving metrics (the
+        bench warmup/measure split); compiled programs, prefix caches,
+        and rid counters survive."""
+        for r in self.replicas:
+            r.reset_metrics()
+        self._next_rid = getattr(self, "_next_rid", 0)
+        #: (replica_idx, replica_rid) -> router rid, across migrations
+        self._map: Dict[Tuple[int, int], int] = {}
+        self.finished: Dict[int, Dict[str, Any]] = {}
+        self.rejected: Dict[int, Dict[str, Any]] = {}
+        self._consumed: List[set] = [set() for _ in self.replicas]
+        self._rejected_seen: List[set] = [set() for _ in self.replicas]
+        self._last_faults = [0] * len(self.replicas)
+        self._last_refused = [0] * len(self.replicas)
+        self._tick = 0
+        self._t_first = float("inf")
+        self._t_last_done = 0.0
+        self.stats = {
+            "routed": 0, "affinity_routed": 0, "router_shed": 0,
+            "fallbacks": 0, "rebalances": 0, "rebalanced_requests": 0,
+            "evacuations": 0, "evacuated_requests": 0,
+            "handoffs": 0, "handoffs_deferred": 0,
+            "migration_blocks": 0, "migration_shared_blocks": 0,
+            "migration_bytes": 0, "migrations_compressed": 0,
+        }
+
+    def _track(self, replica: int, replica_rid: int, router_rid: int) -> None:
+        self._map[(replica, replica_rid)] = router_rid
+
+    def _submit_targets(self) -> List[int]:
+        return [i for i, role in enumerate(self.roles)
+                if self.alive[i] and role in ("both", "prefill")]
+
+    def _import_targets(self, exclude: int) -> List[int]:
+        return [i for i, role in enumerate(self.roles)
+                if self.alive[i] and i != exclude
+                and role in ("both", "decode")]
+
+    # ------------------------------------------------------------------ submit
+
+    def _score(self, i: int, tokens: Sequence[int]) -> Tuple:
+        """Routing sort key for replica ``i`` (smaller = better): longest
+        resident prefix first (negated), then the replica's own biased
+        TTFT estimate (None = unmeasured = 0: no evidence to avoid it
+        on), then queue depth + busy slots, then index (determinism)."""
+        r = self.replicas[i]
+        aff = r.prefix_lookup(tokens)
+        est = r.estimate_ttft(len(tokens), tokens=tokens)
+        return (-aff, est if est is not None else 0.0,
+                len(r.queue) + r.n_busy, i)
+
+    def submit(self, req: Request) -> int:
+        """Route one request: candidates ranked by (affinity, estimated
+        TTFT, load), tried best-first; a replica that sheds falls through
+        to the next.  Returns the ROUTER rid; if every candidate refused,
+        the last structured verdict lands in ``self.rejected[rid]``."""
+        rid = self._next_rid
+        self._next_rid += 1
+        targets = self._submit_targets()
+        if not targets:
+            self.stats["router_shed"] += 1
+            self.rejected[rid] = {"rid": rid, "reason": "no_replicas"}
+            return rid
+        scored = sorted(targets, key=lambda i: self._score(i, req.tokens))
+        last_verdict: Dict[str, Any] = {}
+        for rank, i in enumerate(scored):
+            r = self.replicas[i]
+            aff = r.prefix_lookup(req.tokens)
+            rrid = r.submit(req)
+            if rrid in r.rejected:
+                last_verdict = dict(r.rejected[rrid], replica=i)
+                continue
+            self._track(i, rrid, rid)
+            self.stats["routed"] += 1
+            if aff > 0:
+                self.stats["affinity_routed"] += 1
+            if rank > 0:
+                self.stats["fallbacks"] += 1
+            est = r.estimate_ttft(len(req.tokens), tokens=req.tokens)
+            self._ev.emit(
+                "request_routed", rid=rid, replica=i, replica_rid=rrid,
+                affinity_tokens=int(aff), fallback_rank=rank,
+                est_ttft_s=round(est, 6) if est is not None else None,
+                queue_depth=len(r.queue))
+            return rid
+        self.stats["router_shed"] += 1
+        self.rejected[rid] = dict(last_verdict, rid=rid,
+                                  reason=last_verdict.get("reason", "shed"),
+                                  routed=False)
+        return rid
+
+    # --------------------------------------------------------------- migration
+
+    def _mig_fn(self, src: int, dst: int, compress: bool):
+        """The compiled cross-pool copy for replica pair (src, dst) —
+        fixed-signature lanes ([max_blocks] int32, NULL-padded), compiled
+        once per (pair, wire-format); its signature count is the router's
+        compile-once evidence (``summary()['fleet']['migrations']``)."""
+        key = (src, dst, compress)
+        fn = self._mig_fns.get(key)
+        if fn is None:
+            import jax
+
+            fn = jax.jit(
+                lambda s, d, si, di: migrate_blocks(
+                    s, d, si, di, compress=compress))
+            self._mig_fns[key] = fn
+        return fn
+
+    def _price_migration(self, src: int, dst: int,
+                         n_blocks: int) -> Dict[str, Any]:
+        """Price one migration leg and decide its wire format.  Same-zone
+        legs ship the pool format; a zone-crossing leg is scored through
+        ``CommModel.predict_compressed`` on the DCN axis (the leg is one
+        all-gather hop of the block payload across the 2-member src/dst
+        pair) and ships int8 iff the model approves.  int8 pools are
+        already wire-compressed — nothing to decide."""
+        ref = self.replicas[0]
+        fp_bytes = migration_wire_bytes(
+            ref.cfg, n_blocks, ref.block_size, quantized=ref.kv_quant)
+        out: Dict[str, Any] = {
+            "compress": False, "wire_bytes": fp_bytes, "basis": None,
+            "dcn_crossing": self.zones[src] != self.zones[dst],
+        }
+        if (not out["dcn_crossing"] or self.comm_model is None
+                or ref.kv_quant or n_blocks == 0):
+            return out
+        pred = self.comm_model.predict_compressed(
+            "all_gather", float(fp_bytes), 2, axes=(self.dcn_axis,))
+        out.update(
+            pred_exact_s=round(pred["exact_s"], 9),
+            pred_compressed_s=round(pred["compressed_s"], 9),
+            basis=pred["basis"],
+        )
+        if pred["compress"]:
+            out["compress"] = True
+            out["wire_bytes"] = migration_wire_bytes(
+                ref.cfg, n_blocks, ref.block_size, compressed=True)
+        return out
+
+    def _handoff(self, src: int, rid: int) -> bool:
+        """Move one just-prefilled (or decoding) request from replica
+        ``src`` to the best import target: export → import (prefix-
+        matched on arrival) → ``migrate_blocks`` of the unshared live
+        tail.  Returns False (and leaves the request where it is) when no
+        target has capacity."""
+        p = self.replicas[src]
+        slot = next((s for s in p._slots
+                     if s.state == "decode" and s.rid == rid), None)
+        if slot is None:
+            return False
+        tokens_full = [int(t) for t in slot.prompt] + list(slot.generated)
+        need = len(slot.blocks)
+        targets = sorted(
+            self._import_targets(src),
+            key=lambda i: (-self.replicas[i].prefix_lookup(tokens_full),
+                           len(self.replicas[i].queue)
+                           + self.replicas[i].n_busy, i))
+        dst = next(
+            (i for i in targets
+             if any(s.state == "free" for s in self.replicas[i]._slots)
+             and all(a.n_free + a.n_cached >= need
+                     for a in self.replicas[i]._allocs)),
+            None)
+        if dst is None:
+            self.stats["handoffs_deferred"] += 1
+            return False
+        desc, src_cache = p.export_slot(rid)
+        d = self.replicas[dst]
+        res = d.import_slot(desc)
+        if res is None:  # capacity raced away: put it back where it was
+            res = p.import_slot(desc)
+            assert res is not None, "export_slot freed this capacity"
+            dst, d = src, p
+        router_rid = self._map.get((src, rid), -1)
+        self._track(dst, res["rid"], router_rid)
+        n_mig = res["n_live"] - res["n_shared"]
+        price = self._price_migration(src, dst, n_mig)
+        if n_mig > 0:
+            ref = self.replicas[0]
+            lanes_src = np.zeros(ref.max_blocks, np.int32)
+            lanes_dst = np.zeros(ref.max_blocks, np.int32)
+            lanes_src[:n_mig] = desc["blocks"][res["n_shared"]:res["n_live"]]
+            lanes_dst[:n_mig] = res["blocks"][res["n_shared"]:res["n_live"]]
+            fn = self._mig_fn(src, dst, price["compress"])
+            d.cache = fn(src_cache, d.cache, lanes_src, lanes_dst)
+        self.stats["handoffs"] += 1
+        self.stats["migration_blocks"] += n_mig
+        self.stats["migration_shared_blocks"] += res["n_shared"]
+        self.stats["migration_bytes"] += (
+            price["wire_bytes"] if n_mig > 0 else 0)
+        if price["compress"]:
+            self.stats["migrations_compressed"] += 1
+        self._ev.emit(
+            "blocks_migrated", rid=router_rid, src_replica=src,
+            dst_replica=dst, n_blocks=n_mig, n_shared=res["n_shared"],
+            bytes=int(price["wire_bytes"]) if n_mig > 0 else 0,
+            compressed=price["compress"], dcn=price["dcn_crossing"],
+            basis=price.get("basis"),
+            pred_exact_s=price.get("pred_exact_s"),
+            pred_compressed_s=price.get("pred_compressed_s"))
+        self._ev.emit(
+            "request_migrated", rid=router_rid, src_replica=src,
+            dst_replica=dst, mode="prefill_handoff",
+            emitted_tokens=len(desc.get("emitted") or []))
+        return True
+
+    def _resume_descs(self, descs: List[Dict[str, Any]], exclude: int,
+                      kind: str) -> int:
+        """Resume drain descriptors onto the least-loaded surviving
+        replicas (affinity-ranked per descriptor), bouncing a shed
+        descriptor to the next candidate; a descriptor every survivor
+        refused becomes a router-level rejection.  Returns how many
+        landed."""
+        landed = 0
+        for desc in descs:
+            tokens_full = ([int(t) for t in desc["prompt"]]
+                           + [int(t) for t in desc.get("emitted") or []])
+            router_rid = self._map.get((exclude, desc.get("orig_rid", -1)))
+            if router_rid is None:
+                router_rid = self._next_rid
+                self._next_rid += 1
+            targets = sorted(
+                (i for i in self._submit_targets() if i != exclude),
+                key=lambda i: self._score(i, tokens_full))
+            placed = False
+            for i in targets:
+                r = self.replicas[i]
+                (rrid,) = r.resume(
+                    {"schema": DRAIN_SCHEMA, "n": 1, "requests": [desc]})
+                if rrid in r.rejected:
+                    continue
+                self._track(i, rrid, router_rid)
+                self._ev.emit(
+                    "request_migrated", rid=router_rid,
+                    src_replica=exclude, dst_replica=i, mode=kind,
+                    emitted_tokens=len(desc.get("emitted") or []))
+                landed += 1
+                placed = True
+                break
+            if not placed:
+                self.stats["router_shed"] += 1
+                self.rejected[router_rid] = {
+                    "rid": router_rid, "reason": "migration_shed",
+                    "kind": kind, "src_replica": exclude}
+        return landed
+
+    def rebalance(self, src: int) -> int:
+        """Move queued work off replica ``src``: steal the tail of its
+        queue (half the depth spread, at least 1) and resume it on the
+        best surviving replicas.  KV-free, exact-parity (the PR-9
+        drain/resume contract).  Returns requests moved."""
+        depths = [len(self.replicas[i].queue)
+                  for i in self._submit_targets()]
+        if not depths:
+            return 0
+        spread = len(self.replicas[src].queue) - min(depths)
+        n = max(1, spread // 2)
+        descs = self.replicas[src].steal_queued(n)
+        if not descs:
+            return 0
+        moved = self._resume_descs(descs, src, "rebalance")
+        self.stats["rebalances"] += 1
+        self.stats["rebalanced_requests"] += moved
+        return moved
+
+    def evacuate(self, i: int, reason: str = "manual") -> int:
+        """Kill replica ``i``: drain it (queue + in-flight unwound into
+        exact-parity descriptors), take it out of rotation, and resume
+        everything on the survivors.  Returns requests rehomed."""
+        self._ev.emit("replica_degraded", replica=i, reason=reason,
+                      action="evacuate",
+                      faults=self.replicas[i].stats["faults_detected"],
+                      queued=len(self.replicas[i].queue),
+                      in_flight=self.replicas[i].n_busy)
+        payload = self.replicas[i].drain()
+        self.alive[i] = False
+        moved = self._resume_descs(payload["requests"], i, "evacuation")
+        self.stats["evacuations"] += 1
+        self.stats["evacuated_requests"] += moved
+        return moved
+
+    # ------------------------------------------------------------------- ticks
+
+    def _health_scan(self) -> None:
+        """Per-tick degradation watch: a replica whose fault counter
+        moved is evacuated when the policy says so; new refused demand
+        (shed/expired — the 'overloaded' verdict evidence) triggers an
+        immediate KV-free rebalance of its queue."""
+        for i, r in enumerate(self.replicas):
+            if not self.alive[i]:
+                continue
+            faults = r.stats["faults_detected"]
+            refused = r.stats["shed"] + r.stats["expired"]
+            if faults > self._last_faults[i] and self.evacuate_on_fault:
+                self._last_faults[i] = faults
+                self.evacuate(i, reason="faults_detected")
+                continue
+            if faults > self._last_faults[i]:
+                self._ev.emit(
+                    "replica_degraded", replica=i, reason="faults_detected",
+                    action="observed", faults=faults)
+            self._last_faults[i] = faults
+            if refused > self._last_refused[i] and r.queue and len(
+                    self._submit_targets()) > 1:
+                self._ev.emit(
+                    "replica_degraded", replica=i, reason="overloaded",
+                    action="rebalance",
+                    shed=r.stats["shed"], expired=r.stats["expired"])
+                self.rebalance(i)
+            self._last_refused[i] = refused
+
+    def _watermark_scan(self) -> None:
+        targets = self._submit_targets()
+        if len(targets) < 2:
+            return
+        depths = {i: len(self.replicas[i].queue) for i in targets}
+        deepest = max(depths, key=lambda i: depths[i])
+        if depths[deepest] - min(depths.values()) > self.rebalance_watermark:
+            self.rebalance(deepest)
+
+    def _collect(self) -> None:
+        for i, r in enumerate(self.replicas):
+            for rrid, rec in r.finished.items():
+                if rrid in self._consumed[i]:
+                    continue
+                self._consumed[i].add(rrid)
+                router_rid = self._map.get((i, rrid))
+                if router_rid is None:
+                    continue  # warmup traffic submitted around the router
+                self.finished[router_rid] = dict(rec, replica=i,
+                                                 rid=router_rid)
+                self._t_first = min(self._t_first, rec["t_submit"])
+                self._t_last_done = max(self._t_last_done, rec["t_done"])
+            for rrid, verdict in r.rejected.items():
+                if rrid in self._rejected_seen[i]:
+                    continue
+                self._rejected_seen[i].add(rrid)
+                router_rid = self._map.get((i, rrid))
+                if router_rid is not None and router_rid not in self.finished:
+                    # a replica refused AFTER admission routing (queued
+                    # deadline expiry): surface it at the router level
+                    self.rejected[router_rid] = dict(verdict, replica=i,
+                                                     rid=router_rid)
+
+    def step(self) -> Dict[str, int]:
+        """One fleet tick: health/degradation scan → (periodic) queue
+        rebalance → step every replica that has work → disaggregation
+        handoffs off the prefill tier → collect finished/rejected.
+        Idle replicas are NOT stepped — fleet cost tracks live load, not
+        fleet size."""
+        self._tick += 1
+        self._health_scan()
+        if self.rebalance_every and self._tick % self.rebalance_every == 0:
+            self._watermark_scan()
+        stepped = busy = 0
+        for i, r in enumerate(self.replicas):
+            if not self.alive[i] or not (r.queue or r.n_busy):
+                continue
+            r.step()
+            stepped += 1
+            if self.roles[i] == "prefill":
+                for rid, _slot in r.decode_slots():
+                    self._handoff(i, rid)
+            busy += r.n_busy
+        self._collect()
+        return {"stepped": stepped, "busy": busy,
+                "queued": sum(len(r.queue) for r in self.replicas)}
+
+    @property
+    def n_busy(self) -> int:
+        return sum(r.n_busy for i, r in enumerate(self.replicas)
+                   if self.alive[i])
+
+    def has_work(self) -> bool:
+        return any(self.alive[i] and (r.queue or r.n_busy)
+                   for i, r in enumerate(self.replicas))
+
+    def run_until_idle(self, max_ticks: int = 100_000) -> None:
+        while self.has_work():
+            self.step()
+            if self._tick > max_ticks:
+                raise RuntimeError(
+                    f"fleet did not drain within {max_ticks} ticks")
+
+    # ------------------------------------------------------------------- audit
+
+    def audit(self) -> Dict[str, Any]:
+        """The cross-replica conservation audit: every replica's own
+        block audit (heal=False — pure report) PLUS the invariant only a
+        migration could break: each router-tracked request is live
+        (queued or in a slot) on AT MOST one replica.  A double-owned
+        request means an export/import or drain/resume landed twice —
+        its two copies would both decode and both free blocks."""
+        violations: List[Dict[str, Any]] = []
+        per_replica = []
+        for i, r in enumerate(self.replicas):
+            rep = r.audit(heal=False)
+            per_replica.append(rep)
+            if not rep["ok"]:
+                violations.append(
+                    {"kind": "replica_audit", "replica": i,
+                     "violations": rep["violations"]})
+        live: Dict[int, List[int]] = {}
+        for i, r in enumerate(self.replicas):
+            rids = {req.rid for req, _t in r.queue}
+            rids |= {s.rid for s in r._slots if s.state != "free"}
+            for rrid in rids:
+                router_rid = self._map.get((i, rrid))
+                if router_rid is not None:
+                    live.setdefault(router_rid, []).append(i)
+        for router_rid, where in live.items():
+            if len(where) > 1:
+                violations.append({"kind": "double_owned",
+                                   "rid": router_rid, "replicas": where})
+        return {"ok": not violations, "violations": violations,
+                "per_replica": per_replica}
+
+    # ----------------------------------------------------------------- summary
+
+    def summary(self) -> Dict[str, Any]:
+        """The RUNREPORT ``router`` section
+        (``Telemetry.record_router`` attaches it,
+        ``obs.report._validate_router`` checks it): one full
+        ``serving_summary()`` per replica (tagged with index / role /
+        zone / liveness) and the fleet roll-up — fleet tokens/s and
+        goodput over the ROUTER's span (necessarily ≤ the sum of
+        replica rates, which validation enforces), affinity hit rate,
+        migration count/bytes, rebalance/evacuation counts, and the
+        per-replica verdict list."""
+        replicas = []
+        for i, r in enumerate(self.replicas):
+            s = r.serving_summary()
+            replicas.append(dict(s, index=i, role=self.roles[i],
+                                 zone=self.zones[i], alive=self.alive[i]))
+        span = self._t_last_done - self._t_first
+        gen = sum(r["generated_tokens"] for r in replicas)
+        goodput_tokens = sum(
+            (r.get("slo") or {}).get("goodput_tokens", 0) for r in replicas)
+        met = demand = 0
+        for r in replicas:
+            for row in ((r.get("slo") or {}).get("priorities") or {}).values():
+                met += row.get("met", 0)
+                demand += (row.get("completed", 0) + row.get("shed", 0)
+                           + row.get("expired", 0))
+        st = self.stats
+        verdicts = [r["verdict"] for r in replicas]
+        fleet_verdict = max(verdicts, key=lambda v: _VERDICT_RANK[v])
+        if not all(self.alive):
+            fleet_verdict = max(fleet_verdict, "degraded",
+                                key=lambda v: _VERDICT_RANK[v])
+        fleet = {
+            "n_replicas": len(self.replicas),
+            "n_alive": sum(self.alive),
+            "verdict": fleet_verdict,
+            "verdicts": verdicts,
+            "generated_tokens": gen,
+            "tokens_per_sec": (gen / span if span > 0 and gen else 0.0),
+            "goodput_tokens": goodput_tokens,
+            "goodput_tok_s": (
+                goodput_tokens / span if span > 0 and gen else 0.0),
+            "attainment": round(met / demand, 4) if demand else None,
+            "affinity": {
+                "routed": st["routed"],
+                "affinity_routed": st["affinity_routed"],
+                "hit_rate": (st["affinity_routed"] / st["routed"]
+                             if st["routed"] else 0.0),
+                "fallbacks": st["fallbacks"],
+                "router_shed": st["router_shed"],
+            },
+            "rebalances": st["rebalances"],
+            "rebalanced_requests": st["rebalanced_requests"],
+            "evacuations": st["evacuations"],
+            "evacuated_requests": st["evacuated_requests"],
+            "migrations": {
+                "handoffs": st["handoffs"],
+                "deferred": st["handoffs_deferred"],
+                "blocks": st["migration_blocks"],
+                "shared_blocks": st["migration_shared_blocks"],
+                "bytes": st["migration_bytes"],
+                "compressed": st["migrations_compressed"],
+                # compile-once evidence for the migration tier: one
+                # program per (replica pair, wire format) ever compiled
+                "signatures": len(self._mig_fns),
+            },
+        }
+        return {"replicas": replicas, "fleet": fleet}

@@ -65,12 +65,20 @@ from typing import Any, Dict, List, Tuple
 #: (``1f1b`` vs ``zb``) produced the number — a throughput hold whose
 #: bubble fraction crept back up (or whose arm silently flipped back to
 #: classic 1F1B) is visible next to the tokens/s it costs.
+#: ``fleet_goodput_tok_s`` / ``affinity_hit_rate`` / ``migration_bytes``
+#: (PR 15) ride the ``serve-router-fleet`` line: the fleet's headline
+#: tokens/s gates (``value``), and these columns show HOW it was earned —
+#: a throughput hold with a collapsed affinity hit rate means warm
+#: traffic stopped landing on its KV (the routing policy rotting), and
+#: ballooning migration bytes mean the disaggregation tier started
+#: shipping whole contexts instead of tails.
 AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
             "grad_norm_final", "comm_bytes_per_dim", "shed_rate",
             "preempt_count", "prefix_hit_rate", "spec_accept_rate",
             "slo_attainment", "goodput_tok_s", "paged_pallas_tok_s",
             "autoplan_tok_s", "plan_modeled_step_s", "bubble_fraction",
-            "plan_pp_schedule")
+            "plan_pp_schedule", "fleet_goodput_tok_s", "affinity_hit_rate",
+            "migration_bytes")
 
 
 def _aux_str(key: str, val: Any) -> str:

@@ -55,6 +55,18 @@ BIT-parity asserted between the arms (paired ``serve-spec-{off,on}``
 lines; ``prefix_hit_rate`` / ``spec_accept_rate`` ride the trend's aux
 columns).  CPU-sim rows in docs/BENCH_AB.md.
 
+``--serve --router R`` adds the multi-replica router A/B (docs/serving.md
+"Multi-replica routing and disaggregation"): the same fixed-seed
+shared-prefix trace, replayed as a concurrency-capped closed loop,
+through ONE big engine vs a disaggregated fleet (1 prefill tier + R-1
+decode replicas, prefix-affinity routing + KV-block handoffs) at equal
+total slots — paired ``serve-router-{mono,fleet}`` lines at equal
+``config_hash`` (aggregate tok/s, per-priority p99 TTFT, migration
+count/bytes; ``fleet_goodput_tok_s`` / ``affinity_hit_rate`` /
+``migration_bytes`` ride the trend's aux columns), the
+``serve-router-ab`` roll-up, and the validated RUNREPORT ``router``
+section.
+
 ``--trace out.json`` additionally prints the comm-ledger summary of the
 compiled decode step (one extra AOT compile) and writes the run's
 Perfetto-loadable Chrome trace — cells appear as instant events on the
@@ -558,6 +570,194 @@ def bench_serve_spec(jax, jnp, cfg, params, tel, *, spec_k, n_requests,
     return on_s
 
 
+def bench_serve_router(jax, jnp, cfg, params, tel, *, n_replicas,
+                       n_requests, num_slots, block_size, chunk, seed,
+                       smoke):
+    """The multi-replica router A/B (docs/serving.md "Multi-replica
+    routing and disaggregation"): the same fixed-seed shared-prefix
+    trace through ONE big engine (``num_slots * n_replicas`` slots, the
+    mono arm) and through a disaggregated fleet at EQUAL TOTAL SLOTS —
+    one prefill-tier replica feeding ``n_replicas - 1`` decode replicas,
+    prefix-affinity routing + KV-block handoffs doing the work.  Paired
+    ``serve-router-{mono,fleet}`` JSON lines at equal ``config_hash``
+    (aggregate tok/s, per-priority p99 TTFT, migration count/bytes) and
+    the ``serve-router-ab`` speedup line; the fleet's validated
+    ``router`` section lands in the RUNREPORT.
+
+    The trace is a CONCURRENCY-CAPPED closed loop: ``cap`` sessions
+    round-trip continuously (a finished request immediately admits the
+    next), the latency-bound serving regime where capacity is
+    provisioned for peak but live load sits below it.  That is the
+    regime the router exists for: an engine tick costs O(its own width
+    + pool) HOWEVER FEW slots are live (static shapes — masked rows
+    still compute), so the mono arm pays full-width ticks for a
+    fraction-full batch, while affinity routing CONSOLIDATES each warm
+    prefix group onto one small replica — the fleet runs a couple of
+    hot, cheap replicas and never steps the idle ones.  At full
+    saturation the bigger batch amortizes better and mono wins — that
+    is disclosed, not hidden: push ``--serve-requests`` up against the
+    cap and watch the ratio cross 1.  Warm handoffs ship only unshared
+    TAIL blocks (``migration_shared_blocks`` vs ``migration_bytes``).
+    """
+    import hashlib
+
+    import numpy as np
+
+    from ..serving import Request, Router, ServingEngine
+    from ..utils.logging import master_print
+
+    total_slots = num_slots * n_replicas
+    prefill_slots = max(1, total_slots // 4)
+    n_decode = n_replicas - 1
+    decode_slots = [(total_slots - prefill_slots) // n_decode] * n_decode
+    decode_slots[-1] += (total_slots - prefill_slots) - sum(decode_slots)
+    cap = max(2, total_slots // 3)  # live sessions: moderate load
+
+    rng = np.random.RandomState(seed + 7)
+    sys_len = 4 * block_size
+    tail_lens = [2, 3, 4]
+    n_lens = [12, 18, 24] if smoke else [16, 24, 32]
+    sys_prompts = [rng.randint(0, cfg.vocab_size, size=sys_len).tolist()
+                   for _ in range(2)]
+    trace = []
+    for i in range(n_requests):
+        sysp = sys_prompts[i % 2]
+        tail = rng.randint(0, cfg.vocab_size,
+                           size=int(rng.choice(tail_lens))).tolist()
+        trace.append(dict(
+            tokens=sysp + tail,
+            max_new_tokens=int(rng.choice(n_lens)),
+            priority=int(rng.choice([0, 0, 2])),
+        ))
+    max_ctx = sys_len + max(tail_lens) + max(n_lens)
+    cfg_hash = hashlib.sha1(
+        f"serve-router|d{cfg.dim}|L{cfg.nlayers}|n{n_requests}"
+        f"|R{n_replicas}|s{total_slots}|bs{block_size}|c{chunk}"
+        f"|sys{sys_len}|cap{cap}|seed{seed}".encode()).hexdigest()[:12]
+
+    def prio_cols(summary):
+        out = {}
+        for p, row in (summary.get("priorities") or {}).items():
+            p99 = (row.get("ttft_s") or {}).get("p99")
+            if p99 is not None:
+                out[f"ttft_p99_ms_prio{p}"] = round(p99 * 1e3, 4)
+        return out
+
+    def paced(submit, pump, n_done):
+        """Replay the trace at ``cap`` concurrent sessions: both arms
+        admit request i the moment fewer than ``cap`` of the first i are
+        unfinished — identical admission ORDER, load set by completion."""
+        i = 0
+        t0 = time.perf_counter()
+        while n_done() < len(trace):
+            while i < len(trace) and i - n_done() < cap:
+                submit(Request(**trace[i]))
+                i += 1
+            pump()
+        return time.perf_counter() - t0
+
+    # --- mono arm: one big engine at the fleet's total width
+    mono = ServingEngine(params, cfg, num_slots=total_slots,
+                         block_size=block_size, chunk=chunk,
+                         max_ctx=max_ctx, prefix_cache=True)
+    for sysp in sys_prompts:  # warm compiles AND the prefix cache
+        mono.submit(Request(sysp, 2))
+    mono.run_until_idle()
+    mono.reset_metrics()
+    wall = paced(mono.submit, mono.step, lambda: len(mono.finished))
+    mono_s = mono.serving_summary()
+    mono_tok_s = mono_s["generated_tokens"] / wall if wall > 0 else 0.0
+    assert mono_s["decode_signatures"] == 1, mono_s["decode_signatures"]
+    master_print(json.dumps({
+        "metric": "serve-router-mono",
+        "value": round(mono_tok_s, 1),
+        "num_slots": total_slots, "n_requests": n_requests,
+        "prefill_chunks": mono_s["prefill_chunks"],
+        "prefix_hit_rate": round(mono_s["prefix_hit_rate"], 4),
+        "decode_signatures": mono_s["decode_signatures"],
+        **prio_cols(mono_s),
+        "config_hash": cfg_hash,
+    }), flush=True)
+
+    # --- fleet arm: 1 prefill replica + (R-1) decode replicas
+    replicas = [ServingEngine(params, cfg, num_slots=prefill_slots,
+                              block_size=block_size, chunk=chunk,
+                              max_ctx=max_ctx, prefix_cache=True)]
+    for ds in decode_slots:
+        replicas.append(ServingEngine(
+            params, cfg, num_slots=ds, block_size=block_size, chunk=chunk,
+            max_ctx=max_ctx, prefix_cache=True))
+    # warm EVERY replica's compiled programs AND prefix cache standalone
+    # (affinity would concentrate router-driven warm traffic on one
+    # replica and leave the rest to compile mid-measurement)
+    for eng in replicas:
+        for sysp in sys_prompts:
+            eng.submit(Request(sysp, 2))
+        eng.run_until_idle()
+    router = Router(replicas,
+                    roles=["prefill"] + ["decode"] * n_decode)
+    # ... and every (prefill, decode) pair's migrate program explicitly
+    # with a NULL->NULL no-op copy — a pair compiling mid-measurement
+    # would time XLA, not the fleet
+    lanes = np.zeros(replicas[0].max_blocks, np.int32)
+    for j in range(1, n_replicas):
+        replicas[j].cache = router._mig_fn(0, j, False)(
+            replicas[0].cache, replicas[j].cache, lanes, lanes)
+    router.reset_metrics()
+
+    def fleet_done():
+        return len(router.finished) + len(router.rejected)
+
+    wall_f = paced(router.submit, router.step, fleet_done)
+    fleet = router.summary()
+    gen = fleet["fleet"]["generated_tokens"]
+    fleet_tok_s = gen / wall_f if wall_f > 0 else 0.0
+    for row in fleet["replicas"]:
+        want = {"prefill": (0, 1), "decode": (1, 0)}[row["role"]]
+        got = (row["decode_signatures"], row["prefill_signatures"])
+        assert got == want, (row["role"], got)
+    # fleet-level percentiles across replicas, priority-merged
+    fleet_prio: dict = {}
+    for row in fleet["replicas"]:
+        for p, pr in (row.get("priorities") or {}).items():
+            fleet_prio.setdefault(p, []).extend(
+                [] if not pr.get("ttft_s") else [pr["ttft_s"].get("p99")])
+    fleet_prio_cols = {
+        f"ttft_p99_ms_prio{p}": round(max(v for v in vals if v) * 1e3, 4)
+        for p, vals in fleet_prio.items() if any(vals)}
+    mig = fleet["fleet"]["migrations"]
+    aff = fleet["fleet"]["affinity"]
+    master_print(json.dumps({
+        "metric": "serve-router-fleet",
+        "value": round(fleet_tok_s, 1),
+        "n_replicas": n_replicas, "num_slots": total_slots,
+        "prefill_slots": prefill_slots, "n_requests": n_requests,
+        "affinity_hit_rate": round(aff["hit_rate"], 4),
+        "fleet_goodput_tok_s": round(
+            fleet["fleet"]["goodput_tok_s"], 1),
+        "migration_count": mig["handoffs"],
+        "migration_bytes": mig["bytes"],
+        "migration_shared_blocks": mig["shared_blocks"],
+        "rebalances": fleet["fleet"]["rebalances"],
+        "decode_signatures": 1,
+        **fleet_prio_cols,
+        "config_hash": cfg_hash,
+    }), flush=True)
+    master_print(json.dumps({
+        "metric": "serve-router-ab",
+        "value": round(fleet_tok_s / mono_tok_s, 3)
+        if mono_tok_s > 0 else None,
+        "mono_tok_s": round(mono_tok_s, 1),
+        "fleet_tok_s": round(fleet_tok_s, 1),
+        "affinity_hit_rate": round(aff["hit_rate"], 4),
+        "migration_bytes": mig["bytes"],
+        "config_hash": cfg_hash,
+    }), flush=True)
+    tel.record_serving(mono_s)
+    tel.record_router(fleet)
+    return fleet
+
+
 def bench_serve_paged(jax, jnp, cfg, params, tel, *, attn_impl, n_requests,
                       num_slots, block_size, chunk, seed, smoke):
     """The paged-attention-kernel A/B (docs/serving.md "Paged attention
@@ -690,6 +890,14 @@ def _parse_args(argv=None):
                          "at static draft width K — paired "
                          "serve-spec-{off,on} lines at equal config_hash, "
                          "token bit-parity asserted between the arms")
+    ap.add_argument("--router", type=int, default=0, metavar="R",
+                    help="with --serve: add the multi-replica router A/B "
+                         "— the same shared-prefix trace through one big "
+                         "engine vs a disaggregated fleet of R replicas "
+                         "(1 prefill tier + R-1 decode) at equal total "
+                         "slots; paired serve-router-{mono,fleet} lines "
+                         "at equal config_hash with migration "
+                         "count/bytes, and the RUNREPORT router section")
     ap.add_argument("--attn-impl", choices=("gather", "pallas"), default=None,
                     help="with --serve: add the paged-attention-kernel A/B "
                          "— BOTH arms always run paired at equal "
@@ -806,6 +1014,16 @@ def main(argv=None):
                 n_requests=args.serve_requests or (8 if smoke else 24),
                 num_slots=args.slots, block_size=args.block_size,
                 chunk=args.chunk, seed=args.seed, smoke=smoke)
+        if args.router:
+            if args.router < 2:
+                master_print("decode_bench: --router needs R >= 2",
+                             file=sys.stderr)
+                return 2
+            bench_serve_router(
+                jax, jnp, cfg, params, tel, n_replicas=args.router,
+                n_requests=args.serve_requests or (12 if smoke else 24),
+                num_slots=args.slots, block_size=args.block_size,
+                chunk=args.chunk, seed=args.seed, smoke=smoke)
         if trace_path:
             # the tick-level accounting next to the latency tables: where
             # each engine tick's time went, aggregated over every serve
@@ -815,10 +1033,11 @@ def main(argv=None):
 
             master_print(phase_table(tel.events.as_list()),
                          file=sys.stderr)
-    elif args.overload or args.shared_prefix or args.spec or args.attn_impl:
+    elif (args.overload or args.shared_prefix or args.spec
+          or args.attn_impl or args.router):
         master_print(
-            "decode_bench: --overload/--shared-prefix/--spec/--attn-impl "
-            "need --serve",
+            "decode_bench: --overload/--shared-prefix/--spec/--attn-impl/"
+            "--router need --serve",
             file=sys.stderr)
         return 2
     for B, ctx in cells:
