@@ -228,6 +228,10 @@ def test_int8_compressed_training_converges(devices8):
         )
 
 
+@pytest.mark.slow  # tier-1 budget: int8 grad compression and TP parity
+# each hold fast-tier on their own (test_compression.py goldens /
+# test_gpt.test_tp_matches_serial); this point is the hybrid-mesh
+# composition
 @pytest.mark.heavy
 def test_int8_compression_composes_with_tp(devices8):
     """grad_compress='int8' on a (data, tensor) mesh — the hybrid scenario

@@ -132,7 +132,15 @@ MOE_CFGS = {
 }
 
 
-@pytest.mark.parametrize("name", list(MOE_CFGS))
+@pytest.mark.parametrize("name", [
+    # both params drive the SAME no-drop decode dispatch; the fast tier
+    # keeps the cheaper GPT-trunk point, the mixtral composition
+    # (llama blocks + SwiGLU experts — each fast-tier on its own via
+    # test_greedy_matches_full_forward_llama + the EP/MoE tests) rides
+    # the slow tier (tier-1 budget, PR-13 payback idiom)
+    "moe",
+    pytest.param("mixtral", marks=pytest.mark.slow),
+])
 @pytest.mark.heavy
 def test_moe_greedy_matches_full_forward(name):
     from torchdistpackage_tpu.models import gpt_moe_forward, init_gpt_moe_params
@@ -431,42 +439,49 @@ def test_int8_kv_cache_moe_and_tp():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(dwant))
 
 
+@pytest.mark.parametrize("family", [
+    "gpt",
+    # same lossless claim through the llama trunk (GQA/SwiGLU/RoPE) —
+    # slow tier keeps the family matrix, the fast tier keeps the GPT
+    # point (tier-1 budget, PR-13 payback idiom)
+    pytest.param("llama", marks=pytest.mark.slow),
+])
 @pytest.mark.heavy
-def test_speculative_decode_lossless():
+def test_speculative_decode_lossless(family):
     """Speculative decode must be LOSSLESS: bit-equal to plain greedy
     generate for a perfect draft (self), a realistic draft (int8
     quantized), and an adversarial draft (different random model — near
     0% acceptance), on both families, composing with kv_quant.  The
     draft can only change speed, never output."""
+    import dataclasses
+
     from torchdistpackage_tpu.models import speculative_generate
     from torchdistpackage_tpu.tools.surgery import quantize_decode_params
 
-    for cfg in (GPT_CFG, LLAMA_CFG):
-        import dataclasses
-
-        cfg = dataclasses.replace(cfg, max_seq=64)  # room for K+1 slack
-        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
-        prompt = jax.random.randint(
-            jax.random.PRNGKey(1), (1, PROMPT), 0, cfg.vocab_size)
-        want = np.asarray(jax.jit(
-            lambda p, t: generate(p, t, cfg, max_new_tokens=16))(params, prompt))
-        drafts = {
-            "self": params,
-            "int8": quantize_decode_params(params, min_size=512),
-            "adversarial": init_gpt_params(jax.random.PRNGKey(99), cfg),
-        }
-        for name, dp in drafts.items():
-            got = np.asarray(jax.jit(
-                lambda p, d, t: speculative_generate(
-                    p, d, t, cfg, max_new_tokens=16))(params, dp, prompt))
-            np.testing.assert_array_equal(
-                got, want, err_msg=f"{cfg.norm} draft={name}")
-        # x kv_quant and a different K
+    cfg = {"gpt": GPT_CFG, "llama": LLAMA_CFG}[family]
+    cfg = dataclasses.replace(cfg, max_seq=64)  # room for K+1 slack
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (1, PROMPT), 0, cfg.vocab_size)
+    want = np.asarray(jax.jit(
+        lambda p, t: generate(p, t, cfg, max_new_tokens=16))(params, prompt))
+    drafts = {
+        "self": params,
+        "int8": quantize_decode_params(params, min_size=512),
+        "adversarial": init_gpt_params(jax.random.PRNGKey(99), cfg),
+    }
+    for name, dp in drafts.items():
         got = np.asarray(jax.jit(
             lambda p, d, t: speculative_generate(
-                p, d, t, cfg, max_new_tokens=16, num_draft=7,
-                kv_quant=True))(params, drafts["int8"], prompt))
-        np.testing.assert_array_equal(got, want, err_msg=f"{cfg.norm} kvq")
+                p, d, t, cfg, max_new_tokens=16))(params, dp, prompt))
+        np.testing.assert_array_equal(
+            got, want, err_msg=f"{cfg.norm} draft={name}")
+    # x kv_quant and a different K
+    got = np.asarray(jax.jit(
+        lambda p, d, t: speculative_generate(
+            p, d, t, cfg, max_new_tokens=16, num_draft=7,
+            kv_quant=True))(params, drafts["int8"], prompt))
+    np.testing.assert_array_equal(got, want, err_msg=f"{cfg.norm} kvq")
 
 
 def test_speculative_decode_guards():

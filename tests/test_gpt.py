@@ -505,6 +505,10 @@ def test_gpt_context_parallel_matches_serial(devices8, params, impl, xent_chunk)
     )
 
 
+@pytest.mark.slow  # tier-1 budget: ring-CP grad parity stays fast-tier
+# via test_gpt_ring_cp_remat_flash_matches_serial and the rope/zigzag
+# params; this point adds the 2-step optimizer loop over a data×context
+# mesh (DataParallel treating both axes as data)
 @pytest.mark.heavy
 def test_gpt_ring_training_matches_serial(devices8, params):
     """Train the ring-CP GPT over a data x context mesh with DataParallel
@@ -1122,6 +1126,10 @@ def test_streamed_head_loss_under_dp(devices8, params):
     np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget: the zigzag layout (host permute +
+# owned-position embedding gather) stays fast-tier via
+# test_gpt_rope_ring_cp_matches_serial[zigzag]; this point re-proves it
+# with learned pos-emb + full loss/grad goldens
 @pytest.mark.heavy
 def test_gpt_zigzag_ring_matches_serial(devices8, params):
     """Zigzag (load-balanced) ring CP through the full GPT: tokens/targets

@@ -954,6 +954,10 @@ def test_expert_choice_causal_guard():
         gpt_moe_loss(gp, batch, gcfg)
 
 
+@pytest.mark.slow  # tier-1 budget: MoE parity and ring-CP parity each
+# hold fast-tier on their own (remat_modes_match[True] /
+# test_gpt.test_gpt_ring_cp_remat_flash_matches_serial); this point is
+# the composition
 @pytest.mark.heavy
 def test_gpt_moe_with_ring_cp_matches_serial(devices8):
     """MoE × CP (the long-context expert-model pairing): an MoE GPT with

@@ -588,6 +588,11 @@ def test_1f1b_activation_memory_bounded(devices8):
     assert not leaked, f"O(M) float buffers carried through the scan: {leaked}"
 
 
+@pytest.mark.slow  # tier-1 budget: per-stage heterogeneity stays fast-tier
+# via test_balanced_stage_stack_pipelines_skewed_load (unequal stage
+# SIZES through padded slabs + masks); this point adds the per-stage
+# COMPUTE variant (stage_index-branched nonlinearities) of the same
+# serial-golden claim
 @pytest.mark.heavy
 def test_heterogeneous_stage_fn_matches_serial(devices8):
     """Per-stage heterogeneous compute — ``stage_fn`` branches on

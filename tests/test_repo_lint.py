@@ -469,6 +469,41 @@ def test_router_event_kinds_registered_and_emitted():
         f"router kinds never emitted from serving/router.py: {missing}")
 
 
+def test_fleet_ledger_event_kinds_registered_and_emitted():
+    """The fleet-observability kinds (PR 17) are in the registry AND
+    emitted where the decisions are made: the decision-ledger kinds
+    (``route_decision``/``handoff_decision``/``rebalance_decision`` plus
+    the ``replica_up``/``replica_down`` autoscaler switch) from
+    ``serving/router.py``, and the cross-replica trace-link halves
+    (``request_exported``/``request_imported``) from
+    ``serving/engine.py``.  A kind that stopped being emitted would
+    silently break placement attribution (the trace-replay acceptance
+    gate) or shatter cross-replica journeys back into fragments.  The
+    fleet-stitch split set must also stay registered: an unregistered
+    member would be droppable by the emit-site lint without anyone
+    noticing the stitch went blind."""
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+    from torchdistpackage_tpu.serving.tracing import ROUTER_EVENT_KINDS
+
+    ledger_kinds = {
+        "route_decision", "handoff_decision", "rebalance_decision",
+        "replica_up", "replica_down",
+    }
+    link_kinds = {"request_exported", "request_imported"}
+    assert ledger_kinds | link_kinds <= EVENT_KINDS
+    assert ROUTER_EVENT_KINDS <= EVENT_KINDS
+    router_emitted = {
+        k for _, k in _emit_call_kinds(PKG / "serving" / "router.py")}
+    missing = ledger_kinds - router_emitted
+    assert not missing, (
+        f"ledger kinds never emitted from serving/router.py: {missing}")
+    engine_emitted = {
+        k for _, k in _emit_call_kinds(PKG / "serving" / "engine.py")}
+    missing = link_kinds - engine_emitted
+    assert not missing, (
+        f"trace-link kinds never emitted from serving/engine.py: {missing}")
+
+
 def test_fastpath_event_kinds_registered_and_emitted():
     """The serving fast-path kinds (PR 10) are in the registry AND each
     is actually emitted from ``serving/`` — the prefix-cache hit/COW/

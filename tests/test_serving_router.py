@@ -39,6 +39,8 @@ from torchdistpackage_tpu.serving import (
     Request,
     Router,
     ServingEngine,
+    StubDeviceStep,
+    assemble_fleet_request_timelines,
     init_paged_kv,
     migrate_blocks,
     migration_wire_bytes,
@@ -78,6 +80,17 @@ def event_log(fleet):
     set_default_event_log(log)
     fleet["a"]._ev = log
     fleet["b"]._ev = log
+    yield log
+    set_default_event_log(None)
+
+
+@pytest.fixture()
+def stub_log():
+    """Event log for the stub-engine policy tests — deliberately does
+    NOT touch the ``fleet`` fixture, so a stub-only test never pays the
+    compiled pair's setup."""
+    log = EventLog()
+    set_default_event_log(log)
     yield log
     set_default_event_log(None)
 
@@ -178,9 +191,30 @@ def test_migrate_blocks_unit():
 # ----------------------------------------------------- routing and fallback
 
 
-def test_affinity_routing_and_shed_fallback(fleet, event_log):
-    a, b = _pair(fleet)
-    p = fleet["prompts"]
+def test_affinity_routing_and_shed_fallback(stub_log):
+    """Routing POLICY (PR-17: compile-free on StubDeviceStep — every
+    decision here is host code; the bit-parity claims stay with the
+    real-engine handoff/rebalance tests below).  Warm traffic routes to
+    its prefix owner by affinity, a shedding replica falls through to
+    the next-best, and the token streams still match a solo engine's
+    (the router never corrupts what it routes)."""
+    rng = np.random.RandomState(3)
+    p = rng.randint(0, CFG.vocab_size, size=(3, PROMPT)).astype(np.int32)
+
+    def mk():
+        return ServingEngine(None, CFG, num_slots=3, block_size=BS,
+                             chunk=4, prefix_cache=True,
+                             device_step=StubDeviceStep())
+
+    def solo(tokens):
+        e = mk()
+        r = e.submit(Request(tokens, NEW))
+        e.run_until_idle()
+        return e.finished[r]["tokens"]
+
+    want = [solo(p[i].tolist()) for i in range(2)]
+    event_log = stub_log
+    a, b = mk(), mk()
     router = Router([a, b])
     # warm each replica with a different prefix (through the router, so
     # the registration happens exactly as production traffic would)
@@ -207,10 +241,8 @@ def test_affinity_routing_and_shed_fallback(fleet, event_log):
     assert routed[rb]["replica"] == other
     assert routed[rb]["affinity_tokens"] > 0
     router.run_until_idle()
-    np.testing.assert_array_equal(router.finished[ra]["tokens"],
-                                  fleet["want"][0])
-    np.testing.assert_array_equal(router.finished[rb]["tokens"],
-                                  fleet["want"][1])
+    np.testing.assert_array_equal(router.finished[ra]["tokens"], want[0])
+    np.testing.assert_array_equal(router.finished[rb]["tokens"], want[1])
     s = router.summary()
     assert s["fleet"]["affinity"]["hit_rate"] == 1.0
     assert _validate_router(s) == []
@@ -229,8 +261,7 @@ def test_affinity_routing_and_shed_fallback(fleet, event_log):
     pref.queue.clear()
     pref.max_queue = None
     router.run_until_idle()
-    np.testing.assert_array_equal(router.finished[rc]["tokens"],
-                                  fleet["want"][0])
+    np.testing.assert_array_equal(router.finished[rc]["tokens"], want[0])
 
 
 # --------------------------------------------- disaggregated handoff parity
@@ -284,6 +315,17 @@ def test_prefill_decode_handoff_bit_parity(fleet, event_log):
     assert _validate_router(s) == []
     kinds = _kinds(event_log)
     assert "blocks_migrated" in kinds and "request_migrated" in kinds
+
+    # PR-17 acceptance on the REAL-engine path: each migrated request
+    # reconstructs from the event timeline alone as ONE cross-replica
+    # journey (prefill hop on 0, decode hop on 1), with the
+    # decode_signatures==1 evidence above still standing
+    fleet_tl = assemble_fleet_request_timelines(event_log.as_list())
+    by_rid = {j["rid"]: j for j in fleet_tl["journeys"]}
+    for rid in rids + [rs]:
+        assert [h["replica"] for h in by_rid[rid]["hops"]] == [0, 1]
+        assert by_rid[rid]["outcome"] == "retired"
+        assert by_rid[rid]["migrations"][0]["bytes"] > 0
 
 
 def test_warm_handoff_ships_only_the_tail(fleet, event_log):

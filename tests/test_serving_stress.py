@@ -34,7 +34,8 @@ from torchdistpackage_tpu.models import GPTConfig, generate, init_gpt_params
 from torchdistpackage_tpu.obs.events import EventLog, set_default_event_log
 from torchdistpackage_tpu.obs.report import SERVING_VERDICTS, _validate_serving
 from torchdistpackage_tpu.resilience import ChaosMonkey, Fault, Watchdog
-from torchdistpackage_tpu.serving import BlockAllocator, Request, ServingEngine
+from torchdistpackage_tpu.serving import (BlockAllocator, Request,
+                                           ServingEngine, StubDeviceStep)
 
 CFG = GPTConfig(vocab_size=64, dim=32, nheads=4, nlayers=2, max_seq=32)
 PROMPT, NEW = 5, 6          # chunk=4 < PROMPT: prefill genuinely chunks
@@ -42,9 +43,9 @@ NEED = 3                    # ceil((5 + 6) / block_size=4) blocks/request
 SLOTS, USABLE = 3, 8        # 3 full requests (9 blocks) CANNOT coexist
 
 
-def _mk_engine(params):
+def _mk_engine(params, **kw):
     return ServingEngine(params, CFG, num_slots=SLOTS, block_size=4,
-                         chunk=4, num_blocks=USABLE + 1)
+                         chunk=4, num_blocks=USABLE + 1, **kw)
 
 
 @pytest.fixture(scope="module")
@@ -70,6 +71,17 @@ def event_log(stress):
     set_default_event_log(log)
     stress["eng"]._ev = log
     stress["eng2"]._ev = log
+    yield log
+    set_default_event_log(None)
+
+
+@pytest.fixture()
+def stub_log():
+    """Event log for compile-free StubDeviceStep tests — does NOT touch
+    the module-scope ``stress`` fixture, so a stub-only test never pays
+    for the compiled engines."""
+    log = EventLog()
+    set_default_event_log(log)
     yield log
     set_default_event_log(None)
 
@@ -140,9 +152,26 @@ def test_allocator_audit_and_reclaim():
 # ------------------------------------- exhaustion, back-pressure, preemption
 
 
-def test_exhaustion_backpressure_then_preemption(stress, event_log):
-    eng = _fresh(stress["eng"])
-    p = stress["prompts"]
+def test_exhaustion_backpressure_then_preemption(stub_log):
+    """Back-pressure and preemption POLICY (PR-17: compile-free on
+    StubDeviceStep — admission, the all-or-nothing allocator, priority
+    eviction, and replay are host code; the chaos matrix below keeps
+    the real-engine compile evidence).  The preempted request's replay
+    still bit-equals its unpreempted run: the stub's token rule is
+    deterministic in (last token, position), so a replay that dropped
+    or doubled a token would diverge."""
+    event_log = stub_log
+    eng = _mk_engine(None, device_step=StubDeviceStep())
+    rng = np.random.RandomState(5)
+    p = rng.randint(0, CFG.vocab_size, size=(3, PROMPT)).astype(np.int32)
+
+    def solo(tokens):
+        e = _mk_engine(None, device_step=StubDeviceStep())
+        r = e.submit(Request(tokens, NEW))
+        e.run_until_idle()
+        return e.finished[r]["tokens"]
+
+    want = [solo(p[i].tolist()) for i in range(3)]
     low = [eng.submit(Request(p[i].tolist(), NEW)) for i in range(2)]
     eng.step()
     assert eng.n_busy == 2 and eng._allocs[0].n_free == USABLE - 2 * NEED
@@ -176,7 +205,7 @@ def test_exhaustion_backpressure_then_preemption(stress, event_log):
         f = eng.finished[rid]
         assert f["reason"] == "max_tokens" and f["new_tokens"] == NEW
         np.testing.assert_array_equal(
-            f["tokens"], stress["want"][row],
+            f["tokens"], want[row],
             err_msg=f"rid {rid} diverged after preemption/replay")
     s = eng.serving_summary()
     assert s["verdict"] == "degraded"  # preempted, nothing shed
@@ -184,7 +213,10 @@ def test_exhaustion_backpressure_then_preemption(stress, event_log):
     assert set(s["priorities"]) == {"0", "5"}
     assert s["priorities"]["5"]["completed"] == 1
     assert s["priorities"]["0"]["ttft_s"]["p99"] >= 0
-    assert s["decode_signatures"] == 1 and s["prefill_signatures"] == 1
+    # compile evidence lives with the real engines (chaos matrix below);
+    # here the stub just confirms both program kinds were exercised
+    assert eng.device_step.calls["decode"] > 0
+    assert eng.device_step.calls["prefill"] > 0
     assert _validate_serving(s) == []
 
 

@@ -323,6 +323,32 @@ def test_bench_trend_router_columns():
     assert any("REGRESSION serve-router-fleet" in w for w in warnings)
 
 
+def test_bench_trend_fleet_slo_columns():
+    """The PR-17 fleet-observability columns: ``fleet_slo_attainment``
+    and ``migration_count`` ride the ``serve-router-fleet`` line (and
+    the ``trace-replay`` line) — a fleet tokens/s hold with collapsing
+    SLO attainment means throughput is being bought from deadline
+    misses, and a migration-count explosion means the disaggregation
+    tier started thrashing; both are visible in the trend and a
+    headline regression still trips the gate."""
+    from torchdistpackage_tpu.tools.bench_trend import AUX_KEYS, trend
+
+    assert {"fleet_slo_attainment", "migration_count"} <= set(AUX_KEYS)
+    line = {"metric": "serve-router-fleet", "value": 900.0,
+            "fleet_goodput_tok_s": 900.0, "fleet_slo_attainment": 0.97,
+            "migration_count": 12, "config": "c"}
+    report, warnings = trend(
+        [(1, [line]),
+         (2, [dict(line, value=500.0, fleet_slo_attainment=0.4,
+                   migration_count=480)])],
+        threshold=0.05)
+    assert any("fleet_slo_attainment=0.97" in ln for ln in report)
+    assert any("migration_count=12" in ln for ln in report)
+    assert any("fleet_slo_attainment=0.4" in ln for ln in report)
+    assert any("migration_count=480" in ln for ln in report)
+    assert any("REGRESSION serve-router-fleet" in w for w in warnings)
+
+
 def test_bench_trend_paged_kernel_column():
     """The PR-12 paged-kernel columns: ``serve-paged-{gather,pallas}``
     lines gate on tokens/s (``value``) as their own series, and the

@@ -134,6 +134,10 @@ def test_vit_dp_training_converges(devices8):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # tier-1 budget: ring-CP parity holds fast-tier on the
+# GPT trunk (test_gpt ring/rope/zigzag points), ViT parity via
+# test_vit_dp_training_converges + the ViT-MoE tests; this point is the
+# bidirectional-attention composition
 @pytest.mark.heavy
 def test_vit_ring_cp_matches_serial(devices8):
     """ViT with non-causal ring context parallelism over the patch tokens
@@ -385,6 +389,10 @@ def test_vit_moe_encoder_trains_both_routers():
             router, losses)
 
 
+@pytest.mark.slow  # tier-1 budget: ViT-MoE stays fast-tier via
+# test_vit_moe_encoder_trains_both_routers, EP-matches-serial via
+# test_llama.test_mixtral_style_moe_ep_matches_serial; this point is
+# their composition on the ViT trunk
 @pytest.mark.heavy
 def test_vit_moe_ep_training_matches_serial(devices8):
     """ViT-MoE under EP x MoE-DP with expert-grad overrides tracks the

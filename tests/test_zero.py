@@ -331,6 +331,9 @@ def test_zero_with_tp(devices8):
     )
 
 
+@pytest.mark.slow  # tier-1 budget: ZeRO trajectory parity and ring-CP
+# parity each hold fast-tier on their own; this point is the
+# (data, context) grad-reduce composition
 @pytest.mark.heavy
 def test_zero_with_ring_context_parallel(devices8):
     """ZeRO composed with ring context parallelism: optimizer state shards

@@ -193,6 +193,47 @@ docs/serving.md "Multi-replica routing and disaggregation"):
                             approved the DCN-crossing leg)
 ==========================  =============================================
 
+Fleet-observability kinds (``serving/router.py``, PR 17 — the router
+decision ledger; docs/serving.md "Fleet observability").  Every
+placement the fleet makes is attributable to exactly one of these
+records, which carry the INPUTS the decision was made from, not just
+the outcome:
+
+==========================  =============================================
+``route_decision``          one ``Router.submit`` decision, shed or
+                            placed: the full per-replica candidate table
+                            (affinity tokens, biased TTFT estimate,
+                            load, role) in the order it was ranked, the
+                            chosen replica, the replicas that refused
+                            first (fallthrough, with their rejection
+                            reasons), and the outcome
+``handoff_decision``        one disaggregation handoff decision: the
+                            import-candidate table (arrival affinity,
+                            load, slot/block capacity), the chosen
+                            decode replica, and the outcome (``handoff``
+                            / ``deferred`` when no target had capacity /
+                            ``bounced`` when the import raced away and
+                            the request went back to its source)
+``rebalance_decision``      one KV-free rebalance decision: what
+                            triggered it (``overloaded`` demand /
+                            ``watermark`` spread / ``manual``), the
+                            per-replica queue depths it saw, the spread,
+                            and how many requests it stole and landed
+``replica_up``              a replica entered rotation (``set_alive``;
+                            record carries the reason — the autoscaler
+                            seam of ROADMAP 2(a))
+``replica_down``            a replica left rotation: ``set_alive`` or an
+                            evacuation (reason ``manual`` /
+                            ``faults_detected`` / policy-specific)
+``request_exported``        an engine unwound a DECODE slot into a
+                            migration descriptor (``export_slot``) — the
+                            src half of the cross-replica trace link
+``request_imported``        an engine admitted a migration descriptor
+                            straight into DECODE (``import_slot``);
+                            ``orig_rid`` names the src-engine instance
+                            it continues — the dst half of the link
+==========================  =============================================
+
 Auto-sharding planner kinds (``dist/autoplan.py``, PR 13):
 
 ==========================  =============================================
@@ -263,6 +304,10 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     # multi-replica router (PR 15)
     "request_routed", "request_migrated", "replica_degraded",
     "blocks_migrated",
+    # fleet observability: the router decision ledger + the engine-side
+    # halves of the cross-replica trace link (PR 17)
+    "route_decision", "handoff_decision", "rebalance_decision",
+    "replica_up", "replica_down", "request_exported", "request_imported",
     # memory observability (PR 6)
     "mem_snapshot", "oom_risk",
     # numerics observability (PR 7)
@@ -338,6 +383,38 @@ class EventLog:
 
     def as_list(self):
         return list(self.events)
+
+
+class TaggedEventLog:
+    """A view of an :class:`EventLog` that stamps fixed fields on every
+    emit — how a fleet gives each replica's engine an identity on a
+    SHARED timeline without threading a replica index through every
+    engine emit site.  ``Router`` wraps each replica's ``_ev`` with
+    ``tag_events(log, replica=i)``; downstream consumers
+    (``serving.tracing.assemble_fleet_request_timelines``) split the
+    one timeline back into per-replica streams on the ``replica`` field.
+    Everything except ``emit`` forwards to the wrapped log (same
+    history, same sink), and an explicit field on an emit call wins over
+    the tag."""
+
+    def __init__(self, inner: EventLog, tags: Dict[str, Any]) -> None:
+        self.inner = inner
+        self.tags = dict(tags)
+
+    def emit(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        return self.inner.emit(kind, **{**self.tags, **fields})
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.inner, name)
+
+
+def tag_events(log: Any, **tags: Any) -> TaggedEventLog:
+    """Wrap ``log`` so every emit carries ``tags``.  Re-tagging a
+    tagged log replaces its tags instead of stacking views (a Router
+    rebuilt over the same engines must not accumulate stale indices)."""
+    while isinstance(log, TaggedEventLog):
+        log = log.inner
+    return TaggedEventLog(log, tags)
 
 
 _default_log: Optional[EventLog] = None

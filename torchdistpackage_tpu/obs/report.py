@@ -24,6 +24,11 @@ RESILIENCE_VERDICTS = ("clean", "recovered", "preempted", "aborted")
 # the engine preempted or healed faults to keep serving, healthy = neither
 SERVING_VERDICTS = ("healthy", "degraded", "overloaded")
 
+# the fleet balance verdicts (serving/router.py owns the policy and the
+# skew threshold; the vocabulary is mirrored here so obs — a leaf
+# subsystem — validates router sections without importing serving)
+FLEET_BALANCE_VERDICTS = ("balanced", "skewed", "degraded")
+
 # the auto-sharding planner's end states (dist/autoplan.py imports these —
 # obs is a leaf subsystem, so the schema vocabulary lives here): ``ok`` = a
 # plan was chosen, ``all_oom`` = every candidate was pruned by the memory
@@ -576,6 +581,52 @@ def _validate_router(rt: Any) -> List[str]:
         v = fleet.get(k)
         if not isinstance(v, int) or v < 0:
             errs.append(f"router.fleet.{k} missing/negative")
+    slo = fleet.get("slo")
+    if not isinstance(slo, dict):
+        errs.append("router.fleet.slo missing/non-dict")
+    else:
+        att = slo.get("attainment")
+        if att is not None and (
+                not isinstance(att, (int, float)) or not 0.0 <= att <= 1.0):
+            errs.append("router.fleet.slo.attainment out of [0, 1]")
+        prios = slo.get("priorities")
+        if not isinstance(prios, dict):
+            errs.append("router.fleet.slo.priorities missing/non-dict")
+        else:
+            for k, row in prios.items():
+                a = row.get("attainment") if isinstance(row, dict) else None
+                if a is not None and (
+                        not isinstance(a, (int, float))
+                        or not 0.0 <= a <= 1.0):
+                    errs.append(
+                        f"router.fleet.slo.priorities[{k}].attainment "
+                        f"out of [0, 1]")
+        per = slo.get("per_replica")
+        if not isinstance(per, list) or len(per) != len(reps):
+            errs.append("router.fleet.slo.per_replica missing/mislengthed")
+    bal = fleet.get("balance")
+    if not isinstance(bal, dict):
+        errs.append("router.fleet.balance missing/non-dict")
+    else:
+        if bal.get("verdict") not in FLEET_BALANCE_VERDICTS:
+            errs.append(
+                f"router.fleet.balance.verdict {bal.get('verdict')!r} "
+                f"not in {FLEET_BALANCE_VERDICTS}")
+        idx = bal.get("imbalance_index")
+        if idx is not None and (
+                not isinstance(idx, (int, float)) or idx < 1.0 - 1e-9):
+            errs.append(
+                "router.fleet.balance.imbalance_index below 1 (it is "
+                "max/mean served tokens within a role group)")
+        if not bal.get("basis"):
+            errs.append("router.fleet.balance.basis missing/empty (the "
+                        "verdict must cite its evidence)")
+        if (fleet.get("verdict") in SERVING_VERDICTS
+                and fleet.get("verdict") != "healthy"
+                and bal.get("verdict") == "balanced"):
+            errs.append(
+                "router.fleet.balance.verdict 'balanced' contradicts "
+                f"fleet verdict {fleet.get('verdict')!r}")
     return errs
 
 
@@ -667,15 +718,23 @@ def render_summary_line(report: Dict[str, Any]) -> str:
         fleet = rt["fleet"]
         aff = fleet.get("affinity") or {}
         mig = fleet.get("migrations") or {}
+        att = fleet.get("attainment")
         parts.append(
             f"fleet={fleet.get('n_alive', '?')}/"
             f"{fleet.get('n_replicas', '?')}rep "
             f"{fleet.get('tokens_per_sec', 0.0):.1f}tok/s"
             f"(aff {aff.get('hit_rate', 0.0):.0%}, "
             f"mig {mig.get('handoffs', 0)}/"
-            f"{mig.get('bytes', 0) / 1e6:.2f}MB)")
+            f"{mig.get('bytes', 0) / 1e6:.2f}MB"
+            + (f", att {att:.0%}" if att is not None else "") + ")")
         if fleet.get("verdict") and fleet["verdict"] != "healthy":
             parts.append(f"FLEET={fleet['verdict']}")
+        bal = fleet.get("balance") or {}
+        if bal.get("verdict") and bal["verdict"] != "balanced":
+            idx = bal.get("imbalance_index")
+            parts.append(
+                f"BALANCE={bal['verdict']}"
+                + (f"({idx:.2f})" if idx is not None else ""))
     return "  ".join(parts)
 
 
@@ -1157,14 +1216,37 @@ def render_markdown(report: Dict[str, Any]) -> str:
             f"({fleet.get('rebalanced_requests', 0)} requests moved), "
             f"evacuations: {fleet.get('evacuations', 0)} "
             f"({fleet.get('evacuated_requests', 0)} rehomed)")
+        slo = fleet.get("slo") or {}
+        if slo:
+            att = slo.get("attainment")
+            prio_bits = ", ".join(
+                f"p{k}: {row['attainment']:.0%}"
+                for k, row in sorted((slo.get("priorities") or {}).items())
+                if isinstance(row, dict)
+                and row.get("attainment") is not None)
+            L.append(
+                f"- fleet SLO attainment: "
+                f"**{att:.0%}**" if att is not None
+                else "- fleet SLO attainment: **n/a** (no deadlines)")
+            if prio_bits:
+                L[-1] += f" ({prio_bits})"
+        bal = fleet.get("balance") or {}
+        if bal:
+            idx = bal.get("imbalance_index")
+            L.append(
+                f"- load balance: **{bal.get('verdict', '?')}**"
+                + (f" (imbalance index {idx:.2f})" if idx is not None
+                   else "")
+                + f" — {bal.get('basis', '')}")
         reps = rt.get("replicas") or []
         if reps:
             L.append("")
             L.append("| replica | role | zone | alive | verdict | tok/s "
-                     "| completed | migrated in/out | hit rate |")
-            L.append("|---|---|---|---|---|---|---|---|---|")
+                     "| completed | migrated in/out | hit rate | SLO att |")
+            L.append("|---|---|---|---|---|---|---|---|---|---|")
             for row in reps:
                 reqs = row.get("requests") or {}
+                ratt = (row.get("slo") or {}).get("attainment")
                 L.append(
                     f"| {row.get('index', '?')} | {row.get('role', '?')} "
                     f"| {row.get('zone', '?')} "
@@ -1174,7 +1256,8 @@ def render_markdown(report: Dict[str, Any]) -> str:
                     f"| {reqs.get('completed', 0)} "
                     f"| {reqs.get('migrated_in', 0)}/"
                     f"{reqs.get('migrated_out', 0)} "
-                    f"| {row.get('prefix_hit_rate', 0.0):.0%} |")
+                    f"| {row.get('prefix_hit_rate', 0.0):.0%} "
+                    f"| {f'{ratt:.0%}' if ratt is not None else 'n/a'} |")
         L.append("")
 
     counters = report.get("counters", {})

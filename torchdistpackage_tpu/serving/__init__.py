@@ -43,16 +43,41 @@ prefill → decode across preemptions and drain→resume), and
 goodput, and the predicted-vs-actual TTFT calibration whose bias feeds
 back into ``estimate_ttft`` — all host arithmetic, zero extra compiled
 programs.
+
+Fleet observability (docs/serving.md "Fleet observability"): the Router
+keeps a decision LEDGER — every route/handoff/rebalance/liveness
+decision is a registered event carrying the candidate table it was made
+from — and a request that crosses replicas stitches into one
+flow-linked Perfetto track (:func:`assemble_fleet_request_timelines`).
+The engine's five device touches sit behind a :class:`DeviceStep` seam
+(:mod:`.sim`), so ``tools/trace_replay.py`` can push 10^5+ synthetic
+requests through the real Router + :class:`StubDeviceStep` engines on
+CPU and emit the validated FLEETREPORT as evidence.
 """
 
 from .engine import Request, ServingEngine
-from .router import ROLES, Router
+from .router import (
+    FLEET_BALANCE_VERDICTS,
+    IMBALANCE_SKEWED_AT,
+    ROLES,
+    Router,
+)
+from .sim import (
+    CompiledDeviceStep,
+    DeviceStep,
+    LatencyModel,
+    StubDeviceStep,
+    host_migrate_blocks,
+)
 from .tracing import (
     REQUEST_PHASES,
     REQUEST_TERMINALS,
+    ROUTER_EVENT_KINDS,
     SERVING_METRICS_SCHEMA,
     TICK_PHASES,
+    assemble_fleet_request_timelines,
     assemble_request_timelines,
+    fleet_trace_events,
     lifecycle_phases,
     phase_table,
     request_trace_events,
@@ -82,12 +107,22 @@ from .paged_cache import (
 __all__ = [
     "Request",
     "ServingEngine",
+    "FLEET_BALANCE_VERDICTS",
+    "IMBALANCE_SKEWED_AT",
     "ROLES",
     "Router",
+    "CompiledDeviceStep",
+    "DeviceStep",
+    "LatencyModel",
+    "StubDeviceStep",
+    "host_migrate_blocks",
     "REQUEST_PHASES",
     "REQUEST_TERMINALS",
+    "ROUTER_EVENT_KINDS",
     "SERVING_METRICS_SCHEMA",
     "TICK_PHASES",
+    "assemble_fleet_request_timelines",
+    "fleet_trace_events",
     "assemble_request_timelines",
     "lifecycle_phases",
     "phase_table",

@@ -343,6 +343,16 @@ class ServingEngine:
         and the per-phase tick breakdown of a RUNNING engine.
     tick_history: bound on the in-memory per-tick accounting records
         (``tick_records``; oldest dropped first, like the event log).
+    device_step: a :class:`~.sim.DeviceStep` supplying the engine's
+        device programs (pool init, the shared prefill/decode step, the
+        verify step, COW, per-request PRNG keys).  ``None`` (default)
+        builds the real :class:`~.sim.CompiledDeviceStep` — identical to
+        the engine before the seam existed.  Pass
+        :class:`~.sim.StubDeviceStep` for the host-only double
+        (``params`` may then be ``None``): same scheduler, allocator,
+        audit, and event timeline, zero compilation — what
+        ``tools/trace_replay.py`` and the compile-free policy tests run
+        on.  A host-only step cannot be combined with a mesh.
     """
 
     def __init__(
@@ -372,6 +382,7 @@ class ServingEngine:
         metrics_sink: Optional[Any] = None,
         metrics_every: int = 1,
         tick_history: int = 4096,
+        device_step: Optional[Any] = None,
     ) -> None:
         if (axis is not None or dp_axis is not None) and mesh is None:
             raise ValueError("axis/dp_axis need a mesh")
@@ -443,15 +454,18 @@ class ServingEngine:
         self._allocs = [BlockAllocator(num_blocks) for _ in range(self.dp)]
         self._param_specs = param_specs
 
-        cache = init_paged_kv(cfg, self.dp * num_blocks, block_size,
-                              quantized=kv_quant)
-        if mesh is not None:
-            from jax.sharding import NamedSharding
+        from .sim import CompiledDeviceStep
 
-            cache = jax.tree.map(
-                lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
-                cache, self._cache_specs(cache))
-        self.cache = cache
+        if device_step is None:
+            device_step = CompiledDeviceStep()
+        if getattr(device_step, "host_only", False) and mesh is not None:
+            raise ValueError(
+                "a host-only DeviceStep cannot shard a pool over a mesh")
+        #: the device-program seam (serving/sim.py): compiled pair or
+        #: host-only stub — every device touch below goes through it
+        self.device_step = device_step
+        device_step.bind(self)
+        self.cache = device_step.init_cache()
 
         # host-visible device state, one row per slot
         V = cfg.vocab_size
@@ -467,6 +481,11 @@ class ServingEngine:
         self.queue: List[Tuple[Request, float]] = []
         self.finished: Dict[int, Dict[str, Any]] = {}
         self.rejected: Dict[int, Dict[str, Any]] = {}
+        # completion/rejection rids in arrival order — lets a collector
+        # (the Router, every tick) consume just the tail instead of
+        # re-scanning the whole dict, which goes quadratic at replay scale
+        self._finished_order: List[int] = []
+        self._rejected_order: List[int] = []
         self._next_rid = 0
         self._seq: Dict[int, int] = {}  # rid -> FIFO age (survives requeue)
         self._inject: Dict[int, Dict[str, Any]] = {}  # resume key/prefix
@@ -481,15 +500,15 @@ class ServingEngine:
         self._tick_decode_rids: List[int] = []
         self._tick_emitted = 0
         self._pending_cow: List[Tuple[int, int, int]] = []  # slot, src, dst
-        self._step_fn = self._build_step()
+        wrap = (telemetry is not None
+                and getattr(device_step, "wrap_steps", True))
+        self._step_fn = device_step.step_fn()
         self._decode_fn = (
-            telemetry.wrap_step(self._step_fn) if telemetry is not None
-            else self._step_fn)
-        self._cow_fn = self._build_cow() if self.prefix_cache else None
+            telemetry.wrap_step(self._step_fn) if wrap else self._step_fn)
+        self._cow_fn = device_step.cow_fn() if self.prefix_cache else None
         if self.spec_k:
-            vfn = self._build_verify_step()
-            self._verify_fn = (
-                telemetry.wrap_step(vfn) if telemetry is not None else vfn)
+            vfn = device_step.verify_fn()
+            self._verify_fn = telemetry.wrap_step(vfn) if wrap else vfn
         else:
             self._verify_fn = None
         self.reset_metrics()
@@ -791,6 +810,7 @@ class ServingEngine:
             **extra,
         }
         self.rejected[req.rid] = verdict
+        self._rejected_order.append(req.rid)
         self.stats["shed"] += 1
         self._slo_row(req.priority)["shed"] += 1
         self._ttft_pred.pop(req.rid, None)
@@ -867,6 +887,7 @@ class ServingEngine:
                     "waited_s": round(now - t_submit, 6),
                 }
                 self.rejected[req.rid] = verdict
+                self._rejected_order.append(req.rid)
                 self._inject.pop(req.rid, None)
                 self._ttft_pred.pop(req.rid, None)
                 self._ev.emit("request_expired", **verdict)
@@ -1050,8 +1071,7 @@ class ServingEngine:
                 req.top_k if req.top_k is not None else self.cfg.vocab_size)
             self._top_p[slot_idx] = (
                 req.top_p if req.top_p is not None else 1.0)
-            self._keys[slot_idx] = np.asarray(
-                jax.random.PRNGKey(req.seed), np.uint32)
+            self._keys[slot_idx] = self.device_step.prng_key(req.seed)
             inj = self._inject.get(req.rid)
             if inj is not None:
                 # drain/resume: the admitted prompt carries the already-
@@ -1382,6 +1402,7 @@ class ServingEngine:
         s = self._slots[i]
         completed = reason in ("eos", "max_tokens")
         new_tokens = s.pre_gen + len(s.generated)
+        self._finished_order.append(s.rid)
         self.finished[s.rid] = {
             "rid": s.rid,
             "tokens": np.concatenate(
@@ -1441,6 +1462,7 @@ class ServingEngine:
             if req.rid == rid:
                 del self.queue[idx]
                 self.stats["cancelled"] += 1
+                self._finished_order.append(rid)
                 self.finished[rid] = {
                     "rid": rid,
                     "tokens": np.asarray(req.tokens, np.int32),
@@ -1926,6 +1948,13 @@ class ServingEngine:
         self._ttft_pred.pop(s.rid, None)
         s.reset()
         self.stats["migrated_out"] += 1
+        # the src half of the cross-replica trace link: this instance
+        # ends here, and the importer's ``request_imported`` names the
+        # instance that continues it
+        self._ev.emit(
+            "request_exported", rid=rid, length=length,
+            n_live=desc["n_live"],
+            emitted_tokens=len(desc.get("emitted") or []))
         return desc, cache
 
     def import_slot(self, desc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -2047,6 +2076,14 @@ class ServingEngine:
                     "cache_evict", tick=self._tick, n_blocks=len(evicted),
                     group=i // self.slots_per_group)
             self.stats["migrated_in"] += 1
+            # the dst half of the trace link: a fresh instance opening
+            # straight in DECODE (no queue, no prefill — the KV arrives
+            # by migrate_blocks), naming the src-engine rid it continues
+            self._ev.emit(
+                "request_imported", rid=rid,
+                orig_rid=int(desc.get("orig_rid", -1)), length=length,
+                n_shared=len(hit), n_live=n_live,
+                emitted_tokens=len(emitted))
             return {"rid": rid, "slot": i, "blocks": list(blocks),
                     "n_shared": len(hit), "n_live": n_live}
         return None
@@ -2134,6 +2171,8 @@ class ServingEngine:
         self._t_last_done = 0.0
         self.finished = {}
         self.rejected = {}
+        self._finished_order = []
+        self._rejected_order = []
         for a in self._allocs:
             a.peak_in_use = a.in_use
 

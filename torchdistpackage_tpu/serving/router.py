@@ -64,6 +64,17 @@ engine, built entirely from primitives the engines already prove:
   rotation, and every descriptor resumes on the surviving replicas —
   temp-0 token streams BIT-equal the unfaulted run (the PR-9 resume
   parity), audit green throughout.
+- **Decision ledger (fleet observability).**  Every decision the router
+  makes is a structured, registered event carrying the INPUTS that
+  drove it: ``route_decision`` (the ranked per-replica candidate table —
+  affinity, biased TTFT estimate, load — plus the fallthrough list and
+  outcome), ``handoff_decision`` (import-candidate capacity table and
+  the chosen decode replica), ``rebalance_decision`` (queue depths,
+  spread, trigger, stolen/moved counts), and ``replica_up`` /
+  ``replica_down`` on every :meth:`set_alive` rotation flip (the
+  autoscaler seam).  Any placement in a fleet trace is attributable to
+  exactly one ledger record after the fact — what
+  ``tools/trace_replay.py`` measures routing policy with.
 - **Audit across allocators.**  :meth:`Router.audit` runs every
   replica's block-conservation audit plus the cross-replica invariant a
   migration could break: a router-tracked request is live on AT MOST ONE
@@ -88,9 +99,21 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..obs.events import EventLog, default_event_log
+from ..obs.events import EventLog, default_event_log, tag_events
 from .engine import DRAIN_SCHEMA, Request, ServingEngine
 from .paged_cache import migrate_blocks, migration_wire_bytes
+
+#: Fleet balance verdicts (``summary()['fleet']['balance']`` — the
+#: FLEETREPORT half of the fleet verdict): ``balanced`` = work spread
+#: within :data:`IMBALANCE_SKEWED_AT` of even, ``skewed`` = one replica
+#: carries disproportionate load while the fleet still serves, and
+#: ``degraded`` = the fleet itself is unhealthy (replica down or a
+#: replica verdict worse than healthy) — balance is moot until it heals.
+FLEET_BALANCE_VERDICTS = ("balanced", "skewed", "degraded")
+
+#: Load-imbalance index (max over mean of per-alive-replica served
+#: tokens, >= 1.0) above which the fleet balance verdict is ``skewed``.
+IMBALANCE_SKEWED_AT = 1.5
 
 #: Replica roles.  ``'both'`` replicas admit, prefill, and decode (the
 #: pure-routing fleet); ``'prefill'`` replicas admit + prefill and hand
@@ -189,6 +212,12 @@ class Router:
             # that finish prefill PARK (first token sampled, KV complete)
             # until the handoff exports them — engine.hold_decode
             self.replicas[i].hold_decode = role == "prefill"
+            # every engine event on the shared timeline carries which
+            # replica emitted it — what lets the fleet trace split the
+            # one log back into per-replica request streams and stitch
+            # a migrated request's instances into ONE journey
+            self.replicas[i]._ev = tag_events(
+                self.replicas[i]._ev, replica=i)
         #: compiled migrate_blocks programs, one per ((src, dst), compress)
         self._mig_fns: Dict[Tuple[int, int, bool], Any] = {}
         self.reset_metrics()
@@ -206,8 +235,12 @@ class Router:
         self._map: Dict[Tuple[int, int], int] = {}
         self.finished: Dict[int, Dict[str, Any]] = {}
         self.rejected: Dict[int, Dict[str, Any]] = {}
-        self._consumed: List[set] = [set() for _ in self.replicas]
-        self._rejected_seen: List[set] = [set() for _ in self.replicas]
+        # consumption pointers into each replica's arrival-ordered
+        # _finished_order/_rejected_order lists — _collect walks only
+        # the tail, so a 10^5-request replay stays O(completions) total
+        # instead of O(ticks * completions)
+        self._fin_ptr: List[int] = [0] * len(self.replicas)
+        self._rej_ptr: List[int] = [0] * len(self.replicas)
         self._last_faults = [0] * len(self.replicas)
         self._last_refused = [0] * len(self.replicas)
         self._tick = 0
@@ -247,19 +280,46 @@ class Router:
         return (-aff, est if est is not None else 0.0,
                 len(r.queue) + r.n_busy, i)
 
+    def _candidate_table(self, targets: List[int],
+                         tokens: Sequence[int]) -> List[Dict[str, Any]]:
+        """The decision ledger's input table: one row per candidate
+        replica with every signal :meth:`_score` ranks on.  Rows keep
+        the caller's (ranked) order — what makes a placement
+        attributable after the fact."""
+        rows = []
+        for i in targets:
+            r = self.replicas[i]
+            est = r.estimate_ttft(len(tokens), tokens=tokens)
+            rows.append({
+                "replica": i, "role": self.roles[i],
+                "affinity_tokens": int(r.prefix_lookup(tokens)),
+                "est_ttft_s": round(est, 6) if est is not None else None,
+                "load": len(r.queue) + r.n_busy,
+            })
+        return rows
+
     def submit(self, req: Request) -> int:
         """Route one request: candidates ranked by (affinity, estimated
         TTFT, load), tried best-first; a replica that sheds falls through
         to the next.  Returns the ROUTER rid; if every candidate refused,
-        the last structured verdict lands in ``self.rejected[rid]``."""
+        the last structured verdict lands in ``self.rejected[rid]``.
+        Every outcome — placed, fallthrough, or shed — lands on the
+        timeline as ONE ``route_decision`` record carrying the ranked
+        candidate table the decision was made from."""
         rid = self._next_rid
         self._next_rid += 1
         targets = self._submit_targets()
         if not targets:
             self.stats["router_shed"] += 1
+            self._ev.emit(
+                "route_decision", rid=rid, outcome="shed",
+                reason="no_replicas", candidates=[], fallthrough=[],
+                chosen=None, n_alive=sum(self.alive))
             self.rejected[rid] = {"rid": rid, "reason": "no_replicas"}
             return rid
         scored = sorted(targets, key=lambda i: self._score(i, req.tokens))
+        candidates = self._candidate_table(scored, req.tokens)
+        fallthrough: List[Dict[str, Any]] = []
         last_verdict: Dict[str, Any] = {}
         for rank, i in enumerate(scored):
             r = self.replicas[i]
@@ -267,6 +327,9 @@ class Router:
             rrid = r.submit(req)
             if rrid in r.rejected:
                 last_verdict = dict(r.rejected[rrid], replica=i)
+                fallthrough.append(
+                    {"replica": i,
+                     "reason": last_verdict.get("reason", "shed")})
                 continue
             self._track(i, rrid, rid)
             self.stats["routed"] += 1
@@ -276,12 +339,22 @@ class Router:
                 self.stats["fallbacks"] += 1
             est = r.estimate_ttft(len(req.tokens), tokens=req.tokens)
             self._ev.emit(
+                "route_decision", rid=rid, outcome="routed", chosen=i,
+                replica_rid=rrid, fallback_rank=rank,
+                candidates=candidates, fallthrough=fallthrough,
+                n_alive=sum(self.alive))
+            self._ev.emit(
                 "request_routed", rid=rid, replica=i, replica_rid=rrid,
                 affinity_tokens=int(aff), fallback_rank=rank,
                 est_ttft_s=round(est, 6) if est is not None else None,
                 queue_depth=len(r.queue))
             return rid
         self.stats["router_shed"] += 1
+        self._ev.emit(
+            "route_decision", rid=rid, outcome="shed",
+            reason=last_verdict.get("reason", "shed"),
+            candidates=candidates, fallthrough=fallthrough, chosen=None,
+            n_alive=sum(self.alive))
         self.rejected[rid] = dict(last_verdict, rid=rid,
                                   reason=last_verdict.get("reason", "shed"),
                                   routed=False)
@@ -297,11 +370,21 @@ class Router:
         key = (src, dst, compress)
         fn = self._mig_fns.get(key)
         if fn is None:
-            import jax
+            if getattr(self.replicas[dst].device_step, "host_only", False):
+                # host-only pools (serving/sim.py stub): same lane-vector
+                # copy, numpy instead of a compiled program — still one
+                # cached fn per (pair, wire format) so the signature
+                # accounting means the same thing on a replay fleet
+                from .sim import host_migrate_blocks
 
-            fn = jax.jit(
-                lambda s, d, si, di: migrate_blocks(
-                    s, d, si, di, compress=compress))
+                def fn(s, d, si, di, _c=compress):
+                    return host_migrate_blocks(s, d, si, di, compress=_c)
+            else:
+                import jax
+
+                fn = jax.jit(
+                    lambda s, d, si, di: migrate_blocks(
+                        s, d, si, di, compress=compress))
             self._mig_fns[key] = fn
         return fn
 
@@ -354,6 +437,18 @@ class Router:
             key=lambda i: (-self.replicas[i].prefix_lookup(tokens_full),
                            len(self.replicas[i].queue)
                            + self.replicas[i].n_busy, i))
+        candidates = []
+        for i in targets:
+            t = self.replicas[i]
+            candidates.append({
+                "replica": i,
+                "affinity_tokens": int(t.prefix_lookup(tokens_full)),
+                "load": len(t.queue) + t.n_busy,
+                "has_slot": any(s.state == "free" for s in t._slots),
+                "blocks_free": min(a.n_free + a.n_cached
+                                   for a in t._allocs),
+            })
+        router_rid = self._map.get((src, rid), -1)
         dst = next(
             (i for i in targets
              if any(s.state == "free" for s in self.replicas[i]._slots)
@@ -362,15 +457,23 @@ class Router:
             None)
         if dst is None:
             self.stats["handoffs_deferred"] += 1
+            self._ev.emit(
+                "handoff_decision", rid=router_rid, src_replica=src,
+                outcome="deferred", chosen=None, need_blocks=need,
+                candidates=candidates)
             return False
         desc, src_cache = p.export_slot(rid)
         d = self.replicas[dst]
         res = d.import_slot(desc)
-        if res is None:  # capacity raced away: put it back where it was
+        bounced = res is None
+        if bounced:  # capacity raced away: put it back where it was
             res = p.import_slot(desc)
             assert res is not None, "export_slot freed this capacity"
             dst, d = src, p
-        router_rid = self._map.get((src, rid), -1)
+        self._ev.emit(
+            "handoff_decision", rid=router_rid, src_replica=src,
+            outcome="bounced" if bounced else "handoff", chosen=dst,
+            need_blocks=need, candidates=candidates)
         self._track(dst, res["rid"], router_rid)
         n_mig = res["n_live"] - res["n_shared"]
         price = self._price_migration(src, dst, n_mig)
@@ -400,6 +503,7 @@ class Router:
         self._ev.emit(
             "request_migrated", rid=router_rid, src_replica=src,
             dst_replica=dst, mode="prefill_handoff",
+            src_rid=rid, dst_rid=res["rid"],
             emitted_tokens=len(desc.get("emitted") or []))
         return True
 
@@ -432,6 +536,7 @@ class Router:
                 self._ev.emit(
                     "request_migrated", rid=router_rid,
                     src_replica=exclude, dst_replica=i, mode=kind,
+                    src_rid=desc.get("orig_rid"), dst_rid=rrid,
                     emitted_tokens=len(desc.get("emitted") or []))
                 landed += 1
                 placed = True
@@ -443,36 +548,63 @@ class Router:
                     "kind": kind, "src_replica": exclude}
         return landed
 
-    def rebalance(self, src: int) -> int:
+    def rebalance(self, src: int, trigger: str = "manual") -> int:
         """Move queued work off replica ``src``: steal the tail of its
         queue (half the depth spread, at least 1) and resume it on the
         best surviving replicas.  KV-free, exact-parity (the PR-9
-        drain/resume contract).  Returns requests moved."""
-        depths = [len(self.replicas[i].queue)
-                  for i in self._submit_targets()]
+        drain/resume contract).  Returns requests moved.  Every attempt
+        — including one that found nothing to steal — lands as a
+        ``rebalance_decision`` record carrying the queue depths it saw
+        and what triggered the scan."""
+        targets = self._submit_targets()
+        depths = [len(self.replicas[i].queue) for i in targets]
         if not depths:
             return 0
         spread = len(self.replicas[src].queue) - min(depths)
         n = max(1, spread // 2)
         descs = self.replicas[src].steal_queued(n)
+        moved = self._resume_descs(descs, src, "rebalance") if descs else 0
+        self._ev.emit(
+            "rebalance_decision", src_replica=src, trigger=trigger,
+            depths=[[i, d] for i, d in zip(targets, depths)],
+            spread=int(spread), watermark=self.rebalance_watermark,
+            stolen=len(descs), moved=moved)
         if not descs:
             return 0
-        moved = self._resume_descs(descs, src, "rebalance")
         self.stats["rebalances"] += 1
         self.stats["rebalanced_requests"] += moved
         return moved
 
+    def set_alive(self, i: int, alive: bool, reason: str = "manual") -> None:
+        """Flip replica ``i``'s rotation bit, emitting ``replica_up`` /
+        ``replica_down`` with the reason — the ledger half of the
+        ROADMAP 2(a) autoscaler switch (today flipped by evacuations and
+        by hand; an autoscaler would call exactly this).  Bringing a
+        replica back up re-enters it into routing with whatever engine
+        state it still holds; a drained replica comes back EMPTY (its
+        requests were rehomed) but keeps its prefix cache, so revived
+        capacity is warm.  No-op when the bit already matches."""
+        alive = bool(alive)
+        if self.alive[i] == alive:
+            return
+        self.alive[i] = alive
+        self._ev.emit(
+            "replica_up" if alive else "replica_down", replica=i,
+            reason=reason, role=self.roles[i], zone=self.zones[i],
+            n_alive=sum(self.alive))
+
     def evacuate(self, i: int, reason: str = "manual") -> int:
         """Kill replica ``i``: drain it (queue + in-flight unwound into
-        exact-parity descriptors), take it out of rotation, and resume
-        everything on the survivors.  Returns requests rehomed."""
+        exact-parity descriptors), take it out of rotation
+        (``replica_down`` on the ledger), and resume everything on the
+        survivors.  Returns requests rehomed."""
         self._ev.emit("replica_degraded", replica=i, reason=reason,
                       action="evacuate",
                       faults=self.replicas[i].stats["faults_detected"],
                       queued=len(self.replicas[i].queue),
                       in_flight=self.replicas[i].n_busy)
         payload = self.replicas[i].drain()
-        self.alive[i] = False
+        self.set_alive(i, False, reason=reason)
         moved = self._resume_descs(payload["requests"], i, "evacuation")
         self.stats["evacuations"] += 1
         self.stats["evacuated_requests"] += moved
@@ -505,7 +637,7 @@ class Router:
                     "replica_degraded", replica=i, reason="overloaded",
                     action="rebalance",
                     shed=r.stats["shed"], expired=r.stats["expired"])
-                self.rebalance(i)
+                self.rebalance(i, trigger="overloaded")
             self._last_refused[i] = refused
 
     def _watermark_scan(self) -> None:
@@ -515,14 +647,12 @@ class Router:
         depths = {i: len(self.replicas[i].queue) for i in targets}
         deepest = max(depths, key=lambda i: depths[i])
         if depths[deepest] - min(depths.values()) > self.rebalance_watermark:
-            self.rebalance(deepest)
+            self.rebalance(deepest, trigger="watermark")
 
     def _collect(self) -> None:
         for i, r in enumerate(self.replicas):
-            for rrid, rec in r.finished.items():
-                if rrid in self._consumed[i]:
-                    continue
-                self._consumed[i].add(rrid)
+            for rrid in r._finished_order[self._fin_ptr[i]:]:
+                rec = r.finished[rrid]
                 router_rid = self._map.get((i, rrid))
                 if router_rid is None:
                     continue  # warmup traffic submitted around the router
@@ -530,16 +660,16 @@ class Router:
                                                  rid=router_rid)
                 self._t_first = min(self._t_first, rec["t_submit"])
                 self._t_last_done = max(self._t_last_done, rec["t_done"])
-            for rrid, verdict in r.rejected.items():
-                if rrid in self._rejected_seen[i]:
-                    continue
-                self._rejected_seen[i].add(rrid)
+            self._fin_ptr[i] = len(r._finished_order)
+            for rrid in r._rejected_order[self._rej_ptr[i]:]:
+                verdict = r.rejected[rrid]
                 router_rid = self._map.get((i, rrid))
                 if router_rid is not None and router_rid not in self.finished:
                     # a replica refused AFTER admission routing (queued
                     # deadline expiry): surface it at the router level
                     self.rejected[router_rid] = dict(verdict, replica=i,
                                                      rid=router_rid)
+            self._rej_ptr[i] = len(r._rejected_order)
 
     def step(self) -> Dict[str, int]:
         """One fleet tick: health/degradation scan → (periodic) queue
@@ -624,8 +754,12 @@ class Router:
         zone / liveness) and the fleet roll-up — fleet tokens/s and
         goodput over the ROUTER's span (necessarily ≤ the sum of
         replica rates, which validation enforces), affinity hit rate,
-        migration count/bytes, rebalance/evacuation counts, and the
-        per-replica verdict list."""
+        migration count/bytes, rebalance/evacuation counts, the
+        per-replica verdict list, plus the FLEETREPORT additions: a
+        ``slo`` block (fleet attainment, per-priority aggregation
+        across replicas, per-replica attainment/goodput) and a cited
+        ``balance`` verdict (``balanced|skewed|degraded`` off the
+        served-token imbalance index — :data:`IMBALANCE_SKEWED_AT`)."""
         replicas = []
         for i, r in enumerate(self.replicas):
             s = r.serving_summary()
@@ -636,17 +770,66 @@ class Router:
         goodput_tokens = sum(
             (r.get("slo") or {}).get("goodput_tokens", 0) for r in replicas)
         met = demand = 0
+        per_prio: Dict[Any, Dict[str, int]] = {}
+        per_replica_slo = []
         for r in replicas:
-            for row in ((r.get("slo") or {}).get("priorities") or {}).values():
+            for prio, row in (((r.get("slo") or {}).get("priorities")
+                               or {}).items()):
+                agg = per_prio.setdefault(
+                    prio, {"met": 0, "completed": 0, "shed": 0,
+                           "expired": 0})
+                for k in agg:
+                    agg[k] += row.get(k, 0)
                 met += row.get("met", 0)
                 demand += (row.get("completed", 0) + row.get("shed", 0)
                            + row.get("expired", 0))
+            per_replica_slo.append({
+                "index": r["index"],
+                "attainment": (r.get("slo") or {}).get("attainment"),
+                "goodput_tok_s": (r.get("slo") or {}).get(
+                    "goodput_tok_s", 0.0),
+            })
+        for prio, agg in per_prio.items():
+            d = agg["completed"] + agg["shed"] + agg["expired"]
+            agg["attainment"] = round(agg["met"] / d, 4) if d else None
         st = self.stats
         verdicts = [r["verdict"] for r in replicas]
         fleet_verdict = max(verdicts, key=lambda v: _VERDICT_RANK[v])
         if not all(self.alive):
             fleet_verdict = max(fleet_verdict, "degraded",
                                 key=lambda v: _VERDICT_RANK[v])
+        # FLEETREPORT balance verdict: cited, like the engine's own
+        # verdict_basis — degraded fleets don't get a balance opinion.
+        # Served tokens are only comparable between replicas of the SAME
+        # role (a disaggregated prefill tier generates no decode tokens
+        # by design), so the index is max-over-role-groups of max/mean
+        # within the group; past the line = skewed.
+        loads = [r["generated_tokens"] for r in replicas if r["alive"]]
+        imbalance = None
+        for role in ROLES:
+            group = [r["generated_tokens"] for r in replicas
+                     if r["alive"] and r["role"] == role]
+            mean_load = (sum(group) / len(group)) if group else 0.0
+            if mean_load > 0:
+                idx = max(group) / mean_load
+                imbalance = idx if imbalance is None else max(imbalance,
+                                                              idx)
+        if fleet_verdict != "healthy":
+            balance_verdict = "degraded"
+            basis = (f"fleet verdict {fleet_verdict} "
+                     f"({sum(self.alive)}/{len(self.replicas)} alive, "
+                     f"replica verdicts {verdicts})")
+        elif imbalance is not None and imbalance > IMBALANCE_SKEWED_AT:
+            balance_verdict = "skewed"
+            basis = (f"imbalance index {imbalance:.2f} > "
+                     f"{IMBALANCE_SKEWED_AT} (per-replica served tokens "
+                     f"{loads}, max/mean within role groups)")
+        else:
+            balance_verdict = "balanced"
+            basis = (f"imbalance index "
+                     f"{imbalance:.2f} <= {IMBALANCE_SKEWED_AT}"
+                     if imbalance is not None
+                     else "no tokens served yet")
         fleet = {
             "n_replicas": len(self.replicas),
             "n_alive": sum(self.alive),
@@ -658,6 +841,18 @@ class Router:
             "goodput_tok_s": (
                 goodput_tokens / span if span > 0 and gen else 0.0),
             "attainment": round(met / demand, 4) if demand else None,
+            "slo": {
+                "attainment": round(met / demand, 4) if demand else None,
+                "priorities": {str(k): v for k, v in per_prio.items()},
+                "per_replica": per_replica_slo,
+            },
+            "balance": {
+                "verdict": balance_verdict,
+                "imbalance_index": (round(imbalance, 4)
+                                    if imbalance is not None else None),
+                "loads": loads,
+                "basis": basis,
+            },
             "affinity": {
                 "routed": st["routed"],
                 "affinity_routed": st["affinity_routed"],

@@ -35,6 +35,18 @@ dicts; no device call, no new compiled program — the engine's
   automatically when serving events are present, so
   ``decode_bench --serve --trace out.json`` (and ``TDP_TRACE``) just
   work.
+- **Fleet stitching** (:func:`assemble_fleet_request_timelines`,
+  :func:`fleet_trace_events`).  A multi-replica timeline — every engine
+  tagged ``replica=i`` by the Router, router decisions interleaved —
+  stitches each ROUTER rid's engine instances into one journey:
+  ``request_routed`` names the first placement, ``request_migrated``
+  (``src_rid``/``dst_rid``) each cross-replica hop, ``blocks_migrated``
+  the priced KV legs.  The rendering gives each replica its own
+  Perfetto process, the router a decision lane, and draws ``route`` /
+  ``migrate`` flow arrows across processes, so a request that prefills
+  on replica A and decodes on replica B reads as ONE connected track.
+  ``serving_trace_events`` dispatches to it automatically when events
+  carry replica tags.
 - **Live export** (:func:`serving_metrics_record`).  Flattens a tick
   record into the documented ``serving_metrics`` schema
   (:data:`SERVING_METRICS_SCHEMA`; docs/serving.md "Serving
@@ -67,8 +79,11 @@ TICK_PHASES = ("audit", "sched", "prefill", "draft", "decode", "fetch",
 #: Request phase-span vocabulary (re-entered on preemption/requeue).
 REQUEST_PHASES = ("queued", "prefill", "decode")
 
-#: Terminal states a request instance can reach.
-REQUEST_TERMINALS = ("retired", "cancelled", "shed", "expired", "drained")
+#: Terminal states a request instance can reach.  ``exported`` ends an
+#: instance on the engine that migrated it out; the importing engine's
+#: instance (opened by ``request_imported``) continues the request.
+REQUEST_TERMINALS = ("retired", "cancelled", "shed", "expired", "drained",
+                     "exported")
 
 #: Chrome tids for the tick phase lanes (obs/trace.py owns 0-4 for the
 #: step spans; serving lanes start at 10).
@@ -245,6 +260,28 @@ def assemble_request_timelines(
                                  and e.get("action") == "requeued") else []
             for r in rids:
                 requeue(r, t, "fault_requeued")
+        elif kind == "request_imported":
+            # a migrated-in instance: opens straight in DECODE (no queue,
+            # no prefill — the KV arrives by migrate_blocks).  orig_rid
+            # names the SRC-engine instance; on a per-engine timeline
+            # that rid lives in another engine's namespace, so the
+            # cross-engine link is stitched at fleet scope
+            # (assemble_fleet_request_timelines), not here.
+            if rid in open_by_rid:  # rid reused without a terminal: rotate
+                _close_phase(open_by_rid[rid], t)
+                open_by_rid.pop(rid)
+            rec = _new_record(rid, len(all_by_rid.get(rid, [])))
+            records.append(rec)
+            open_by_rid[rid] = rec
+            all_by_rid.setdefault(rid, []).append(rec)
+            rec["args"] = {
+                k: e[k] for k in ("orig_rid", "n_shared", "n_live",
+                                  "emitted_tokens")
+                if e.get(k) is not None}
+            _mark(rec, "imported", t)
+            _open_phase(rec, "decode", t)
+        elif kind == "request_exported":
+            finish(rid, t, "exported")
         elif kind == "request_retired":
             finish(rid, t, "retired")
         elif kind == "request_cancelled":
@@ -458,11 +495,289 @@ def serving_trace_events(
     """Everything serving adds to a Chrome trace: request-flow tracks +
     tick lanes + counters.  ``obs.trace.chrome_trace_events`` calls this
     when serving events are on the timeline; pass the same ``t0`` the
-    rest of the trace uses so both land on one axis."""
+    rest of the trace uses so both land on one axis.
+
+    A FLEET timeline — engine events carrying the ``replica`` tag the
+    Router stamps on each engine's log — dispatches to
+    :func:`fleet_trace_events` instead: one Perfetto process per
+    replica plus the router decision lane, so two engines' tick lanes
+    never interleave on one track (``process`` is ignored; fleet pids
+    are fixed by :func:`fleet_pid`)."""
     if t0 is None:
         t0 = _serving_t0([e for e in events if "t_mono" in e])
+    if any(e.get("replica") is not None
+           and e.get("kind") not in ROUTER_EVENT_KINDS for e in events):
+        return fleet_trace_events(events, t0=t0)
     return (tick_trace_events(events, process=process, t0=t0)
             + request_trace_events(events, process=process, t0=t0))
+
+
+# ------------------------------------------------------ fleet (multi-replica)
+
+#: Event kinds emitted by the Router itself (the decision ledger + the
+#: PR-15 routing/migration records).  On a fleet timeline these stay on
+#: the router lane; everything else carrying a ``replica`` tag is an
+#: engine event and belongs to that replica's stream.
+ROUTER_EVENT_KINDS = frozenset({
+    "route_decision", "request_routed", "handoff_decision",
+    "rebalance_decision", "request_migrated", "blocks_migrated",
+    "replica_degraded", "replica_up", "replica_down",
+})
+
+#: Chrome pid of the router decision lane in a fleet trace.
+ROUTER_PID = 99
+
+
+def fleet_pid(replica: int) -> int:
+    """Chrome pid of replica ``i``'s process in a fleet trace."""
+    return 100 + int(replica)
+
+
+def _split_fleet_events(
+    events: Iterable[Dict[str, Any]],
+) -> tuple:
+    """Split one shared fleet timeline into the router's own events and
+    per-replica engine streams (keyed by the ``replica`` tag
+    ``Router.__init__`` stamps on each engine's log)."""
+    router_ev: List[Dict[str, Any]] = []
+    streams: Dict[Any, List[Dict[str, Any]]] = {}
+    for e in events:
+        if e.get("kind") is None or e.get("t_mono") is None:
+            continue
+        if e["kind"] in ROUTER_EVENT_KINDS:
+            router_ev.append(e)
+        elif e.get("replica") is not None:
+            streams.setdefault(e["replica"], []).append(e)
+    return router_ev, streams
+
+
+def _record_t0(rec: Dict[str, Any]) -> Optional[float]:
+    ts = [s["t0"] for s in rec["spans"]] + [m["t"] for m in rec["marks"]]
+    if rec.get("_t_phase") is not None:
+        ts.append(rec["_t_phase"])
+    return min(ts) if ts else None
+
+
+def _record_t1(rec: Dict[str, Any]) -> Optional[float]:
+    ts = [s["t1"] for s in rec["spans"]] + [m["t"] for m in rec["marks"]]
+    if rec.get("_t_phase") is not None:
+        ts.append(rec["_t_phase"])
+    return max(ts) if ts else None
+
+
+def _find_instance(
+    records: Sequence[Dict[str, Any]], engine_rid: Any, t: float,
+) -> Optional[Dict[str, Any]]:
+    """The request instance a router record at time ``t`` refers to: the
+    LATEST instance of that engine rid that had already started (engine
+    rids are reused, so 'rid 3 on replica 1' alone is ambiguous — 'rid 3
+    on replica 1 as of t' is not: the engine-side event precedes the
+    router record that cites it)."""
+    best, best_t = None, None
+    for r in records:
+        if r["rid"] != engine_rid:
+            continue
+        rt = _record_t0(r)
+        if rt is None or rt > t + 1e-6:
+            continue
+        if best is None or rt >= best_t:
+            best, best_t = r, rt
+    return best
+
+
+def assemble_fleet_request_timelines(
+    events: Iterable[Dict[str, Any]],
+) -> Dict[str, Any]:
+    """Stitch one shared fleet timeline into per-ROUTER-rid journeys.
+
+    Splits the timeline on the ``replica`` tag, assembles each replica's
+    engine events with :func:`assemble_request_timelines` (uids become
+    ``"r<replica>/<rid>.<n>"``), then walks the router's own records to
+    link each router rid's engine instances in placement order:
+    ``request_routed`` names the first hop (replica + engine rid), each
+    ``request_migrated`` names the next (``src_rid``/``dst_rid`` pin the
+    exact instances), and ``blocks_migrated`` prices the KV legs.
+
+    Returns ``{"journeys", "replicas", "router_events"}``; each journey
+    is ``{rid, hops, decisions, migrations, sequence, outcome}`` where
+    ``sequence`` is the request's full cross-replica phase walk
+    (``@replica<i>`` markers between hops) — what "a migrated request
+    reconstructs from the trace alone" means at fleet scope."""
+    router_ev, streams = _split_fleet_events(events)
+    replicas: Dict[Any, List[Dict[str, Any]]] = {}
+    for rep in sorted(streams):
+        recs = assemble_request_timelines(streams[rep])
+        rename = {r["uid"]: f"r{rep}/{r['uid']}" for r in recs}
+        for r in recs:
+            r["replica"] = rep
+            r["uid"] = rename[r["uid"]]
+            if r["resumed_from"] in rename:
+                r["resumed_from"] = rename[r["resumed_from"]]
+            if r["resumed_to"] in rename:
+                r["resumed_to"] = rename[r["resumed_to"]]
+        replicas[rep] = recs
+
+    journeys: Dict[Any, Dict[str, Any]] = {}
+    order: List[Dict[str, Any]] = []
+
+    def journey(rid: Any) -> Dict[str, Any]:
+        j = journeys.get(rid)
+        if j is None:
+            j = {"rid": rid, "hops": [], "decisions": [],
+                 "migrations": [], "sequence": [], "outcome": None}
+            journeys[rid] = j
+            order.append(j)
+        return j
+
+    def uid_of(rep: Any, erid: Any, t: float) -> Optional[str]:
+        rec = _find_instance(replicas.get(rep, ()), erid, t)
+        return rec["uid"] if rec is not None else None
+
+    for e in router_ev:
+        kind, t, rid = e["kind"], e["t_mono"], e.get("rid")
+        if kind == "route_decision":
+            j = journey(rid)
+            j["decisions"].append(
+                {"kind": kind, "t": t, "outcome": e.get("outcome"),
+                 "chosen": e.get("chosen")})
+            if e.get("outcome") == "shed":
+                j["outcome"] = "shed"
+        elif kind == "request_routed":
+            journey(rid)["hops"].append(
+                {"replica": e.get("replica"),
+                 "engine_rid": e.get("replica_rid"),
+                 "uid": uid_of(e.get("replica"), e.get("replica_rid"), t),
+                 "via": "routed", "t": t})
+        elif kind == "handoff_decision":
+            journey(rid)["decisions"].append(
+                {"kind": kind, "t": t, "outcome": e.get("outcome"),
+                 "chosen": e.get("chosen")})
+        elif kind == "request_migrated":
+            journey(rid)["hops"].append(
+                {"replica": e.get("dst_replica"),
+                 "engine_rid": e.get("dst_rid"),
+                 "uid": uid_of(e.get("dst_replica"), e.get("dst_rid"), t),
+                 "via": e.get("mode", "migrated"), "t": t,
+                 "src_replica": e.get("src_replica"),
+                 "src_rid": e.get("src_rid")})
+        elif kind == "blocks_migrated":
+            journey(rid)["migrations"].append(
+                {"t": t, "src_replica": e.get("src_replica"),
+                 "dst_replica": e.get("dst_replica"),
+                 "n_blocks": e.get("n_blocks"),
+                 "n_shared": e.get("n_shared"),
+                 "bytes": e.get("bytes"),
+                 "compressed": e.get("compressed"), "dcn": e.get("dcn")})
+
+    by_uid = {r["uid"]: r
+              for recs in replicas.values() for r in recs}
+    for j in order:
+        seq: List[str] = []
+        for h in j["hops"]:
+            rec = by_uid.get(h["uid"])
+            if rec is None:
+                continue
+            seq.append(f"@replica{h['replica']}")
+            seq.extend(rec["sequence"])
+        j["sequence"] = seq
+        if j["outcome"] is None and j["hops"]:
+            last = by_uid.get(j["hops"][-1]["uid"])
+            if last is not None:
+                j["outcome"] = last["terminal"]
+    return {"journeys": order, "replicas": replicas,
+            "router_events": router_ev}
+
+
+def fleet_trace_events(
+    events: Sequence[Dict[str, Any]],
+    t0: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """Chrome trace events for a multi-replica fleet timeline: one
+    Perfetto process per replica (pid :func:`fleet_pid`, carrying that
+    engine's tick lanes + request tracks exactly as the single-engine
+    renderer draws them), a ``router`` process (pid :data:`ROUTER_PID`)
+    with one instant per decision-ledger record, a ``route`` flow arrow
+    from each placement decision to the engine instance it created, and
+    a ``migrate`` flow arrow across processes for every cross-replica
+    hop — carrying the priced wire bytes from ``blocks_migrated`` — so
+    a migrated request reads as ONE connected track in
+    https://ui.perfetto.dev."""
+    router_ev, streams = _split_fleet_events(events)
+    all_ev = router_ev + [e for s in streams.values() for e in s]
+    if t0 is None:
+        t0 = _serving_t0(all_ev)
+    if t0 is None:
+        return []
+
+    def us(t: float) -> float:
+        return round(max(t - t0, 0.0) * 1e6, 3)
+
+    fleet = assemble_fleet_request_timelines(events)
+    by_uid = {r["uid"]: r
+              for recs in fleet["replicas"].values() for r in recs}
+    out: List[Dict[str, Any]] = [
+        {"ph": "M", "name": "process_name", "pid": ROUTER_PID, "tid": 0,
+         "args": {"name": "router"}},
+        {"ph": "M", "name": "process_sort_index", "pid": ROUTER_PID,
+         "tid": 0, "args": {"sort_index": ROUTER_PID}},
+        {"ph": "M", "name": "thread_name", "pid": ROUTER_PID, "tid": 0,
+         "args": {"name": "decisions"}},
+    ]
+    for rep in sorted(fleet["replicas"]):
+        pid = fleet_pid(rep)
+        out.append({"ph": "M", "name": "process_name", "pid": pid,
+                    "tid": 0, "args": {"name": f"replica{rep}"}})
+        out.append({"ph": "M", "name": "process_sort_index", "pid": pid,
+                    "tid": 0, "args": {"sort_index": pid}})
+        out.extend(tick_trace_events(streams[rep], process=pid, t0=t0))
+        out.extend(request_trace_events(streams[rep], process=pid, t0=t0))
+    # the router decision lane: every ledger record, with its evidence
+    for e in router_ev:
+        args = {k: v for k, v in e.items()
+                if k not in ("type", "kind", "t_wall", "t_mono", "process")}
+        out.append({"ph": "i", "name": e["kind"], "cat": "router",
+                    "s": "t", "pid": ROUTER_PID, "tid": 0,
+                    "ts": us(e["t_mono"]), "args": args})
+    # flow arrows: router -> first placement, then hop -> hop
+    for j in fleet["journeys"]:
+        hops = [h for h in j["hops"] if h["uid"] in by_uid]
+        if not hops:
+            continue
+        fid = f"route-{j['rid']}"
+        out.append({"ph": "s", "cat": "flow", "name": "route", "id": fid,
+                    "pid": ROUTER_PID, "tid": 0, "ts": us(hops[0]["t"])})
+        out.append({"ph": "f", "bp": "e", "cat": "flow", "name": "route",
+                    "id": fid, "pid": fleet_pid(hops[0]["replica"]),
+                    "tid": 0, "ts": us(hops[0]["t"])})
+        for k, h in enumerate(hops[1:]):
+            src_rep = h.get("src_replica")
+            src = _find_instance(
+                fleet["replicas"].get(src_rep, ()), h.get("src_rid"),
+                h["t"]) if src_rep is not None else None
+            t_s = _record_t1(src) if src is not None else h["t"]
+            t_s = h["t"] if t_s is None else min(t_s, h["t"])
+            dst = by_uid[h["uid"]]
+            t_f = _record_t0(dst)
+            t_f = t_s if t_f is None else max(t_f, t_s)
+            args = {"via": h["via"]}
+            legs = [m for m in j["migrations"]
+                    if m.get("src_replica") == src_rep
+                    and m.get("dst_replica") == h["replica"]]
+            if legs:
+                leg = min(legs, key=lambda m: abs(m["t"] - h["t"]))
+                args.update({kk: leg[kk] for kk in
+                             ("n_blocks", "n_shared", "bytes",
+                              "compressed", "dcn") if kk in leg})
+            mid = f"mig-{j['rid']}-{k}"
+            out.append({"ph": "s", "cat": "flow", "name": "migrate",
+                        "id": mid, "pid": fleet_pid(src_rep)
+                        if src_rep is not None else ROUTER_PID,
+                        "tid": 0, "ts": us(t_s), "args": args})
+            out.append({"ph": "f", "bp": "e", "cat": "flow",
+                        "name": "migrate", "id": mid,
+                        "pid": fleet_pid(h["replica"]), "tid": 0,
+                        "ts": us(t_f)})
+    return out
 
 
 # ---------------------------------------------------------- operator table

@@ -78,7 +78,7 @@ AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
             "slo_attainment", "goodput_tok_s", "paged_pallas_tok_s",
             "autoplan_tok_s", "plan_modeled_step_s", "bubble_fraction",
             "plan_pp_schedule", "fleet_goodput_tok_s", "affinity_hit_rate",
-            "migration_bytes")
+            "migration_bytes", "fleet_slo_attainment", "migration_count")
 
 
 def _aux_str(key: str, val: Any) -> str:

@@ -735,6 +735,9 @@ def bench_serve_router(jax, jnp, cfg, params, tel, *, n_replicas,
         "affinity_hit_rate": round(aff["hit_rate"], 4),
         "fleet_goodput_tok_s": round(
             fleet["fleet"]["goodput_tok_s"], 1),
+        "fleet_slo_attainment": (
+            round(fleet["fleet"]["attainment"], 4)
+            if fleet["fleet"]["attainment"] is not None else None),
         "migration_count": mig["handoffs"],
         "migration_bytes": mig["bytes"],
         "migration_shared_blocks": mig["shared_blocks"],
