@@ -122,11 +122,14 @@ LONG_CANDIDATES = [
 # all_to_all wraps at scale).  4-tuples: (batch, remat, xent_chunk,
 # dispatch).  Measured 2026-07-31 (docs/BENCH_AB.md): b8 sorted 66,636
 # tok/s (MFU 0.358 activated) wins; sorted beats dense 10.2% at the
-# identical b2 config — XLA's gather/scatter lowering leaves nothing on
-# the table, so no fused Pallas dispatch kernel is needed.  Dense at
-# b>=4 is untestable (the [T, E, C] one-hots alone exceed HBM).
+# identical b2 config.  Dense at b>=4 is untestable (the [T, E, C]
+# one-hots alone exceed HBM).  PR 18 adds the fused Pallas dispatch
+# ('pallas': gather -> expert FFN -> weighted scatter in one kernel, no
+# [E, C, D] slot view in HBM — ops/moe_dispatch.py) as a paired arm
+# against the sorted incumbent; on-chip numbers pending the tunnel.
 MOE_CANDIDATES = [
     (8, "flash", None, "sorted"),
+    (8, "flash", None, "pallas"),
     (16, "flash", None, "sorted"),
     (2, "flash", None, "sorted"),
     (2, "flash", None, "dense"),
@@ -591,13 +594,73 @@ def _run_pp_plan_config(jax, jnp, cfg, chosen, batch_size, steps, warmup,
     return global_batch * cfg.max_seq * steps / dt / n_chips, dt / steps
 
 
+def _run_moe_plan_config(jax, jnp, cfg, chosen, batch_size, steps, warmup,
+                         remat):
+    """Time a MoE plan (tokens/sec/chip, mean step seconds) through a
+    GSPMD jit step: the plan's mesh (``data x ep x tensor``) with the
+    REAL ``gpt_moe_param_specs`` tree from ``plan_param_specs`` (expert
+    stacks sharded over ``ep``, router replicated) and the batch over
+    ``("data", "ep")`` — XLA derives the dispatch all_to_all the ep
+    sharding implies, which is exactly the collective the planner's
+    ``moe-all-to-all`` term prices."""
+    import optax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from torchdistpackage_tpu.dist import autoplan as _autoplan
+    from torchdistpackage_tpu.models import gpt_moe_loss, init_gpt_moe_params
+
+    params = init_gpt_moe_params(jax.random.PRNGKey(0), cfg)
+
+    def loss_fn(p, batch):
+        return gpt_moe_loss(p, batch, cfg, remat=remat)
+
+    opt = optax.adamw(3e-4)
+    state = opt.init(params)
+    mesh = _autoplan.build_mesh(chosen)
+    n_chips = max(1, jax.device_count())
+    specs = _autoplan.plan_param_specs(chosen, cfg)
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: x is None)
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    global_batch = batch_size * n_chips
+    batch = jax.device_put({
+        "tokens": jax.random.randint(
+            k1, (global_batch, cfg.max_seq), 0, cfg.vocab_size),
+        "targets": jax.random.randint(
+            k2, (global_batch, cfg.max_seq), 0, cfg.vocab_size),
+    }, NamedSharding(mesh, _autoplan.batch_partition_spec(chosen)))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, state = opt.update(grads, state, params)
+        return jax.tree.map(jnp.add, params, updates), state, loss
+
+    for _ in range(warmup):
+        params, state, loss = step(params, state, batch)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, state, loss = step(params, state, batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return global_batch * cfg.max_seq * steps / dt / n_chips, dt / steps
+
+
 def _run_plan_config(jax, jnp, cfg, chosen, batch_size, steps, warmup, remat,
                      xent_chunk=None, microbatches=8):
     """Time the planner-chosen plan (tokens/sec/chip) through the same
-    model/batch/steps as :func:`_run_config`.  Three runners cover every
+    model/batch/steps as :func:`_run_config`.  Four runners cover every
     executable plan (``dist.autoplan.enumerate_candidates(
     executable_only=True)``):
 
+    - MoE configs -> :func:`_run_moe_plan_config` (GSPMD over the plan's
+      ``data x ep x tensor`` mesh; MoE plans are always pp == 1, dp
+      layout, uncompressed);
     - pure dp with grad compression -> ``DataParallel(grad_compress=
       'int8')`` (the int8 ring only exists on the shard_map path);
     - ``pp > 1`` -> the pipeline runner (:func:`_run_pp_plan_config`)
@@ -608,6 +671,9 @@ def _run_plan_config(jax, jnp, cfg, chosen, batch_size, steps, warmup, remat,
       planner scored."""
     import optax
 
+    if getattr(cfg, "moe_experts", 0):
+        return _run_moe_plan_config(
+            jax, jnp, cfg, chosen, batch_size, steps, warmup, remat)
     if chosen["pp"] > 1:
         return _run_pp_plan_config(
             jax, jnp, cfg, chosen, batch_size, steps, warmup, remat,
@@ -844,6 +910,8 @@ def _run_autoplan(jax, jnp, cfg, batch_size, steps, warmup, remat,
         if arm == "planned":
             mvm = result["modeled_vs_measured"]["rows"][0]
             line["plan"] = chosen["key"]
+            if chosen.get("ep"):
+                line["plan_ep"] = chosen["ep"]
             line["autoplan_tok_s"] = round(tps, 2)
             line["plan_modeled_step_s"] = round(chosen["step_s"], 6)
             line["plan_measured_step_s"] = round(step_plan, 6)
@@ -928,6 +996,13 @@ def main(jax, jnp, ab: bool = False, only=None, big: bool = False,
         candidates = [(4, False, None)]
         steps, warmup = 5, 2
         size_tag = "tiny"
+        if moe:
+            # tiny-MoE CPU leaf: keeps --moe --autoplan runnable on the
+            # 8-device sim (the planner's ep arms need experts to shard)
+            cfg = dataclasses.replace(
+                cfg, moe_experts=4, moe_top_k=2, moe_every=2)
+            candidates = [(4, False, None, "sorted")]
+            size_tag = "tiny-moe"
 
     baseline_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")

@@ -123,7 +123,57 @@ def main():
     )
     stats["router_entropy"] = float(router["router_entropy"])
     tel.record_counters(moe=stats)
-    tel.finalize()
+
+    # --- auto-sharding planner phase (docs/autoplan.md, PR 18): the
+    # hand-picked EP split above is exactly the decision the planner now
+    # makes for MoE configs — ep arms over divisors of dp that divide E,
+    # activated-FLOP pricing (top_k·cf/E per expert leaf), the ep-axis
+    # all_to_all comm term, and the expert stacks' residency at each EP
+    # sharding judged by MemoryModel before any compile.  Prove the
+    # chosen plan compiles and trains via plain GSPMD (XLA derives the
+    # dispatch all_to_all from the ep-sharded expert specs).
+    from torchdistpackage_tpu.dist import autoplan
+
+    presult = autoplan.plan(
+        cfg, ndev, global_batch=B, seq_len=cfg.max_seq,
+        executable_only=True, device_kind=jax.devices()[0].device_kind)
+    chosen = presult["chosen"]
+    assert chosen is not None, "no MoE plan fits this host's memory budget"
+    eps = sorted({c.get("ep", 1) for c in presult["ranked"]})
+    print(f"autoplan: chose {chosen['key']} of "
+          f"{presult['n_candidates']} candidates (ep arms {eps}, "
+          f"{presult['n_pruned_oom']} pruned OOM), modeled step "
+          f"{chosen['step_s'] * 1e3:.3f} ms")
+    pmesh = autoplan.build_mesh(chosen)
+    pspecs = autoplan.plan_param_specs(chosen, cfg)
+    pparams = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(pmesh, s)),
+        init_gpt_moe_params(jax.random.PRNGKey(7), cfg), pspecs)
+    popt = optax.adam(1e-3)
+    pstate = jax.device_put(popt.init(pparams), NamedSharding(pmesh, P()))
+    pbatch = jax.device_put(
+        {"tokens": tokens, "targets": targets},
+        NamedSharding(pmesh, autoplan.batch_partition_spec(chosen)))
+
+    @jax.jit
+    def plan_step(p, s, b):
+        loss, grads = jax.value_and_grad(
+            lambda p_: gpt_moe_loss(p_, b, cfg))(p)
+        updates, s = popt.update(grads, s)
+        return jax.tree.map(jnp.add, p, updates), s, loss
+
+    plosses = []
+    for _ in range(3):
+        pparams, pstate, ploss = plan_step(pparams, pstate, pbatch)
+        plosses.append(float(ploss))
+    assert np.isfinite(plosses).all(), plosses
+    assert plosses[-1] < plosses[0], f"planned MoE layout failed to train: {plosses}"
+    print(f"autoplan: plan {chosen['key']} trains "
+          f"(loss {plosses[0]:.4f} -> {plosses[-1]:.4f})")
+    tel.record_autoplan(presult)
+
+    report = tel.finalize()
+    assert report["autoplan"]["chosen"]["key"] == chosen["key"]
     print(
         f"expert load: imbalance={stats['imbalance']:.3f} "
         f"entropy={stats['load_entropy']:.3f} "

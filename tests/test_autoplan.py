@@ -276,9 +276,86 @@ def test_plan_selected_event_emitted():
     assert evs[-1]["n_candidates"] == res["n_candidates"]
 
 
-def test_moe_config_rejected_loudly():
-    with pytest.raises(ValueError, match="MoE"):
-        ap.plan(dict(TINY_DICT, moe_experts=8), 8, global_batch=8)
+# ------------------------------------------------------------- MoE / EP (PR 18)
+
+MOE_TINY = GPTConfig(vocab_size=64, dim=32, nheads=4, nlayers=4, max_seq=32,
+                     moe_experts=4, moe_top_k=2, moe_every=2,
+                     moe_capacity_factor=2.0, dtype=jnp.float32)
+
+
+def test_moe_plan_enumerates_ep_candidates():
+    """MoE configs plan instead of raising (PR 18): every dp x tp point
+    crosses in ep | gcd(dp, E) arms with a dedicated ``ep`` mesh axis
+    (``data = dp/ep``); pp, fsdp, and compression stay out of the MoE
+    set; ep>1 rows price the dispatch all_to_all over the ep axis."""
+    res = ap.plan(MOE_TINY, 8, global_batch=8, memory="model",
+                  comm_model=_cpu_model(), emit=False, top=64)
+    assert res["verdict"] == "ok" and res["chosen"] is not None
+    assert _validate_autoplan(res) == []
+    rows = res["ranked"]
+    assert {1, 2, 4} <= {r.get("ep", 1) for r in rows}
+    for r in rows:
+        assert r["pp"] == 1 and r["layout"] == "dp"
+        assert not r["compress"]["grads"] and not r["compress"]["acts"]
+        assert r["mesh_axes"]["data"] * r["mesh_axes"]["ep"] == r["dp"]
+        if r["ep"] > 1:
+            assert f"ep{r['ep']}" in r["key"]
+        else:
+            assert "ep" not in r["key"]
+    d = ap.model_dims(MOE_TINY)
+    ep_row = next(r for r in rows if r["ep"] > 1)
+    a2a = [t for t in ap.comm_terms(d, ep_row, 8, _cpu_model())
+           if t["name"] == "moe-all-to-all"]
+    assert a2a and a2a[0]["op"] == "all_to_all" and a2a[0]["axes"] == ["ep"]
+    assert a2a[0]["count"] == 4 * d.n_moe_layers
+    assert all(t["name"] != "moe-all-to-all" for t in ap.comm_terms(
+        d, next(r for r in rows if r["ep"] == 1), 8, _cpu_model()))
+    # activated-FLOP accounting: the capacity factor inflates the expert
+    # FLOP term (flop_weight = top_k * cf / E on expert leaves)
+    import dataclasses as _dc
+
+    d2 = _dc.replace(d, moe_capacity_factor=2 * d.moe_capacity_factor)
+    assert ap.flops_per_token(d2) > ap.flops_per_token(d)
+
+
+def test_moe_memory_pin_and_shape_table():
+    """The PR-13 byte-identical pin extends to MoE: the analytic mirror
+    equals ``MemoryModel.estimate`` over the REAL gpt_moe spec tree
+    (expert stacks EP-sharded via ``gpt_moe_param_specs``) for EVERY
+    candidate, and the analytic table matches ``jax.eval_shape`` of
+    ``init_gpt_moe_params`` leaf-for-leaf in count and bytes."""
+    from torchdistpackage_tpu.obs.mem_ledger import _shapes_for_config
+
+    d = ap.model_dims(MOE_TINY)
+    leaves = jax.tree.leaves(_shapes_for_config(MOE_TINY))
+    real_bytes = sum(
+        int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize for l in leaves)
+    table = ap.param_table(d)
+    table_bytes = sum(
+        r.count * int(np.prod(r.shape)) * d.dtype_size for r in table)
+    assert table_bytes == real_bytes
+    assert sum(r.count for r in table) == len(leaves)
+    for c in ap.enumerate_candidates(d, 8, 8):
+        a = ap.estimate_memory_analytic(d, c, 8, capacity_bytes=10**9)
+        m = ap.estimate_memory_model(MOE_TINY, c, 8, capacity_bytes=10**9)
+        for k in ("params_bytes", "grads_bytes", "opt_bytes", "act_bytes",
+                  "total_bytes"):
+            assert a[k] == m[k], (c["key"], k, a[k], m[k])
+        assert a["verdict"] == m["verdict"], c["key"]
+        # ep>1 shrinks per-device expert bytes vs its ep=1 sibling
+        if c["ep"] > 1:
+            sib = dict(c, ep=1, mesh_axes=dict(c["mesh_axes"],
+                                               data=c["dp"], ep=1))
+            assert a["params_bytes"] < ap.estimate_memory_analytic(
+                d, sib, 8, capacity_bytes=10**9)["params_bytes"]
+
+
+def test_moe_transformer_family_rejected():
+    """The transformer family has no expert blocks — a dict config with
+    experts but no vocab still fails loudly instead of mispricing."""
+    with pytest.raises(ValueError, match="gpt"):
+        ap.model_dims({"dim": 64, "nheads": 4, "nlayers": 2,
+                       "moe_experts": 4})
 
 
 # ------------------------------------------------- measured validation arm

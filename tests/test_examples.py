@@ -50,7 +50,11 @@ OBS_EXAMPLES = {
     # zb strictly below the 1f1b reference) and the schedule-build events
     "train_zb_pipeline.py": {
         "counter": "pipeline", "field": "bubble_fraction", "zb": True},
-    "train_moe.py": {"counter": "moe", "field": "imbalance", "comm": "moe"},
+    # ``autoplan`` additionally probes the PR-18 MoE planner phase: the
+    # ep-arm enumeration, the chosen plan's GSPMD training proof, and the
+    # validated section riding the same RUNREPORT
+    "train_moe.py": {"counter": "moe", "field": "imbalance", "comm": "moe",
+                     "autoplan": True},
     # overlap-audited examples (PR 3): GSPMD FSDP's param all-gathers and
     # the ZeRO owner-scatter both ledger onto the data axis.  ``memory``
     # probes the PR-6 mem-ledger section; for the FSDP example the probe
@@ -221,6 +225,12 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
         assert 0 <= aps["n_pruned_oom"] <= aps["n_candidates"]
         kinds = {e["kind"] for e in report["events"]}
         assert "plan_selected" in kinds, kinds
+        if script == "train_moe.py":
+            # PR 18: the MoE planner emitted real ep arms — the chosen
+            # plan carries the ep mesh factor and the ranked set crossed
+            # in ep>1 candidates (8 experts / 8 sim devices)
+            assert "ep" in aps["chosen"]["mesh_axes"], aps["chosen"]
+            assert any(r.get("ep", 1) > 1 for r in aps["ranked"]), aps
 
     if probe.get("memory"):
         # the PR-6 memory section: per-program static breakdown captured

@@ -346,7 +346,28 @@ def test_bench_trend_fleet_slo_columns():
     assert any("migration_count=12" in ln for ln in report)
     assert any("fleet_slo_attainment=0.4" in ln for ln in report)
     assert any("migration_count=480" in ln for ln in report)
-    assert any("REGRESSION serve-router-fleet" in w for w in warnings)
+
+
+def test_bench_trend_moe_columns():
+    """The PR-18 MoE dispatch columns: ``moe_pallas_tok_s`` and
+    ``expert_imbalance`` ride the ``serve-moe-ab`` line — a speedup
+    hold earned while the imbalance column climbs means the router is
+    feeding the fused kernel ever-more-skewed batches (capacity drops
+    coming), and a headline regression still trips the gate."""
+    from torchdistpackage_tpu.tools.bench_trend import AUX_KEYS, trend
+
+    assert {"moe_pallas_tok_s", "expert_imbalance"} <= set(AUX_KEYS)
+    line = {"metric": "serve-moe-ab", "value": 1.2,
+            "moe_pallas_tok_s": 900.0, "expert_imbalance": 0.45,
+            "config": "c"}
+    report, warnings = trend(
+        [(1, [line]),
+         (2, [dict(line, value=0.9, expert_imbalance=1.8)])],
+        threshold=0.05)
+    assert any("moe_pallas_tok_s=900.0" in ln for ln in report)
+    assert any("expert_imbalance=0.45" in ln for ln in report)
+    assert any("expert_imbalance=1.8" in ln for ln in report)
+    assert any("REGRESSION serve-moe-ab" in w for w in warnings)
 
 
 def test_bench_trend_paged_kernel_column():

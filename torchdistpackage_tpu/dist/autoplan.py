@@ -17,7 +17,10 @@ three at once: given a model config and a chip count it
    int8 compression arms (grad collectives on the data axis, SP boundary
    activations on the tensor axis — exactly the knobs
    ``DataParallel(grad_compress=...)`` / ``TransformerConfig(ag_compress=
-   ...)`` expose);
+   ...)`` expose) — MoE GPT configs additionally cross in an
+   expert-parallel factor ``ep | gcd(dp, experts)`` (expert stacks
+   sharded over a dedicated ``ep`` mesh axis, the batch over
+   ``("data", "ep")``, the dispatch all_to_all priced per MoE layer);
 2. **prunes** candidates whose modeled per-device resident bytes exceed
    the HBM budget — ``MemoryModel.estimate`` is the judge when jax is
    importable (``memory='model'``), a byte-identical pure-python mirror
@@ -97,18 +100,35 @@ class ModelDims:
     norm: str = "layer"
     pos: str = "learned"
     dtype_size: int = 4
+    # MoE (0 experts = dense).  Every ``moe_every``-th block's FFN is an
+    # expert layer; top_k routing with the Switch capacity bound inflates
+    # the expert FLOP term by ``top_k * capacity_factor / experts``.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+
+    @property
+    def n_moe_layers(self) -> int:
+        """MoE blocks in the stack — ``is_moe_block`` places one at every
+        ``moe_every``-th position, so exactly ``L // moe_every``."""
+        if not self.moe_experts:
+            return 0
+        return self.nlayers // max(self.moe_every, 1)
 
 
 def model_dims(config: Any) -> ModelDims:
     """Normalize a GPTConfig / TransformerConfig / dict into
-    :class:`ModelDims`.  MoE configs are rejected loudly — expert/routing
-    traffic is not modeled here (the EP all_to_all needs its own terms)."""
+    :class:`ModelDims`.  MoE GPT configs carry the expert dims through
+    (the planner prices the EP all_to_all and the capacity-inflated
+    expert FLOPs); the transformer family has no MoE variant."""
     get = (config.get if isinstance(config, dict)
            else lambda k, d=None: getattr(config, k, d))
-    if get("moe_experts", 0):
+    moe_experts = int(get("moe_experts", 0) or 0)
+    if moe_experts and not get("vocab_size"):
         raise ValueError(
-            "autoplan does not model MoE configs (EP all_to_all + expert "
-            "capacity terms are unmodeled); plan the dense trunk instead")
+            "MoE planning needs the gpt family (gpt_moe) — the "
+            "transformer family has no expert blocks")
     dim = int(get("dim"))
     ffn = get("ffn_hidden") or dim * int(get("ffn_mult", 4))
     dtype = get("dtype", "float32")
@@ -132,6 +152,10 @@ def model_dims(config: Any) -> ModelDims:
         norm=str(get("norm", "layer")),
         pos=str(get("pos", "learned")),
         dtype_size=dtype_size,
+        moe_experts=moe_experts,
+        moe_top_k=int(get("moe_top_k", 2) or 2),
+        moe_every=int(get("moe_every", 2) or 2),
+        moe_capacity_factor=float(get("moe_capacity_factor", 1.25) or 1.25),
     )
 
 
@@ -148,6 +172,11 @@ class LeafRow:
     stack_dim: Optional[int] = None
     count: int = 1
     matmul: bool = True  # counted by the 6N FLOP formula
+    ep_dim: Optional[int] = None  # dim the expert-parallel axis shards
+    #: FLOP multiplier vs a dense leaf — expert leaves carry
+    #: ``top_k * capacity_factor / experts`` (each token visits top_k of
+    #: E experts, padded to the Switch capacity bound).
+    flop_weight: float = 1.0
 
 
 def _block_rows(d: ModelDims) -> List[LeafRow]:
@@ -193,11 +222,46 @@ def _block_rows(d: ModelDims) -> List[LeafRow]:
     return rows
 
 
+def _moe_rows(d: ModelDims, count: int) -> List[LeafRow]:
+    """The expert-layer leaves of one MoE block — the analytic mirror of
+    ``parallel.moe.init_moe_params`` / ``moe_param_specs``: router
+    replicated, stacked expert arrays EP-sharded on dim 0.  Expert leaves
+    carry the capacity-inflated FLOP weight (a token runs top_k of E
+    experts, each padded to the Switch capacity bound)."""
+    D, F, E = d.dim, d.ffn, d.moe_experts
+    w = d.moe_top_k * d.moe_capacity_factor / E
+    rows = [LeafRow("moe.router.w", (D, E), count=count)]
+    if d.act == "swiglu":
+        rows += [
+            LeafRow("moe.experts.w1", (E, 2, D, F), ep_dim=0, count=count,
+                    flop_weight=w),
+            LeafRow("moe.experts.b1", (E, 2, F), ep_dim=0, count=count,
+                    flop_weight=w),
+        ]
+    else:
+        rows += [
+            LeafRow("moe.experts.w1", (E, D, F), ep_dim=0, count=count,
+                    flop_weight=w),
+            LeafRow("moe.experts.b1", (E, F), ep_dim=0, count=count,
+                    flop_weight=w),
+        ]
+    rows += [
+        LeafRow("moe.experts.w2", (E, F, D), ep_dim=0, count=count,
+                flop_weight=w),
+        LeafRow("moe.experts.b2", (E, D), ep_dim=0, count=count,
+                flop_weight=w),
+    ]
+    return rows
+
+
 def param_table(d: ModelDims) -> List[LeafRow]:
     """The model's full analytic shape table.  GPT stacks block leaves on
     a leading [L] dim (``stack_dim=0`` — the dim ``pipe`` shards, and a
     legal FSDP dim, exactly as in the real spec tree); the transformer
-    family keeps per-layer leaves (``count=nlayers``)."""
+    family keeps per-layer leaves (``count=nlayers``).  MoE GPT blocks
+    are a heterogeneous LIST in the real tree (``init_gpt_moe_params``),
+    so they are counted per-layer too: dense blocks x (L - n_moe), MoE
+    blocks' attention/norm leaves + expert leaves x n_moe."""
     rows: List[LeafRow] = []
     if d.family == "gpt":
         assert d.vocab
@@ -205,11 +269,25 @@ def param_table(d: ModelDims) -> List[LeafRow]:
                             matmul=False))
         if d.pos == "learned":
             rows.append(LeafRow("pos_emb", (d.seq, d.dim), matmul=False))
-        for r in _block_rows(d):
-            rows.append(LeafRow(
-                f"blocks.{r.path}", (d.nlayers, *r.shape),
-                tp_dim=None if r.tp_dim is None else r.tp_dim + 1,
-                stack_dim=0))
+        if d.moe_experts:
+            n_moe = d.n_moe_layers
+            n_dense = d.nlayers - n_moe
+            brows = _block_rows(d)
+            if n_dense:
+                rows += [dataclasses.replace(
+                    r, path=f"blocks[dense].{r.path}", count=n_dense)
+                    for r in brows]
+            rows += [dataclasses.replace(
+                r, path=f"blocks[moe].{r.path}", count=n_moe)
+                for r in brows if not r.path.startswith("mlp.")]
+            rows += [dataclasses.replace(r, path=f"blocks[moe].{r.path}")
+                     for r in _moe_rows(d, count=n_moe)]
+        else:
+            for r in _block_rows(d):
+                rows.append(LeafRow(
+                    f"blocks.{r.path}", (d.nlayers, *r.shape),
+                    tp_dim=None if r.tp_dim is None else r.tp_dim + 1,
+                    stack_dim=0))
         rows.append(LeafRow("head", (d.dim, d.vocab), tp_dim=1))
     else:
         for r in _block_rows(d):
@@ -225,9 +303,12 @@ def flops_per_token(d: ModelDims) -> float:
     """The bench.py 6N+12LSD accounting: 6 FLOPs per matmul param per
     token (embedding tables excluded — gathers, not matmuls) plus the
     attention score/value matmuls.  ``bench.py --autoplan`` replaces this
-    with the compiled step's own ``cost_analysis`` count when it has one."""
+    with the compiled step's own ``cost_analysis`` count when it has one.
+    Expert leaves count at their capacity-inflated ``flop_weight`` — a
+    token runs ``top_k`` of ``E`` experts, padded to capacity — so a MoE
+    stack prices its *activated* FLOPs, not the full parameter count."""
     n_matmul = sum(
-        r.count * int(np.prod(r.shape, dtype=np.int64))
+        r.count * r.flop_weight * int(np.prod(r.shape, dtype=np.int64))
         for r in param_table(d) if r.matmul)
     return 6.0 * n_matmul + 12.0 * d.nlayers * d.seq * d.dim
 
@@ -253,6 +334,8 @@ def _tp_ok(d: ModelDims, tp: int) -> bool:
 
 def candidate_key(c: Dict[str, Any]) -> str:
     parts = [f"{'fsdp' if c['layout'] == 'fsdp' else 'dp'}{c['dp']}"]
+    if c.get("ep", 1) > 1:
+        parts.append(f"ep{c['ep']}")
     if c["tp"] > 1:
         parts.append(f"tp{c['tp']}")
     if c["pp"] > 1:
@@ -284,11 +367,21 @@ def enumerate_candidates(
     tp/fsdp plans cannot express the int8 rings), and ``pp > 1`` plans
     restricted to the ``dp`` layout (bench's pipeline runner drives the
     1F1B/ZB schedules through ``DataParallel``, which replicates params
-    over ``data`` — the fsdp spec insertion has no pipelined runner)."""
+    over ``data`` — the fsdp spec insertion has no pipelined runner).
+
+    MoE configs additionally cross each ``dp x tp`` point with an
+    expert-parallel factor ``ep`` (every common divisor of ``dp`` and the
+    expert count): the data axis splits into ``data = dp/ep`` x ``ep``,
+    the batch shards over both, and expert stacks shard over ``ep``
+    (``moe_param_specs``).  MoE candidates are restricted to ``pp == 1``
+    (MoE blocks are a heterogeneous list — no stacked [L] dim for pipe to
+    shard), the ``dp`` layout (the ZeRO insertion has no MoE runner), and
+    no compression arms (the int8 rings have no expert-dispatch runner)."""
     out: List[Dict[str, Any]] = []
+    moe = d.moe_experts > 0
     for pp in _divisors(n_chips):
         if pp > 1 and (
-                not allow_pp or d.family != "gpt" or d.nlayers % pp):
+                not allow_pp or d.family != "gpt" or d.nlayers % pp or moe):
             continue
         for tp in _divisors(n_chips // pp):
             if not _tp_ok(d, tp):
@@ -298,24 +391,35 @@ def enumerate_candidates(
                 continue
             arm_layouts = [
                 l for l in layouts if l == "dp" or (l == "fsdp" and dp > 1)]
-            if executable_only and pp > 1:
+            if moe or (executable_only and pp > 1):
                 arm_layouts = [l for l in arm_layouts if l == "dp"]
+            ep_arms = [
+                e for e in _divisors(dp) if d.moe_experts % e == 0
+            ] if moe else [1]
             for layout in arm_layouts:
-                can_gq = compression and dp > 1 and not (
+                can_gq = compression and dp > 1 and not moe and not (
                     executable_only and (tp > 1 or pp > 1
                                          or layout == "fsdp"))
                 grad_arms = (False, True) if can_gq else (False,)
                 act_arms = (False, True) if (
-                    compression and tp > 1 and not executable_only) else (False,)
+                    compression and tp > 1 and not moe
+                    and not executable_only) else (False,)
                 for gq in grad_arms:
                     for aq in act_arms:
-                        out.append({
-                            "dp": dp, "tp": tp, "pp": pp,
-                            "layout": layout,
-                            "mesh_axes": {"pipe": pp, "data": dp,
-                                          "tensor": tp},
-                            "compress": {"grads": gq, "acts": aq},
-                        })
+                        for ep in ep_arms:
+                            c: Dict[str, Any] = {
+                                "dp": dp, "tp": tp, "pp": pp,
+                                "layout": layout,
+                                "mesh_axes": {"pipe": pp, "data": dp,
+                                              "tensor": tp},
+                                "compress": {"grads": gq, "acts": aq},
+                            }
+                            if moe:
+                                c["ep"] = ep
+                                c["mesh_axes"] = {
+                                    "pipe": pp, "data": dp // ep,
+                                    "ep": ep, "tensor": tp}
+                            out.append(c)
     for c in out:
         c["key"] = candidate_key(c)
     return out
@@ -334,6 +438,8 @@ def _axis_assignment(
     entries: List[Optional[str]] = [None] * len(row.shape)
     if c["pp"] > 1 and row.stack_dim is not None:
         entries[row.stack_dim] = "pipe"
+    if c.get("ep", 1) > 1 and row.ep_dim is not None:
+        entries[row.ep_dim] = "ep"
     if c["tp"] > 1 and row.tp_dim is not None:
         entries[row.tp_dim] = "tensor"
     if c["layout"] == "fsdp" and c["dp"] > 1:
@@ -468,14 +574,15 @@ def estimate_memory_model(
 
 def _grad_payload_bytes(d: ModelDims, c: Dict[str, Any]) -> float:
     """Per-device grad bytes entering the data-axis collective: each
-    leaf's bytes after the NON-data shards (tp/pp) — the fsdp data shard
-    is the collective's OUTPUT, not its payload."""
+    leaf's bytes after the NON-data shards (tp/pp/ep — each ep shard owns
+    different experts, so its grads never cross the ep boundary) — the
+    fsdp data shard is the collective's OUTPUT, not its payload."""
     total = 0
     for r in param_table(d):
         n_elems = int(np.prod(r.shape, dtype=np.int64))
         shards = 1
         for axis in _axis_assignment(r, c):
-            if axis in ("tensor", "pipe"):
+            if axis in ("tensor", "pipe", "ep"):
                 shards *= c["mesh_axes"][axis]
         total += r.count * -(-n_elems // shards) * d.dtype_size
     return float(total)
@@ -549,6 +656,17 @@ def comm_terms(
             * d.dtype_size
         price("pp-boundary", "ppermute", ("pipe",), pp, micro_act,
               2 * (microbatches + pp - 2), False)
+    ep = c.get("ep", 1)
+    if ep > 1:
+        # EP dispatch: each MoE layer all_to_alls the capacity-padded
+        # token buffer (T_local * top_k * cf rows of D) to its experts
+        # and back, forward and backward -> 4 per MoE layer per step.
+        tok_local = (global_batch // dp) * S
+        a2a_bytes = int(
+            tok_local * d.moe_top_k * d.moe_capacity_factor
+            * d.dim * d.dtype_size)
+        price("moe-all-to-all", "all_to_all", ("ep",), ep, a2a_bytes,
+              4 * d.n_moe_layers, False)
     return terms
 
 
@@ -847,7 +965,13 @@ def plan_param_specs(c: Dict[str, Any], config: Any):
     tp_axis = "tensor" if c["tp"] > 1 else None
     pipe_axis = "pipe" if c["pp"] > 1 else None
     shapes = _shapes_for_config(config)
-    if d.family == "gpt":
+    if d.family == "gpt" and d.moe_experts:
+        from ..models.gpt_moe import gpt_moe_param_specs
+
+        base = gpt_moe_param_specs(
+            config, tp_axis=tp_axis,
+            ep_axis="ep" if c.get("ep", 1) > 1 else None)
+    elif d.family == "gpt":
         from ..models.gpt import gpt_param_specs
 
         base = gpt_param_specs(config, tp_axis=tp_axis, pipe_axis=pipe_axis)
@@ -870,7 +994,11 @@ def plan_param_specs(c: Dict[str, Any], config: Any):
 
 
 def batch_partition_spec(c: Dict[str, Any]):
-    """Batch leaves shard their leading dim over the data axis."""
+    """Batch leaves shard their leading dim over the data axis — over
+    ``("data", "ep")`` for MoE plans, whose data axis splits in two (the
+    batch still shards ``dp`` ways; experts shard over the ep factor)."""
     from jax.sharding import PartitionSpec as P
 
+    if "ep" in c["mesh_axes"]:
+        return P(("data", "ep")) if c["dp"] > 1 else P()
     return P("data") if c["dp"] > 1 else P()

@@ -111,7 +111,7 @@ class GPTConfig:
     # EC remains available through moe_forward(causal=False) for
     # encoder/non-AR models built from the same MoE layer.
     moe_router: str = "topk"
-    moe_dispatch: str = "auto"  # 'dense' | 'sorted' | 'auto' (see MoEConfig)
+    moe_dispatch: str = "auto"  # 'dense' | 'sorted' | 'pallas' | 'auto' (see MoEConfig)
 
     def __post_init__(self):
         if self.context_axis is not None and self.attn_impl not in ("ring", "ulysses"):

@@ -318,6 +318,10 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     "plan_selected", "plan_rejected_oom",
     # zero-bubble pipeline schedule (PR 14)
     "zb_wgrad_deferred", "zb_cooldown_filled",
+    # MoE dispatch + expert-load serving (PR 18): which dispatch path a
+    # trace resolved ('auto' is backend-dependent), and the host-side
+    # capacity-overflow alarm (dropped-token rate over threshold)
+    "moe_dispatch_selected", "expert_overflow",
 })
 
 

@@ -438,6 +438,33 @@ def _validate_serving(srv: Any) -> List[str]:
             not isinstance(spec, dict)
             or spec.get("accepted", 0) > spec.get("drafted", 0)):
         errs.append("serving.spec malformed (accepted > drafted)")
+    # expert-load fields (PR 18) — present for MoE engines, ranged when set
+    moe = srv.get("moe")
+    if moe is not None:
+        if not isinstance(moe, dict):
+            errs.append("serving.moe non-dict")
+        else:
+            imb = moe.get("imbalance")
+            if not isinstance(imb, (int, float)) or imb < 0:
+                errs.append("serving.moe.imbalance missing/negative")
+            ent = moe.get("load_entropy")
+            if not isinstance(ent, (int, float)) or not (0.0 <= ent <= 1.0):
+                errs.append("serving.moe.load_entropy missing/out of [0,1]")
+            dr = moe.get("dropped_token_rate")
+            if not isinstance(dr, (int, float)) or not (0.0 <= dr <= 1.0):
+                errs.append(
+                    "serving.moe.dropped_token_rate missing/out of [0,1]")
+            ne = moe.get("num_experts")
+            if not isinstance(ne, int) or ne < 2:
+                errs.append("serving.moe.num_experts missing/< 2")
+            et = moe.get("expert_tokens")
+            if not isinstance(et, list) or (
+                    isinstance(ne, int) and len(et) != ne):
+                errs.append("serving.moe.expert_tokens missing/wrong length")
+            if moe.get("dispatch") not in (
+                    "gather", "pallas", "dense", "sorted", "auto"):
+                errs.append(
+                    f"serving.moe.dispatch {moe.get('dispatch')!r} unknown")
     errs.extend(_validate_serving_slo(srv))
     return errs
 

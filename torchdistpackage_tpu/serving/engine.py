@@ -335,6 +335,14 @@ class ServingEngine:
         gather on CPU (the interpreter-mode kernel is correct but slow —
         tests opt in explicitly).  Recorded in
         ``serving_summary()['attn_impl']``.
+    moe_dispatch: MoE dispatch for the expert-FFN layers of a MoE family
+        (ignored otherwise): ``'gather'`` pins the ragged grouped-GEMM
+        serving oracle, ``'pallas'`` the fused dispatch kernel
+        (ops/moe_dispatch.py), ``None`` defers to ``cfg.moe_dispatch``
+        (whose ``'auto'`` picks pallas on TPU).  Recorded in
+        ``serving_summary()['moe']['dispatch']``; both arms feed the same
+        live expert-load stats (the summary's ``moe`` subsection and the
+        Router's imbalance-weighted load index).
     metrics_sink: any obs exporter sink (``write(record)`` — e.g.
         :class:`~..obs.exporters.PrometheusTextfileSink` or ``JsonlSink``);
         every ``metrics_every``-th tick writes a ``serving_metrics``
@@ -379,6 +387,7 @@ class ServingEngine:
         prefix_cache: bool = False,
         spec_k: int = 0,
         attn_impl: str = "auto",
+        moe_dispatch: Optional[str] = None,
         metrics_sink: Optional[Any] = None,
         metrics_every: int = 1,
         tick_history: int = 4096,
@@ -429,6 +438,19 @@ class ServingEngine:
         #: default; interpreter-mode pallas on CPU is correct but slow).
         #: docs/serving.md "Paged attention kernel".
         self.attn_impl = resolve_attn_impl(attn_impl)
+        #: 'gather' (ragged grouped-GEMM oracle) or 'pallas' (fused
+        #: dispatch kernel); None defers to cfg.moe_dispatch.  MoE
+        #: families only — serving_summary()['moe']['dispatch'].
+        self.moe_dispatch = moe_dispatch
+        if moe_dispatch is not None:
+            if not cfg.moe_experts:
+                raise ValueError(
+                    "moe_dispatch is set but the model has no MoE layers "
+                    "(cfg.moe_experts == 0)")
+            if moe_dispatch not in ("gather", "pallas"):
+                raise ValueError(
+                    "engine moe_dispatch must be 'gather' or 'pallas', got "
+                    f"{moe_dispatch!r}")
         if metrics_every < 1:
             raise ValueError(f"metrics_every must be >= 1, got {metrics_every}")
         self.metrics_sink = metrics_sink
@@ -524,12 +546,14 @@ class ServingEngine:
 
         return jax.tree.map(spec, cache)
 
-    def _fwd(self) -> Callable:
+    def _fwd(self, moe_stats: bool = False) -> Callable:
         import functools
 
         if self.cfg.moe_experts:
             return functools.partial(paged_forward_moe, ep_axis=self.ep_axis,
-                                     attn_impl=self.attn_impl)
+                                     attn_impl=self.attn_impl,
+                                     moe_dispatch=self.moe_dispatch,
+                                     moe_stats=moe_stats)
         return functools.partial(paged_forward, attn_impl=self.attn_impl)
 
     def _build_step(self) -> Callable:
@@ -537,11 +561,17 @@ class ServingEngine:
         step, S_in=chunk calls the prefill-chunk step — two signatures of
         the same program, compiled once each."""
         cfg, axis = self.cfg, self.axis
-        fwd = self._fwd()
+        moe = bool(cfg.moe_experts)
+        fwd = self._fwd(moe_stats=moe)
 
         def step(params, cache, tokens, tables, offsets, last_idx, samp, keys):
-            cache, logits = fwd(params, tokens, cfg, cache, tables, offsets,
-                                axis=axis, last_idx=last_idx)
+            if moe:
+                cache, logits, mstats = fwd(
+                    params, tokens, cfg, cache, tables, offsets,
+                    axis=axis, last_idx=last_idx)
+            else:
+                cache, logits = fwd(params, tokens, cfg, cache, tables,
+                                    offsets, axis=axis, last_idx=last_idx)
             full = _full_logits(logits, cfg, axis)
             keys, sub = _split_keys(keys)
             tok = _slot_sample(full, sub, samp["temperature"], samp["top_k"],
@@ -551,6 +581,17 @@ class ServingEngine:
                 # are psum-assembled, keys replicated); pmax re-types it
                 # axis-invariant for the replicated out_spec
                 tok = jax.lax.pmax(tok, axis)
+            if moe:
+                # live expert-load signal, [1, E] / [1] per dp group so the
+                # host can sum shards; pmax re-types tp-replicated values
+                # axis-invariant (the routing inputs are identical per tp
+                # shard) without changing them
+                et = mstats["expert_tokens"][None, :]
+                dr = mstats["dropped_token_rate"][None]
+                if axis is not None:
+                    et = jax.lax.pmax(et, axis)
+                    dr = jax.lax.pmax(dr, axis)
+                return cache, tok, keys, et, dr
             return cache, tok, keys
 
         if self.mesh is None:
@@ -572,6 +613,10 @@ class ServingEngine:
             row,
         )
         out_specs = (self._cache_specs(self.cache), row, row)
+        if self.cfg.moe_experts:
+            # [1, E] expert counts / [1] drop rate per dp group -> stacked
+            # [dp, E] / [dp] globally; the host sums / means the groups
+            out_specs = out_specs + (row, row)
         return jax.jit(shard_map(
             step, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs))
 
@@ -1159,9 +1204,14 @@ class ServingEngine:
             offsets[i] = s.off
             last_idx[i] = min(len(s.prompt) - 1 - s.off, C - 1)
         t_disp = time.perf_counter()
-        self.cache, tok, keys = self._step_fn(
+        out = self._step_fn(
             self.params, self.cache, tokens, tables, offsets, last_idx,
             self._samp(), self._keys)
+        if len(out) == 5:  # MoE family: live expert-load stats ride along
+            self.cache, tok, keys, moe_et, moe_dr = out
+            self._absorb_moe_stats(moe_et, moe_dr)
+        else:
+            self.cache, tok, keys = out
         self._prefill_sigs.add(("prefill",) + self._sig(tokens))
         t_fetch = time.perf_counter()
         self._phase["prefill"] += t_fetch - t_disp
@@ -1225,9 +1275,14 @@ class ServingEngine:
         self._tick_decode_rids = [
             s.rid for s in self._slots if s.state == DECODE]
         t_disp = time.perf_counter()
-        self.cache, tok, keys = self._decode_fn(
+        out = self._decode_fn(
             self.params, self.cache, tokens, tables, offsets, last_idx,
             self._samp(), self._keys)
+        if len(out) == 5:  # MoE family: live expert-load stats ride along
+            self.cache, tok, keys, moe_et, moe_dr = out
+            self._absorb_moe_stats(moe_et, moe_dr)
+        else:
+            self.cache, tok, keys = out
         self._decode_sigs.add(("decode",) + self._sig(tokens))
         t_fetch = time.perf_counter()
         self._phase["decode"] += t_fetch - t_disp
@@ -2173,8 +2228,37 @@ class ServingEngine:
         self.rejected = {}
         self._finished_order = []
         self._rejected_order = []
+        # live MoE expert-load accumulators (MoE families only): summed
+        # per-expert routed-token counts and the mean drop rate over the
+        # measured steps — serving_summary()['moe'] / moe_imbalance()
+        self._moe_expert_tokens: Optional[np.ndarray] = None
+        self._moe_dropped_sum = 0.0
+        self._moe_steps = 0
         for a in self._allocs:
             a.peak_in_use = a.in_use
+
+    def _absorb_moe_stats(self, et, dr) -> None:
+        """Fold one step's expert-load stats into the accumulators.
+        ``et``: [groups, E] per-dp-group routed-token counts (groups = 1
+        without a mesh), ``dr``: [groups] drop rates."""
+        et = np.asarray(et, np.float64).sum(axis=0)
+        if self._moe_expert_tokens is None:
+            self._moe_expert_tokens = et
+        else:
+            self._moe_expert_tokens += et
+        self._moe_dropped_sum += float(np.mean(np.asarray(dr)))
+        self._moe_steps += 1
+
+    def moe_imbalance(self) -> float:
+        """Live expert-load imbalance (``max/mean - 1`` over the summed
+        per-expert counts; 0.0 when balanced, unknown, or not a MoE
+        model) — the signal the Router weighs into a MoE replica's load
+        index."""
+        if self._moe_expert_tokens is None:
+            return 0.0
+        from ..obs.aggregate import moe_load_stats
+
+        return float(moe_load_stats(self._moe_expert_tokens)["imbalance"])
 
     # ------------------------------------------------------------------ report
 
@@ -2277,6 +2361,26 @@ class ServingEngine:
             "phases_mean_s": {k: round(v, 9)
                               for k, v in phases_mean.items()},
         }
+        # --- live expert-load (MoE families): moe_load_stats over the
+        # accumulated per-expert routed-token counts, plus the dispatch
+        # implementation the compiled programs traced.  The overflow
+        # tripwire fires here, where the stats are concrete.
+        moe = None
+        if self.cfg.moe_experts:
+            from ..obs.aggregate import moe_load_stats
+            from ..parallel.moe import check_expert_overflow
+
+            dropped = (self._moe_dropped_sum / self._moe_steps
+                       if self._moe_steps else 0.0)
+            moe = moe_load_stats(
+                self._moe_expert_tokens
+                if self._moe_expert_tokens is not None
+                else [0.0] * self.cfg.moe_experts,
+                dropped_rate=dropped,
+            )
+            moe["dispatch"] = (self.moe_dispatch if self.moe_dispatch
+                               is not None else self.cfg.moe_dispatch)
+            check_expert_overflow(moe, where="serving_summary")
         return {
             "requests": {"completed": completed, "queued": len(self.queue),
                          "in_flight": self.n_busy,
@@ -2329,6 +2433,7 @@ class ServingEngine:
             # (docs/serving.md "Paged attention kernel"): 'pallas' walks
             # the block table in-kernel, 'gather' is the parity oracle
             "attn_impl": self.attn_impl,
+            **({"moe": moe} if moe is not None else {}),
             "decode_steps": st["decode_steps"],
             "prefill_chunks": st["prefill_chunks"],
             "decode_batch_mean": (

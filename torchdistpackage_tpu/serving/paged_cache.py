@@ -307,6 +307,8 @@ def paged_forward_moe(
     ep_axis: Optional[str] = None,
     all_logits: bool = False,
     attn_impl: str = "gather",
+    moe_dispatch: Optional[str] = None,
+    moe_stats: bool = False,
 ) -> Tuple[Dict[str, Any], jnp.ndarray]:
     """:func:`paged_forward` for the MoE family (heterogeneous block list,
     expert FFN every moe_every-th block) — the same exact no-drop serving
@@ -316,6 +318,13 @@ def paged_forward_moe(
     ``all_logits=True``: per-position logits, as in :func:`paged_forward`;
     ``attn_impl`` as in :func:`paged_forward` (the MoE families ride the
     same kernel — attention is family-independent).
+
+    ``moe_dispatch`` overrides the model's ``cfg.moe_dispatch`` for the
+    serving A/B ('gather' pins the ragged oracle, 'pallas' the fused
+    kernel — :func:`~..parallel.moe.moe_serve_forward`).  ``moe_stats=True``
+    returns ``(cache, logits, moe_metrics)`` where ``moe_metrics`` sums
+    per-expert routed-token counts over the MoE layers — the engine's live
+    expert-load signal.
     """
     import dataclasses as _dc
 
@@ -329,6 +338,13 @@ def paged_forward_moe(
         capacity_factor=max(mcfg.capacity_factor,
                             mcfg.num_experts / mcfg.top_k),
     )
+    if moe_dispatch is not None and ep_axis is not None:
+        # the EP exchange has no ragged analogue: its 'gather' arm is the
+        # sorted index materialization (same jnp gather/scatter family)
+        mcfg = _dc.replace(
+            mcfg,
+            dispatch="sorted" if moe_dispatch == "gather" else moe_dispatch,
+        )
     S_in = tokens.shape[1]
     offset = jnp.asarray(offset, jnp.int32)
     positions = offset[:, None] + jnp.arange(S_in)[None, :]
@@ -336,13 +352,27 @@ def paged_forward_moe(
     rope = _batched_rope(bcfg, positions)
     ops = _paged_cache_ops(tables, attn_impl)
 
+    collected = []  # per-MoE-layer metrics dicts (moe_stats)
     if ep_axis is None:
         def moe_ffn(p, hh):
-            return moe_serve_forward(p["moe"], hh, mcfg)
+            out = moe_serve_forward(
+                p["moe"], hh, mcfg, dispatch=moe_dispatch,
+                return_metrics=moe_stats)
+            if moe_stats:
+                z, met = out
+                collected.append(met)
+                return z
+            return out
     else:
         def moe_ffn(p, hh):
-            z, _aux = moe_forward(
-                p["moe"], hh, mcfg, ep_axis=ep_axis, causal=bcfg.causal)
+            out = moe_forward(
+                p["moe"], hh, mcfg, ep_axis=ep_axis, causal=bcfg.causal,
+                return_metrics=moe_stats)
+            if moe_stats:
+                z, _aux, met = out
+                collected.append(met)
+                return z
+            z, _aux = out
             return z
 
     ks, vs = [], []
@@ -357,11 +387,23 @@ def paged_forward_moe(
         vs.append(cv)
     stack = lambda cs: jax.tree.map(lambda *xs: jnp.stack(xs), *cs)
     cache = {"k": stack(ks), "v": stack(vs)}
+    metrics = None
+    if moe_stats:
+        # sum routed-token counts over the MoE layers, mean the drop rate
+        metrics = {
+            "expert_tokens": sum(m["expert_tokens"] for m in collected),
+            "dropped_token_rate": sum(
+                m["dropped_token_rate"] for m in collected
+            ) / max(len(collected), 1),
+        }
     if all_logits:
-        return cache, gpt_head(params, h, axis, False, eps=cfg.norm_eps)
-    logits = gpt_head(params, _select_row(h, last_idx), axis, False,
-                      eps=cfg.norm_eps)
-    return cache, logits[:, 0, :]
+        logits = gpt_head(params, h, axis, False, eps=cfg.norm_eps)
+    else:
+        logits = gpt_head(params, _select_row(h, last_idx), axis, False,
+                          eps=cfg.norm_eps)[:, 0, :]
+    if moe_stats:
+        return cache, logits, metrics
+    return cache, logits
 
 
 def copy_blocks(cache: Dict[str, Any], src: jnp.ndarray,

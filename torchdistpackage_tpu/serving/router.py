@@ -269,16 +269,31 @@ class Router:
 
     # ------------------------------------------------------------------ submit
 
+    def _load_index(self, i: int) -> float:
+        """Replica ``i``'s load index: queue depth + busy slots, inflated
+        by the live expert-load imbalance on MoE replicas — a replica
+        whose hottest expert sees 2x its fair share (imbalance 1.0)
+        finishes its expert FFNs that much later than a balanced peer at
+        equal occupancy, so it counts as proportionally more loaded.
+        Dense replicas (``moe_imbalance`` absent or 0) are unchanged."""
+        r = self.replicas[i]
+        load = float(len(r.queue) + r.n_busy)
+        imb = getattr(r, "moe_imbalance", None)
+        if callable(imb):
+            load *= 1.0 + float(imb())
+        return load
+
     def _score(self, i: int, tokens: Sequence[int]) -> Tuple:
         """Routing sort key for replica ``i`` (smaller = better): longest
         resident prefix first (negated), then the replica's own biased
         TTFT estimate (None = unmeasured = 0: no evidence to avoid it
-        on), then queue depth + busy slots, then index (determinism)."""
+        on), then the imbalance-weighted load index (:meth:`_load_index`),
+        then index (determinism)."""
         r = self.replicas[i]
         aff = r.prefix_lookup(tokens)
         est = r.estimate_ttft(len(tokens), tokens=tokens)
         return (-aff, est if est is not None else 0.0,
-                len(r.queue) + r.n_busy, i)
+                self._load_index(i), i)
 
     def _candidate_table(self, targets: List[int],
                          tokens: Sequence[int]) -> List[Dict[str, Any]]:
@@ -290,12 +305,16 @@ class Router:
         for i in targets:
             r = self.replicas[i]
             est = r.estimate_ttft(len(tokens), tokens=tokens)
-            rows.append({
+            row = {
                 "replica": i, "role": self.roles[i],
                 "affinity_tokens": int(r.prefix_lookup(tokens)),
                 "est_ttft_s": round(est, 6) if est is not None else None,
-                "load": len(r.queue) + r.n_busy,
-            })
+                "load": round(self._load_index(i), 4),
+            }
+            imb = getattr(r, "moe_imbalance", None)
+            if callable(imb):
+                row["expert_imbalance"] = round(float(imb()), 4)
+            rows.append(row)
         return rows
 
     def submit(self, req: Request) -> int:

@@ -72,13 +72,20 @@ from typing import Any, Dict, List, Tuple
 #: traffic stopped landing on its KV (the routing policy rotting), and
 #: ballooning migration bytes mean the disaggregation tier started
 #: shipping whole contexts instead of tails.
+#: ``moe_pallas_tok_s`` / ``expert_imbalance`` (PR 18) ride the
+#: ``serve-moe-ab`` line: the fused-dispatch arm's absolute tokens/s
+#: next to the run's accumulated expert-load imbalance — a speedup hold
+#: earned while imbalance climbs means the router is feeding the kernel
+#: ever-more-skewed batches (capacity drops coming), visible before the
+#: dropped-token alarm fires.
 AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
             "grad_norm_final", "comm_bytes_per_dim", "shed_rate",
             "preempt_count", "prefix_hit_rate", "spec_accept_rate",
             "slo_attainment", "goodput_tok_s", "paged_pallas_tok_s",
             "autoplan_tok_s", "plan_modeled_step_s", "bubble_fraction",
             "plan_pp_schedule", "fleet_goodput_tok_s", "affinity_hit_rate",
-            "migration_bytes", "fleet_slo_attainment", "migration_count")
+            "migration_bytes", "fleet_slo_attainment", "migration_count",
+            "moe_pallas_tok_s", "expert_imbalance")
 
 
 def _aux_str(key: str, val: Any) -> str:
