@@ -25,6 +25,18 @@ everything on the survivors.  Every request still completes, the
 cross-allocator audit stays green, and the fleet verdict reports
 ``degraded`` with the dead replica visible in the replica table.
 
+Phase 3 (elastic self-healing, PR 19): the goodput-driven
+``Autoscaler`` attaches to the degraded fleet and the migration wire
+swaps to the ``ChunkedWireTransport`` with a chaos ``chunk_drop``
+seeded into it.  A traffic burst queues past the high-water mark, the
+controller REVIVES the evacuated replica (``scale_up`` — warm, its
+prefix cache survived the evacuation), the dropped KV chunk is
+re-requested under the retry budget (``migration_retry`` on the
+timeline, zero fallbacks), and when the burst drains the calm-window
+policy parks an idle replica again (``scale_down`` via the exact-parity
+drain path).  Every decision — hold included — is one ``scale_decision``
+ledger record, and the fleet ends 2/3 alive exactly as phase 2 left it.
+
 The RUNREPORT carries the validated ``router`` section (per-replica
 serving sections + the fleet roll-up) next to the usual telemetry; CI
 (tests/test_examples.py) validates all of it.
@@ -48,7 +60,13 @@ from torchdistpackage_tpu import setup_distributed
 from torchdistpackage_tpu.models import init_gpt_params, llama_config
 from torchdistpackage_tpu.obs import Telemetry
 from torchdistpackage_tpu.resilience import ChaosMonkey, Fault
-from torchdistpackage_tpu.serving import Request, Router, ServingEngine
+from torchdistpackage_tpu.serving import (
+    Autoscaler,
+    ChunkedWireTransport,
+    Request,
+    Router,
+    ServingEngine,
+)
 from torchdistpackage_tpu.utils.logging import master_print
 
 
@@ -163,6 +181,50 @@ def main():
         f"verdict {s['fleet']['verdict']}, "
         f"{s['fleet']['n_alive']}/{len(replicas)} alive, all "
         f"{len(rids2)} requests completed on the survivors")
+
+    # --- phase 3: elastic self-healing under transport chaos -----------
+    # swap the migration wire to the chunked transport with a dropped
+    # chunk seeded in, and hand the rotation bit to the autoscaler
+    router.transport = ChunkedWireTransport(
+        chaos=ChaosMonkey(faults=[Fault("chunk_drop", step=1)], seed=0)
+    ).bind(router)
+    asc = Autoscaler(router, eval_every=4, cooldown=8, queue_high=0.5,
+                     min_alive=2)
+    rids3 = []
+    for i in range(6 if smoke else 10):
+        sysp = sys_prompts[i % 2]
+        tail = rng.randint(0, cfg.vocab_size, size=2).tolist()
+        rids3.append(router.submit(Request(
+            tokens=sysp + tail, max_new_tokens=8, seed=200 + i)))
+    while router.has_work():
+        router.step()
+        rep = router.audit()
+        assert rep["ok"], rep["violations"]
+    # calm tail: let the controller observe the idle fleet and park the
+    # surplus replica it revived for the burst
+    for _ in range(4 * asc.eval_every):
+        if asc.stats["scale_downs"]:
+            break
+        router.step()
+    assert all(rid in router.finished for rid in rids3)
+    assert asc.stats["scale_ups"] >= 1, asc.stats
+    assert asc.stats["scale_downs"] >= 1, asc.stats
+    assert router.transport.stats["retries"] >= 1, router.transport.stats
+    assert router.stats["transport_fallbacks"] == 0, router.stats
+
+    s = router.summary()
+    assert s["fleet"]["autoscale"]["verdict"] == "elastic", (
+        s["fleet"]["autoscale"])
+    assert s["fleet"]["n_alive"] == len(replicas) - 1
+    master_print(
+        f"phase 3: burst under transport chaos — "
+        f"{asc.stats['scale_ups']} scale-up(s) revived the evacuated "
+        f"replica, {router.transport.stats['retries']} wire retr"
+        f"{'y' if router.transport.stats['retries'] == 1 else 'ies'} "
+        f"healed the dropped chunk, {asc.stats['scale_downs']} "
+        f"scale-down(s) parked the surplus; autoscale verdict "
+        f"{s['fleet']['autoscale']['verdict']}, "
+        f"{s['fleet']['n_alive']}/{len(replicas)} alive")
 
     tel.record_router(s)
     tel.finalize()

@@ -205,13 +205,23 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
         assert fleet["verdict"] == "degraded", fleet
         assert fleet["evacuations"] >= 1 and fleet["n_alive"] < len(
             rt["replicas"]), fleet
+        # the elastic phase (PR 19): the autoscaler revived the corpse
+        # under the burst and parked the surplus in the calm tail, and
+        # the chunked wire healed its seeded chunk drop under the retry
+        # budget (no re-prefill fallback spent)
+        asc = fleet["autoscale"]
+        assert asc["verdict"] == "elastic", asc
+        assert asc["scale_ups"] >= 1 and asc["scale_downs"] >= 1, asc
+        assert fleet["migrations"]["retries"] >= 1, fleet["migrations"]
+        assert fleet["migrations"]["fallbacks"] == 0, fleet["migrations"]
         # compile-once per live decode replica
         for row in rt["replicas"]:
             if row["alive"] and row["role"] in ("decode", "both"):
                 assert row["decode_signatures"] == 1, row
         kinds = {e["kind"] for e in report["events"]}
         assert {"request_routed", "blocks_migrated", "request_migrated",
-                "replica_degraded"} <= kinds, kinds
+                "replica_degraded", "scale_decision",
+                "migration_retry"} <= kinds, kinds
 
     if probe.get("autoplan"):
         # the PR-13 planner section: a chosen plan with per-term score

@@ -394,3 +394,88 @@ def test_trace_replay_100k_acceptance(capsys):
     assert out["attribution"]["ledger_route_decisions"] == 100_000
     # the diurnal peak really exercised the cross-replica machinery
     assert out["attribution"]["handoffs"] > 0
+
+
+def test_trace_replay_autoscale_chaos_twin(tmp_path, capsys):
+    """Tier-1 twin of the PR-19 elastic acceptance run: a 10^3-request
+    ``--autoscale --chaos --ab`` replay with provisioned spares.  Both
+    arms share a config hash (same offered load, same fleet, only the
+    controller differs), the autoscaled arm's attainment is strictly
+    better, every non-hold ``scale_decision`` reconciles with the
+    controller's action count, the transport fault plan fired, and the
+    curves landed in the report."""
+    from torchdistpackage_tpu.tools.trace_replay import main
+
+    report = tmp_path / "FLEETREPORT.json"
+    ledger = tmp_path / "ledger.jsonl"
+    rc = main(["--n-requests", "1000", "--num-slots", "8",
+               "--replicas", "3", "--spares", "1",
+               "--diurnal-period", "256", "--curve-every", "64",
+               "--eval-every", "16", "--cooldown", "48",
+               "--queue-high", "1.0",
+               "--autoscale", "--chaos", "--ab",
+               "--report", str(report), "--ledger", str(ledger)])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    (rec,) = [r for r in lines if r.get("metric") == "trace-replay"]
+    (ab,) = [r for r in lines if r.get("metric") == "trace-replay-ab"]
+    # the bench_trend AUX columns ride the metric line
+    assert {"autoscale_actions", "migration_retry_count",
+            "transport_fallback_count"} <= set(rec)
+    assert rec["report_valid"] and rec["attribution_complete"]
+    assert rec["autoscale_actions"] >= 1
+    assert rec["migration_retry_count"] >= 1
+    # A/B at equal config hash: elasticity must WIN on attainment
+    assert ab["config_hash_match"], ab
+    assert ab["baseline_valid"], ab
+    assert ab["win"] and ab["attainment_delta"] > 0, ab
+
+    rep = json.loads(report.read_text())
+    asc = rep["counters"]["autoscale"]
+    assert asc["verdict"] in ("elastic", "thrashing"), asc
+    att = rep["counters"]["attribution"]
+    assert att["scale_actions"] == att["ledger_scale_actions"] >= 1
+    curves = rep["counters"]["curves"]
+    assert len(curves["tick"]) >= 2
+    assert len(curves["attainment"]) == len(curves["tick"])
+    assert len(curves["n_alive"]) == len(curves["tick"])
+    # the fleet really flexed: replica count moved during the run
+    assert len(set(curves["n_alive"])) >= 2, curves["n_alive"]
+    assert rep["counters"]["chaos"]["fired"] >= 1
+    # ledger JSONL stays inside the router lane, scale decisions on it
+    led = [json.loads(ln) for ln in ledger.read_text().splitlines()]
+    assert {r["kind"] for r in led} <= ROUTER_EVENT_KINDS
+    assert any(r["kind"] == "scale_decision" for r in led)
+
+
+@pytest.mark.slow
+def test_trace_replay_100k_elastic_chaos_acceptance():
+    """The PR-19 acceptance run: 10^5 requests with autoscaling, parked
+    spares, and a seeded transport-fault plan (death included) — the
+    report validates, attribution (scale decisions included) reconciles
+    exactly, and attainment strictly beats the autoscaling-disabled arm
+    at the SAME config hash."""
+    from torchdistpackage_tpu.tools.trace_replay import run_replay
+
+    kw = dict(n_requests=100_000, n_replicas=4, n_spares=2, chaos=True,
+              chaos_faults=24,
+              autoscale_kw={"eval_every": 64, "cooldown": 192,
+                            "queue_high": 4.0})
+    on = run_replay(autoscale=True, **kw)
+    on.pop("events")
+    off = run_replay(autoscale=False, **kw)
+    off.pop("events")
+    assert on["config_hash"] == off["config_hash"]
+    for out in (on, off):
+        assert out["submitted"] == 100_000
+        assert out["validation_errors"] == []
+        assert out["attribution"]["complete"], out["attribution"]
+    assert on["attribution"]["scale_actions"] >= 1
+    assert on["attribution"]["ledger_scale_actions"] == (
+        on["attribution"]["scale_actions"])
+    att_on = on["summary"]["fleet"]["attainment"]
+    att_off = off["summary"]["fleet"]["attainment"]
+    assert att_on > att_off, (att_on, att_off)
+    assert len(on["curves"]["tick"]) >= 10
+    assert len(set(on["curves"]["n_alive"])) >= 2

@@ -235,9 +235,34 @@ def test_skewed_router_reports_imbalance():
     assert stats["dropped_token_rate"] > 0.0
     assert float(m["router_entropy"]) < 0.9
 
-    # metrics are observational: outputs and grads identical without them
+    # metrics are observational: the forward output is identical without
+    # them (the grad identity + expert-choice arm live in the slow twin
+    # test_router_metrics_grad_identity_and_expert_choice — PR-19 budget
+    # payback; each extra arm is a fresh compile)
     y2, _ = moe_forward(params, x, cfg)
     np.testing.assert_allclose(np.asarray(y), np.asarray(y2))
+
+
+@pytest.mark.slow
+def test_router_metrics_grad_identity_and_expert_choice():
+    """Slow twin of ``test_skewed_router_reports_imbalance`` (PR-19
+    budget payback): the grad-identity and expert-choice arms each
+    compile a fresh moe_forward variant.  Fast-tier holders: the skewed
+    test above keeps the forward-identity check, and
+    test_moe.py::test_expert_choice_serial_matches_dense_golden covers
+    the expert-choice routing math."""
+    from torchdistpackage_tpu.parallel.moe import (
+        MoEConfig,
+        init_moe_params,
+        moe_forward,
+    )
+
+    cfg = MoEConfig(dim=8, ffn_dim=16, num_experts=4, top_k=1,
+                    capacity_factor=1.0)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 8))
+
+    # metrics are observational: grads identical with and without them
     g1 = jax.grad(lambda p: moe_forward(p, x, cfg)[0].sum())(params)
     g2 = jax.grad(
         lambda p: moe_forward(p, x, cfg, return_metrics=True)[0].sum()

@@ -528,6 +528,44 @@ def test_fleet_ledger_event_kinds_registered_and_emitted():
         f"trace-link kinds never emitted from serving/engine.py: {missing}")
 
 
+def test_elastic_fleet_event_kinds_registered_and_emitted():
+    """The elastic-fleet kinds (PR 19) are in the registry AND emitted
+    where the subsystem lives: ``scale_decision`` from
+    ``serving/autoscale.py`` (EVERY controller evaluation — hold
+    included — is one attributable record; the trace-replay scale
+    reconciliation is built on it), ``migration_retry`` from
+    ``serving/transport.py`` (the wire's per-re-request evidence),
+    ``migration_fallback`` from ``serving/router.py`` (the re-prefill
+    escape hatch), and ``import_aborted`` from ``serving/engine.py``
+    (the half-import unwind that keeps a dead transfer from leaking
+    blocks).  The router-ledger members must also ride the PR-17
+    ledger lane, and the transport fault kinds must stay inside the
+    chaos registry — an unknown kind would make ``Fault`` raise."""
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+    from torchdistpackage_tpu.resilience.chaos import (
+        FAULT_KINDS, TRANSPORT_FAULT_KINDS)
+    from torchdistpackage_tpu.serving.tracing import ROUTER_EVENT_KINDS
+
+    elastic_kinds = {
+        "scale_decision", "migration_retry", "migration_fallback",
+        "import_aborted",
+    }
+    assert elastic_kinds <= EVENT_KINDS
+    for kind, fname in (("scale_decision", "autoscale.py"),
+                        ("migration_retry", "transport.py"),
+                        ("migration_fallback", "router.py"),
+                        ("import_aborted", "engine.py")):
+        emitted = {
+            k for _, k in _emit_call_kinds(PKG / "serving" / fname)}
+        assert kind in emitted, (
+            f"{kind} never emitted from serving/{fname}")
+    # the ledger lane carries the fleet-size/wire decisions (the replay
+    # twin asserts ledger JSONL kinds ⊆ ROUTER_EVENT_KINDS)
+    assert {"scale_decision", "migration_retry",
+            "migration_fallback"} <= ROUTER_EVENT_KINDS
+    assert set(TRANSPORT_FAULT_KINDS) <= set(FAULT_KINDS)
+
+
 def test_fastpath_event_kinds_registered_and_emitted():
     """The serving fast-path kinds (PR 10) are in the registry AND each
     is actually emitted from ``serving/`` — the prefix-cache hit/COW/

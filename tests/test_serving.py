@@ -142,7 +142,14 @@ def test_init_paged_kv_guards():
 # ------------------------------------------------- paged parity (tentpole)
 
 
-@pytest.mark.parametrize("family", FAMILIES)
+@pytest.mark.parametrize(
+    "family",
+    # moe demoted to slow (PR-19 budget payback): the staggered
+    # admission regime is family-independent and held fast-tier by the
+    # dense/gqa/sliding rows; the moe expert-dispatch math keeps its own
+    # fast-tier holder in test_moe_dispatch.py::test_engine_token_bit_parity
+    [pytest.param("moe", marks=pytest.mark.slow)]
+    + [f for f in FAMILIES if f != "moe"])
 def test_paged_parity_staggered(bundles, family):
     """Bit parity under the engine's real regime: request B is admitted
     while request A is already decoding (mixed prefill/decode ticks,
@@ -178,9 +185,12 @@ def test_paged_parity_staggered(bundles, family):
     # plumbing it exercises is family-independent and held fast-tier by
     # the gqa/sliding/moe rows; dense single-device parity stays fast-tier
     # above, and the pallas-vs-gather engine pair in
-    # test_paged_attention.py re-proves the dense-attention math per PR
-    [pytest.param("dense", marks=pytest.mark.slow)]
-    + [f for f in FAMILIES if f != "dense"])
+    # test_paged_attention.py re-proves the dense-attention math per PR.
+    # moe joins it (PR-19 payback): the mesh/table plumbing is held by
+    # the fast gqa/sliding rows; moe expert sharding under tensor-
+    # parallel decode keeps its fast holder in test_moe_dispatch.py
+    [pytest.param(f, marks=pytest.mark.slow) for f in ("dense", "moe")]
+    + [f for f in FAMILIES if f not in ("dense", "moe")])
 def test_tp_dp_paged_parity(bundles, family, devices8):
     """The same goldens on a tensor=2 x data=2 mesh: KV heads + vocab
     shard over 'tensor' exactly as training, slots + block pool split over
@@ -319,12 +329,18 @@ def test_submit_guards(bundles):
 # ------------------------------------------------- int8 KV-quant coverage
 
 
+@pytest.mark.slow
 def test_kv_quant_sliding_window_decode():
     """Satellite: the _kv_quant cache path vs the fp cache, on the
     sliding-window family (window masking composes with the per-vector
     scales — previously untested).  At these seeds the int8 cache keeps
     greedy decode token-identical; prefill logits stay within quant
-    tolerance."""
+    tolerance.
+
+    Slow tier (PR-19 budget payback): fast-tier holders are
+    test_paged_parity_staggered[sliding] (window masking under the
+    engine) and test_generate.py::test_int8_kv_cache_decode (the quant
+    cache math itself)."""
     from torchdistpackage_tpu.models.generate import (
         _full_logits, forward_cached, init_kv_cache)
 

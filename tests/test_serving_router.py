@@ -363,7 +363,59 @@ def test_warm_handoff_ships_only_the_tail(fleet, event_log):
 # ------------------------------------------------- rebalance and evacuation
 
 
+def test_rebalance_policy_and_parity_stub(stub_log):
+    """Rebalance POLICY on StubDeviceStep (PR-19 budget payback: the
+    fast-tier holder for ``test_rebalance_moves_queue_with_exact_parity``
+    below, now ``slow``): a watermark-deep queue spills to the idle
+    peer via exact-parity descriptors — the stub's deterministic token
+    rule still diverges on any drop/replay bug."""
+    rng = np.random.RandomState(11)
+    p0 = rng.randint(0, CFG.vocab_size, size=PROMPT).astype(np.int32)
+
+    def mk():
+        return ServingEngine(None, CFG, num_slots=3, block_size=BS,
+                             chunk=4, prefix_cache=True,
+                             device_step=StubDeviceStep())
+
+    shared = p0.tolist()[:8]
+    reqs = [shared + [i] for i in range(6)]
+
+    def solo(tokens):
+        e = mk()
+        r = e.submit(Request(tokens, NEW))
+        e.run_until_idle()
+        return e.finished[r]["tokens"]
+
+    want = [solo(r) for r in reqs]
+    router = Router([mk(), mk()], rebalance_every=1, rebalance_watermark=1)
+    w = router.submit(Request(p0.tolist(), 2))  # pin affinity to one side
+    router.run_until_idle()
+    pinned = router.finished[w]["replica"]
+    router.reset_metrics()
+
+    rids = [router.submit(Request(r, NEW)) for r in reqs]
+    routed = [e for e in stub_log.as_list()
+              if e["kind"] == "request_routed"]
+    assert all(e["replica"] == pinned for e in routed[-6:])
+    _run_audited(router)
+    s = router.summary()
+    assert s["fleet"]["rebalances"] >= 1
+    assert s["fleet"]["rebalanced_requests"] >= 1
+    assert router.replicas[1 - pinned].stats["generated_tokens"] > 0
+    moved = [e for e in stub_log.as_list()
+             if e["kind"] == "request_migrated" and e["mode"] == "rebalance"]
+    assert moved and all(e["src_replica"] == pinned for e in moved)
+    for rid, row in zip(rids, range(6)):
+        np.testing.assert_array_equal(
+            router.finished[rid]["tokens"], want[row],
+            err_msg="rebalance broke replay parity")
+    assert _validate_router(s) == []
+
+
+@pytest.mark.slow
 def test_rebalance_moves_queue_with_exact_parity(fleet, event_log):
+    """Real-engine rebalance parity (slow tier; fast holder:
+    ``test_rebalance_policy_and_parity_stub``)."""
     a, b = _pair(fleet)
     p = fleet["prompts"]
     router = Router([a, b], rebalance_every=1, rebalance_watermark=1)
@@ -441,18 +493,33 @@ def test_replica_kill_mid_decode_evacuates_to_survivor(fleet, event_log):
 # ------------------------------------------------ pricing and the validator
 
 
-def test_dcn_migration_pricing_and_int8_wire(fleet, event_log):
-    """The comm-model loop on the migration leg: a zone-crossing handoff
-    is priced through ``predict_compressed`` on the calibrated DCN axis
-    and ships the int8 wire format iff the model approves; a same-zone
-    handoff never compresses (and the bit-parity tests above all ride
-    same-zone legs)."""
+def test_dcn_migration_pricing_and_int8_wire(stub_log):
+    """The comm-model loop on the migration leg (PR-19 budget payback:
+    pricing is host POLICY, so this rides StubDeviceStep; the int8
+    wire's bounded-error parity on real arrays stays with
+    ``test_migrate_blocks_unit`` above): a zone-crossing handoff is
+    priced through ``predict_compressed`` on the calibrated DCN axis and
+    ships the int8 wire format iff the model approves; an
+    alpha-dominated leg REFUSES and stays exact."""
+    event_log = stub_log
+    rng = np.random.RandomState(13)
+    p = rng.randint(0, CFG.vocab_size, size=(2, PROMPT)).astype(np.int32)
+
+    def mk():
+        return ServingEngine(None, CFG, num_slots=3, block_size=BS,
+                             chunk=4, prefix_cache=True,
+                             device_step=StubDeviceStep())
+
+    def solo(tokens):
+        e = mk()
+        r = e.submit(Request(tokens, NEW))
+        e.run_until_idle()
+        return e.finished[r]["tokens"]
+
     model = CommModel(
         axis_costs={"dcn": AxisCost(1e-3, 1e9, "calibrated")},
         compressed_axis_costs={"dcn": AxisCost(1e-3, 1e9, "calibrated")})
-    a, b = _pair(fleet)
-    p = fleet["prompts"]
-    router = Router([a, b], roles=["prefill", "decode"],
+    router = Router([mk(), mk()], roles=["prefill", "decode"],
                     zones=["east", "west"], comm_model=model)
     rid = router.submit(Request(p[0].tolist(), NEW))
     _run_audited(router)
@@ -472,23 +539,31 @@ def test_dcn_migration_pricing_and_int8_wire(fleet, event_log):
         axis_costs={"dcn": AxisCost(1.0, float("inf"), "calibrated")},
         compressed_axis_costs={"dcn": AxisCost(1.0, float("inf"),
                                                "calibrated")})
-    a, b = _pair(fleet)
-    router = Router([a, b], roles=["prefill", "decode"],
+    router = Router([mk(), mk()], roles=["prefill", "decode"],
                     zones=["east", "west"], comm_model=slow)
     rid = router.submit(Request(p[1].tolist(), NEW))
     _run_audited(router)
     ev = [e for e in event_log.as_list() if e["kind"] == "blocks_migrated"][-1]
     assert ev["dcn"] and not ev["compressed"]
     np.testing.assert_array_equal(  # exact wire => parity intact
-        router.finished[rid]["tokens"], fleet["want"][1])
+        router.finished[rid]["tokens"], solo(p[1].tolist()))
 
 
-def test_router_summary_validator_bites(fleet, event_log):
+def test_router_summary_validator_bites(stub_log):
+    """Validator logic is pure host code (PR-19 budget payback: rides
+    StubDeviceStep, never pays the compiled fleet fixture)."""
     import copy
 
-    a, b = _pair(fleet)
-    router = Router([a, b])
-    rid = router.submit(Request(fleet["prompts"][0].tolist(), NEW))
+    rng = np.random.RandomState(17)
+    prompt = rng.randint(0, CFG.vocab_size, size=PROMPT).tolist()
+
+    def mk():
+        return ServingEngine(None, CFG, num_slots=3, block_size=BS,
+                             chunk=4, prefix_cache=True,
+                             device_step=StubDeviceStep())
+
+    router = Router([mk(), mk()])
+    rid = router.submit(Request(prompt, NEW))
     router.run_until_idle()
     assert router.finished[rid]["new_tokens"] == NEW
     s = router.summary()

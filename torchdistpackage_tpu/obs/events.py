@@ -322,6 +322,12 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     # trace resolved ('auto' is backend-dependent), and the host-side
     # capacity-overflow alarm (dropped-token rate over threshold)
     "moe_dispatch_selected", "expert_overflow",
+    # elastic fleet (PR 19): every autoscaler evaluation (hold included)
+    # with its evidence; per-chunk wire re-requests healed by bounded
+    # backoff; a transfer declared dead taking the re-prefill fallback;
+    # and the engine-side unwind of an import whose KV never arrived
+    "scale_decision", "migration_retry", "migration_fallback",
+    "import_aborted",
 })
 
 

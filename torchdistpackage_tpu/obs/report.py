@@ -29,6 +29,12 @@ SERVING_VERDICTS = ("healthy", "degraded", "overloaded")
 # subsystem — validates router sections without importing serving)
 FLEET_BALANCE_VERDICTS = ("balanced", "skewed", "degraded")
 
+# the autoscaler's end states (serving/autoscale.py owns the control
+# policy; vocabulary mirrored for the same leaf-subsystem reason):
+# static = never acted, elastic = acted within the thrash budget,
+# thrashing = more scale flips than the budget allows
+AUTOSCALE_VERDICTS = ("static", "elastic", "thrashing")
+
 # the auto-sharding planner's end states (dist/autoplan.py imports these —
 # obs is a leaf subsystem, so the schema vocabulary lives here): ``ok`` = a
 # plan was chosen, ``all_oom`` = every candidate was pruned by the memory
@@ -604,6 +610,14 @@ def _validate_router(rt: Any) -> List[str]:
             v = mig.get(k)
             if not isinstance(v, int) or v < 0:
                 errs.append(f"router.fleet.migrations.{k} missing/negative")
+        # the fault-tolerant wire (PR 19): retry/fallback counters are
+        # optional (old reports) but must be sane when present, and a
+        # fallback implies the transfer's handoff never completed — the
+        # counters may never exceed what the wire actually carried
+        for k in ("retries", "fallbacks"):
+            v = mig.get(k)
+            if v is not None and (not isinstance(v, int) or v < 0):
+                errs.append(f"router.fleet.migrations.{k} negative/non-int")
     for k in ("rebalances", "evacuations"):
         v = fleet.get(k)
         if not isinstance(v, int) or v < 0:
@@ -654,6 +668,62 @@ def _validate_router(rt: Any) -> List[str]:
             errs.append(
                 "router.fleet.balance.verdict 'balanced' contradicts "
                 f"fleet verdict {fleet.get('verdict')!r}")
+    asc = fleet.get("autoscale")
+    if asc is not None:
+        errs.extend(_validate_autoscale(asc))
+    return errs
+
+
+def _validate_autoscale(asc: Any) -> List[str]:
+    """The optional ``router.fleet.autoscale`` subsection (an
+    ``Autoscaler`` was attached): verdict-vs-evidence cross-checked in
+    BOTH directions — a ``static`` verdict with recorded scale actions
+    lies about what the controller did, and a non-``static`` verdict
+    with zero actions claims activity the ledger cannot attribute;
+    ``thrashing`` must agree with the action count vs the thrash budget,
+    and the action total must reconcile with its up/down split."""
+    if not isinstance(asc, dict):
+        return ["router.fleet.autoscale non-dict"]
+    errs: List[str] = []
+    if asc.get("verdict") not in AUTOSCALE_VERDICTS:
+        errs.append(
+            f"router.fleet.autoscale.verdict {asc.get('verdict')!r} not "
+            f"in {AUTOSCALE_VERDICTS}")
+    for k in ("actions", "evals", "scale_ups", "scale_downs", "holds"):
+        v = asc.get(k)
+        if not isinstance(v, int) or v < 0:
+            errs.append(f"router.fleet.autoscale.{k} missing/negative")
+    if not asc.get("basis"):
+        errs.append("router.fleet.autoscale.basis missing/empty (the "
+                    "verdict must cite its evidence)")
+    actions = asc.get("actions")
+    ups, downs = asc.get("scale_ups"), asc.get("scale_downs")
+    if (isinstance(actions, int) and isinstance(ups, int)
+            and isinstance(downs, int) and actions != ups + downs):
+        errs.append(
+            f"router.fleet.autoscale.actions {actions} != scale_ups "
+            f"{ups} + scale_downs {downs}")
+    verdict = asc.get("verdict")
+    if isinstance(actions, int) and verdict in AUTOSCALE_VERDICTS:
+        if verdict == "static" and actions > 0:
+            errs.append(
+                f"router.fleet.autoscale.verdict 'static' contradicts "
+                f"{actions} recorded scale actions")
+        if verdict != "static" and actions == 0:
+            errs.append(
+                f"router.fleet.autoscale.verdict {verdict!r} with 0 "
+                f"actions — 'static' is the only verdict for a "
+                f"controller that never acted")
+        thrash_at = asc.get("thrash_at")
+        if isinstance(thrash_at, int):
+            if verdict == "thrashing" and actions <= thrash_at:
+                errs.append(
+                    f"router.fleet.autoscale.verdict 'thrashing' with "
+                    f"{actions} actions <= thrash_at {thrash_at}")
+            if verdict == "elastic" and actions > thrash_at:
+                errs.append(
+                    f"router.fleet.autoscale.verdict 'elastic' with "
+                    f"{actions} actions > thrash_at {thrash_at}")
     return errs
 
 
@@ -1238,6 +1308,20 @@ def render_markdown(report: Dict[str, Any]) -> str:
             f"{mig.get('bytes', 0) / 1e6:.2f} MB wire, "
             f"{mig.get('compressed', 0)} int8-compressed) over "
             f"{mig.get('signatures', 0)} compiled pair program(s)")
+        if mig.get("retries") or mig.get("fallbacks"):
+            L.append(
+                f"- migration wire: {mig.get('retries', 0)} chunk "
+                f"re-request(s) healed by backoff, "
+                f"{mig.get('fallbacks', 0)} dead transfer(s) fell back "
+                f"to re-prefill")
+        asc = fleet.get("autoscale") or {}
+        if asc:
+            L.append(
+                f"- autoscale: **{asc.get('verdict', '?')}** "
+                f"({asc.get('scale_ups', 0)} up / "
+                f"{asc.get('scale_downs', 0)} down / "
+                f"{asc.get('retiers', 0)} retier over "
+                f"{asc.get('evals', 0)} evals) — {asc.get('basis', '')}")
         L.append(
             f"- rebalances: {fleet.get('rebalances', 0)} "
             f"({fleet.get('rebalanced_requests', 0)} requests moved), "

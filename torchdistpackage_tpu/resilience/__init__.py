@@ -28,7 +28,14 @@ possible so the chaos/verification helpers stay usable from lightweight
 tooling.
 """
 
-from .chaos import FAULT_KINDS, ChaosMonkey, Fault, corrupt_checkpoint
+from .chaos import (
+    ENGINE_FAULT_KINDS,
+    FAULT_KINDS,
+    TRANSPORT_FAULT_KINDS,
+    ChaosMonkey,
+    Fault,
+    corrupt_checkpoint,
+)
 from .ckpt_guard import (
     CheckpointCorruptError,
     GuardedCheckpointManager,
@@ -52,7 +59,9 @@ from .watchdog import (
 )
 
 __all__ = [
+    "ENGINE_FAULT_KINDS",
     "FAULT_KINDS",
+    "TRANSPORT_FAULT_KINDS",
     "ChaosMonkey",
     "Fault",
     "corrupt_checkpoint",

@@ -58,6 +58,32 @@ bit-identical — see docs/serving.md "Serving under stress"):
                     that slot
 ==================  ====================================================
 
+Transport fault kinds (the KV-migration wire — ``Fault.step`` is the
+MIGRATION SEQUENCE NUMBER the fault fires on (the k-th ``send`` of the
+:class:`~..serving.transport.ChunkedWireTransport`), and ``Fault.slot``
+picks the victim chunk index within that send.  A non-repeating fault
+fires on the first fetch attempt only, so the bounded-backoff re-request
+recovers it; ``repeat=True`` fires on EVERY attempt — the retry budget
+exhausts and the router must take the ``migration_fallback`` re-prefill
+path instead.  See docs/resilience.md "Transport faults"):
+
+===============================  =======================================
+``chunk_drop``                   a wire chunk never arrives (the fetch
+                                 raises instead of delivering bytes)
+``chunk_corrupt``                a wire chunk arrives with a flipped
+                                 byte — the per-chunk SHA-256 manifest
+                                 check must reject it
+``transport_stall``              the fetch exceeds the transport's
+                                 timeout (``duration_s`` vs
+                                 ``timeout_s``) — a timed-out chunk is
+                                 re-requested like a dropped one
+``replica_death_midmigration``   the destination replica dies after
+                                 chunks started flowing — terminal for
+                                 the transfer: the router must fall
+                                 back without double-owning or leaking
+                                 the in-flight request's blocks
+===============================  =======================================
+
 Usage::
 
     chaos = ChaosMonkey(faults=[Fault("nan_spike", step=5)], seed=0)
@@ -83,9 +109,16 @@ from typing import Any, List, Optional, Sequence
 ENGINE_FAULT_KINDS = (
     "slot_stall", "alloc_exhaust", "table_corrupt", "nan_logits")
 
+#: Faults the KV-migration wire injects (``Fault.step`` = migration
+#: sequence number, ``Fault.slot`` = victim chunk index within the send;
+#: driven by :class:`~..serving.transport.ChunkedWireTransport`).
+TRANSPORT_FAULT_KINDS = (
+    "chunk_drop", "chunk_corrupt", "transport_stall",
+    "replica_death_midmigration")
+
 FAULT_KINDS = (
     "ckpt_corrupt", "sigterm", "nan_spike", "stall", "host_dropout",
-) + ENGINE_FAULT_KINDS
+) + ENGINE_FAULT_KINDS + TRANSPORT_FAULT_KINDS
 
 
 @dataclasses.dataclass
@@ -280,6 +313,26 @@ class ChaosMonkey:
             bogus = pool[self.rng.randrange(len(pool))]
             engine._tables[slot, 0] = bogus
             self._emit(f, slot=slot, entry=0, bogus_block=int(bogus))
+
+    # ---------------------------------------- migration-wire injectors
+
+    def transport_faults_due(self, seq: int) -> List[Fault]:
+        """Transport faults due on migration ``seq`` (the k-th wire send).
+        The :class:`~..serving.transport.ChunkedWireTransport` calls this
+        once per fetch ATTEMPT of that send: a non-repeating fault is
+        consumed by its first firing (the bounded-backoff re-request then
+        succeeds — the recoverable arm), while ``repeat=True`` keeps
+        firing until the retry budget exhausts (the fallback arm).  The
+        transport injects the failure itself and reports it back through
+        :meth:`fire` — injection lives where the wire lives."""
+        return self._due(seq, TRANSPORT_FAULT_KINDS)
+
+    def fire(self, fault: Fault, **extra: Any) -> None:
+        """Record an externally-injected fault: bump its fired count and
+        land the ``fault_injected`` evidence on the timeline — for
+        injectors (the migration transport) that apply the fault
+        themselves but must keep the chaos ledger exact."""
+        self._emit(fault, **extra)
 
     def perturb_engine_tokens(self, tick: int, tokens: Any) -> Any:
         """Poison one slot's host-fetched sampled token when a

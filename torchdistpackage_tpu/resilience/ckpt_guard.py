@@ -64,11 +64,17 @@ def with_retries(
     retry_on: Tuple[type, ...] = (OSError,),
     label: str = "ckpt",
     rng: Optional[random.Random] = None,
+    on_retry: Optional[Callable[[int, float, BaseException], None]] = None,
 ) -> Any:
     """Call ``fn()``; on a ``retry_on`` exception retry up to ``retries``
     times with exponential backoff (``base * 2**attempt``, capped, plus
     uniform jitter so a pod's hosts don't hammer storage in lockstep).
     Every retry emits a ``ckpt_retry`` event; the last failure re-raises.
+
+    ``on_retry(attempt, delay_s, error)`` replaces the default event for
+    callers retrying something other than checkpoint I/O (the KV-migration
+    transport emits ``migration_retry`` through exactly this hook) —
+    same bounded-backoff machinery, caller-owned evidence.
     """
     rng = rng or random.Random()
     attempt = 0
@@ -80,12 +86,15 @@ def with_retries(
                 raise
             delay = min(max_delay_s, base_delay_s * (2 ** attempt))
             delay += delay * jitter * rng.random()
-            from ..obs.events import emit_event
+            if on_retry is not None:
+                on_retry(attempt + 1, delay, e)
+            else:
+                from ..obs.events import emit_event
 
-            emit_event(
-                "ckpt_retry", label=label, attempt=attempt + 1,
-                retries=retries, delay_s=round(delay, 4), error=repr(e),
-            )
+                emit_event(
+                    "ckpt_retry", label=label, attempt=attempt + 1,
+                    retries=retries, delay_s=round(delay, 4), error=repr(e),
+                )
             time.sleep(delay)
             attempt += 1
 

@@ -55,12 +55,21 @@ requests through the real Router + :class:`StubDeviceStep` engines on
 CPU and emit the validated FLEETREPORT as evidence.
 """
 
+from .autoscale import AUTOSCALE_VERDICTS, Autoscaler
 from .engine import Request, ServingEngine
 from .router import (
     FLEET_BALANCE_VERDICTS,
     IMBALANCE_SKEWED_AT,
     ROLES,
     Router,
+)
+from .transport import (
+    ChunkedWireTransport,
+    LoopbackTransport,
+    MigrationTransport,
+    ReplicaDiedError,
+    TransportDeadError,
+    TransportError,
 )
 from .sim import (
     CompiledDeviceStep,
@@ -105,8 +114,16 @@ from .paged_cache import (
 )
 
 __all__ = [
+    "AUTOSCALE_VERDICTS",
+    "Autoscaler",
     "Request",
     "ServingEngine",
+    "ChunkedWireTransport",
+    "LoopbackTransport",
+    "MigrationTransport",
+    "ReplicaDiedError",
+    "TransportDeadError",
+    "TransportError",
     "FLEET_BALANCE_VERDICTS",
     "IMBALANCE_SKEWED_AT",
     "ROLES",

@@ -2143,6 +2143,34 @@ class ServingEngine:
                     "n_shared": len(hit), "n_live": n_live}
         return None
 
+    def abort_import(self, rid: int, n_valid: int = 0) -> None:
+        """Unwind an :meth:`import_slot` admission whose KV never arrived
+        (the migration transport died between import and ``deliver``).
+        Blocks past ``n_valid`` hold garbage — their content hashes (the
+        import optimistically registered migrated full blocks) are
+        DROPPED before release, so a later same-prefix import can never
+        ``share`` a block the wire never filled; valid (shared) blocks
+        release refcount-aware as usual.  The slot returns to FREE with
+        rows cleared — as if the import never happened.  The request
+        itself lives on in the router's descriptor (re-prefill
+        fallback)."""
+        for i, s in enumerate(self._slots):
+            if s.state != FREE and s.rid == rid:
+                break
+        else:
+            raise ValueError(f"abort_import: rid {rid} holds no slot")
+        alloc = self._allocs[i // self.slots_per_group]
+        for b in s.blocks[n_valid:]:
+            alloc._drop_hash(int(b))
+        self._release_blocks(alloc, s.blocks)
+        self._clear_slot_rows(i)
+        self._seq.pop(s.rid, None)
+        self._inject.pop(s.rid, None)
+        self._ttft_pred.pop(s.rid, None)
+        s.reset()
+        self.stats["imports_aborted"] += 1
+        self._ev.emit("import_aborted", rid=rid, n_valid=int(n_valid))
+
     def steal_queued(self, max_n: int) -> List[Dict[str, Any]]:
         """Pop up to ``max_n`` queued requests off the TAIL of the
         priority order (youngest of the lowest class — the requests that
@@ -2203,7 +2231,8 @@ class ServingEngine:
                       "prefix_prompt_tokens": 0, "cow_copies": 0,
                       "cache_evictions": 0,
                       "spec_drafted": 0, "spec_accepted": 0,
-                      "migrated_in": 0, "migrated_out": 0}
+                      "migrated_in": 0, "migrated_out": 0,
+                      "imports_aborted": 0}
         self._decode_sigs: set = set()
         self._prefill_sigs: set = set()
         self._cow_sigs: set = set()
@@ -2392,7 +2421,8 @@ class ServingEngine:
                          # requests that left with their KV (export_slot /
                          # steal_queued) and arrived with it (import_slot)
                          "migrated_in": st["migrated_in"],
-                         "migrated_out": st["migrated_out"]},
+                         "migrated_out": st["migrated_out"],
+                         "imports_aborted": st["imports_aborted"]},
             "generated_tokens": st["generated_tokens"],
             "tokens_per_sec": (
                 st["generated_tokens"] / span

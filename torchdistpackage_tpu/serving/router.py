@@ -102,6 +102,12 @@ import numpy as np
 from ..obs.events import EventLog, default_event_log, tag_events
 from .engine import DRAIN_SCHEMA, Request, ServingEngine
 from .paged_cache import migrate_blocks, migration_wire_bytes
+from .transport import (
+    LoopbackTransport,
+    MigrationTransport,
+    ReplicaDiedError,
+    TransportDeadError,
+)
 
 #: Fleet balance verdicts (``summary()['fleet']['balance']`` — the
 #: FLEETREPORT half of the fleet verdict): ``balanced`` = work spread
@@ -156,6 +162,12 @@ class Router:
     evacuate_on_fault: drain-and-redistribute a replica whose
         ``faults_detected`` counter moves (the chaos / dead-replica
         policy).  Off by default: the engines self-heal routine faults.
+    transport: a :class:`~.transport.MigrationTransport` carrying the
+        handoff KV copies (default :class:`~.transport.LoopbackTransport`
+        — the in-process bit-exact wire).  A prestaging transport (the
+        chunked wire) pulls and verifies chunk bytes BEFORE the import
+        admits anything; a transport declared dead falls back to
+        re-prefill on a survivor (``migration_fallback``).
     telemetry: an ``obs.Telemetry`` — router events land on its timeline.
     """
 
@@ -170,6 +182,7 @@ class Router:
         rebalance_every: int = 8,
         rebalance_watermark: int = 4,
         evacuate_on_fault: bool = False,
+        transport: Optional[MigrationTransport] = None,
         telemetry: Optional[Any] = None,
     ) -> None:
         if not replicas:
@@ -220,6 +233,15 @@ class Router:
                 self.replicas[i]._ev, replica=i)
         #: compiled migrate_blocks programs, one per ((src, dst), compress)
         self._mig_fns: Dict[Tuple[int, int, bool], Any] = {}
+        #: the migration wire (PR-19): loopback = the pre-transport
+        #: bit-exact in-process copy; the chunked wire adds manifests,
+        #: bounded-backoff re-requests, and the re-prefill fallback
+        self.transport: MigrationTransport = (
+            transport if transport is not None else LoopbackTransport())
+        self.transport.bind(self)
+        #: the elastic-fleet control loop (``serving/autoscale.py``
+        #: attaches itself here); ``step()`` ticks it after collection
+        self.autoscaler: Optional[Any] = None
         self.reset_metrics()
 
     # ------------------------------------------------------------- bookkeeping
@@ -253,7 +275,13 @@ class Router:
             "handoffs": 0, "handoffs_deferred": 0,
             "migration_blocks": 0, "migration_shared_blocks": 0,
             "migration_bytes": 0, "migrations_compressed": 0,
+            "transport_fallbacks": 0,
         }
+        #: router_rid -> {src, dst, src_rid} for every transfer whose
+        #: request currently lives ONLY in its exported descriptor —
+        #: the ownership site :meth:`audit` counts across the
+        #: export→import window (ISSUE-19: previously invisible)
+        self._inflight: Dict[int, Dict[str, Any]] = {}
 
     def _track(self, replica: int, replica_rid: int, router_rid: int) -> None:
         self._map[(replica, replica_rid)] = router_rid
@@ -438,11 +466,56 @@ class Router:
                 ref.cfg, n_blocks, ref.block_size, compressed=True)
         return out
 
+    def _lane_copy(self, src: int, dst: int, src_cache: Any, dst_cache: Any,
+                   src_ids: Sequence[int], dst_ids: Sequence[int],
+                   compress: bool) -> Any:
+        """The NULL-padded fixed-signature block copy through the cached
+        per-(pair, wire-format) ``migrate_blocks`` program — shared by
+        :class:`~.transport.LoopbackTransport` and the same-replica
+        bounce path, so signature accounting is one code path."""
+        ref = self.replicas[0]
+        n = len(src_ids)
+        lanes_src = np.zeros(ref.max_blocks, np.int32)
+        lanes_dst = np.zeros(ref.max_blocks, np.int32)
+        lanes_src[:n] = src_ids
+        lanes_dst[:n] = dst_ids
+        return self._mig_fn(src, dst, compress)(
+            src_cache, dst_cache, lanes_src, lanes_dst)
+
+    def _migration_fallback(self, router_rid: int, desc: Dict[str, Any],
+                            src: int, dst: int, err: BaseException) -> bool:
+        """The transport declared a handoff transfer dead: give up on
+        moving the KV and RE-PREFILL the request from its descriptor on
+        a surviving replica instead — correct-but-slower (the PR-9
+        descriptor replay is exact, so the token stream still BIT-matches
+        the unfaulted run; only the prefill work is repeated).  A
+        destination that DIED mid-transfer additionally leaves rotation
+        here, before placement reruns."""
+        self._inflight.pop(router_rid, None)
+        self.stats["transport_fallbacks"] += 1
+        if isinstance(err, ReplicaDiedError) and self.alive[err.replica]:
+            # full evacuation, not a bare rotation flip: requests already
+            # RESIDENT on the corpse (earlier successful migrations) must
+            # be rehomed too, or they leak with no terminal record
+            self.evacuate(err.replica, reason="died_midmigration")
+        self._ev.emit(
+            "migration_fallback", rid=router_rid, src_replica=src,
+            dst_replica=dst, error=repr(err),
+            replica_died=isinstance(err, ReplicaDiedError),
+            transport=self.transport.kind)
+        landed = self._resume_descs(
+            [desc], dst, "migration_fallback", origin=src)
+        return landed > 0
+
     def _handoff(self, src: int, rid: int) -> bool:
         """Move one just-prefilled (or decoding) request from replica
         ``src`` to the best import target: export → import (prefix-
         matched on arrival) → ``migrate_blocks`` of the unshared live
-        tail.  Returns False (and leaves the request where it is) when no
+        tail, carried by ``self.transport``.  A prestaging transport
+        pulls and verifies the tail BEFORE the import, so every wire
+        failure lands while the destination still holds nothing; a dead
+        transfer falls back to re-prefill (:meth:`_migration_fallback`).
+        Returns False (and leaves the request where it is) when no
         target has capacity."""
         p = self.replicas[src]
         slot = next((s for s in p._slots
@@ -475,6 +548,19 @@ class Router:
                      for a in self.replicas[i]._allocs)),
             None)
         if dst is None:
+            if not targets and self.roles[src] == "prefill":
+                # the last import-capable peer is gone (e.g. it died
+                # mid-migration): collapse the tier rather than park
+                # forever — this replica serves both phases until the
+                # autoscaler revives a decode peer.  Correct, merely
+                # un-disaggregated; the ledger records the collapse.
+                self.roles[src] = "both"
+                p.hold_decode = False
+                self._ev.emit(
+                    "replica_degraded", replica=src,
+                    reason="tier_collapse", action="undisaggregate",
+                    n_alive=sum(self.alive))
+                return False
             self.stats["handoffs_deferred"] += 1
             self._ev.emit(
                 "handoff_decision", rid=router_rid, src_replica=src,
@@ -482,6 +568,36 @@ class Router:
                 candidates=candidates)
             return False
         desc, src_cache = p.export_slot(rid)
+        # the in-flight window opens: until the import lands, the request
+        # exists ONLY in `desc` — audit() counts this record as its one
+        # allowed ownership site (the ISSUE-19 invisible-window fix)
+        self._inflight[router_rid] = {"src": src, "dst": dst,
+                                      "src_rid": rid}
+        tr = self.transport
+        handle = None
+        if tr.prestage:
+            # probe the destination's expected prefix share and pull the
+            # estimated unshared tail over the wire BEFORE the import:
+            # a transfer that dies here leaves dst completely untouched
+            ctx = tokens_full[:desc["length"]]
+            exp_shared = (self.replicas[dst].prefix_lookup(ctx)
+                          // p.block_size)
+            est_price = self._price_migration(
+                src, dst, max(0, desc["n_live"] - exp_shared))
+            try:
+                handle = tr.begin(src_cache, desc, src=src, dst=dst,
+                                  compress=est_price["compress"])
+                tr.fetch(handle, desc["blocks"][exp_shared:desc["n_live"]])
+            except TransportDeadError as e:
+                self._ev.emit(
+                    "handoff_decision", rid=router_rid, src_replica=src,
+                    outcome="transport_dead", chosen=dst,
+                    need_blocks=need, candidates=candidates)
+                return self._migration_fallback(router_rid, desc, src,
+                                                dst, e)
+        else:
+            handle = tr.begin(src_cache, desc, src=src, dst=dst,
+                              compress=False)
         d = self.replicas[dst]
         res = d.import_slot(desc)
         bounced = res is None
@@ -489,6 +605,7 @@ class Router:
             res = p.import_slot(desc)
             assert res is not None, "export_slot freed this capacity"
             dst, d = src, p
+        self._inflight.pop(router_rid, None)  # admitted: a slot owns it
         self._ev.emit(
             "handoff_decision", rid=router_rid, src_replica=src,
             outcome="bounced" if bounced else "handoff", chosen=dst,
@@ -497,13 +614,35 @@ class Router:
         n_mig = res["n_live"] - res["n_shared"]
         price = self._price_migration(src, dst, n_mig)
         if n_mig > 0:
-            ref = self.replicas[0]
-            lanes_src = np.zeros(ref.max_blocks, np.int32)
-            lanes_dst = np.zeros(ref.max_blocks, np.int32)
-            lanes_src[:n_mig] = desc["blocks"][res["n_shared"]:res["n_live"]]
-            lanes_dst[:n_mig] = res["blocks"][res["n_shared"]:res["n_live"]]
-            fn = self._mig_fn(src, dst, price["compress"])
-            d.cache = fn(src_cache, d.cache, lanes_src, lanes_dst)
+            mig_src = desc["blocks"][res["n_shared"]:res["n_live"]]
+            mig_dst = res["blocks"][res["n_shared"]:res["n_live"]]
+            if tr.prestage and not bounced:
+                price["compress"] = handle["compress"]  # what shipped
+                try:
+                    # cache eviction raced between probe and import: the
+                    # import expected to `share` these blocks but found
+                    # the hashes gone — RE-SHIP them (never trust a stale
+                    # hash; the wire holds the bytes)
+                    tr.fetch(handle, mig_src, reship=True)
+                    d.cache = tr.deliver(handle, d.cache, mig_src,
+                                         mig_dst)
+                except TransportDeadError as e:
+                    # unwind the admission: garbage-tail hashes dropped,
+                    # blocks released, slot freed — then fall back
+                    d.abort_import(res["rid"], res["n_shared"])
+                    self._map.pop((dst, res["rid"]), None)
+                    self._inflight[router_rid] = {
+                        "src": src, "dst": dst, "src_rid": rid}
+                    return self._migration_fallback(router_rid, desc,
+                                                    src, dst, e)
+            elif bounced:
+                # a bounce never crosses the wire: same-replica lane copy
+                d.cache = self._lane_copy(src, dst, src_cache, d.cache,
+                                          mig_src, mig_dst,
+                                          price["compress"])
+            else:
+                handle["compress"] = price["compress"]
+                d.cache = tr.deliver(handle, d.cache, mig_src, mig_dst)
         self.stats["handoffs"] += 1
         self.stats["migration_blocks"] += n_mig
         self.stats["migration_shared_blocks"] += res["n_shared"]
@@ -527,17 +666,22 @@ class Router:
         return True
 
     def _resume_descs(self, descs: List[Dict[str, Any]], exclude: int,
-                      kind: str) -> int:
+                      kind: str, origin: Optional[int] = None) -> int:
         """Resume drain descriptors onto the least-loaded surviving
         replicas (affinity-ranked per descriptor), bouncing a shed
         descriptor to the next candidate; a descriptor every survivor
         refused becomes a router-level rejection.  Returns how many
-        landed."""
+        landed.  ``origin`` names the replica the descriptors' rids map
+        from when it differs from the one being avoided (the
+        migration-fallback path excludes the DEAD destination while the
+        rids belong to the export source — which stays a legitimate
+        landing spot)."""
+        origin = exclude if origin is None else origin
         landed = 0
         for desc in descs:
             tokens_full = ([int(t) for t in desc["prompt"]]
                            + [int(t) for t in desc.get("emitted") or []])
-            router_rid = self._map.get((exclude, desc.get("orig_rid", -1)))
+            router_rid = self._map.get((origin, desc.get("orig_rid", -1)))
             if router_rid is None:
                 router_rid = self._next_rid
                 self._next_rid += 1
@@ -554,7 +698,7 @@ class Router:
                 self._track(i, rrid, router_rid)
                 self._ev.emit(
                     "request_migrated", rid=router_rid,
-                    src_replica=exclude, dst_replica=i, mode=kind,
+                    src_replica=origin, dst_replica=i, mode=kind,
                     src_rid=desc.get("orig_rid"), dst_rid=rrid,
                     emitted_tokens=len(desc.get("emitted") or []))
                 landed += 1
@@ -711,6 +855,8 @@ class Router:
                     self._handoff(i, rid)
             busy += r.n_busy
         self._collect()
+        if self.autoscaler is not None:
+            self.autoscaler.tick()
         return {"stepped": stepped, "busy": busy,
                 "queued": sum(len(r.queue) for r in self.replicas)}
 
@@ -736,9 +882,14 @@ class Router:
         """The cross-replica conservation audit: every replica's own
         block audit (heal=False — pure report) PLUS the invariant only a
         migration could break: each router-tracked request is live
-        (queued or in a slot) on AT MOST one replica.  A double-owned
-        request means an export/import or drain/resume landed twice —
-        its two copies would both decode and both free blocks."""
+        (queued, in a slot, OR riding an in-flight transfer) on AT MOST
+        one ownership site.  A double-owned request means an
+        export/import or drain/resume landed twice — its two copies
+        would both decode and both free blocks.  In-flight transfer
+        records (:attr:`_inflight` — the export→import window, during
+        which the request exists only in its descriptor) count as an
+        ownership site: a request both in flight and live on a replica
+        is exactly the double-delivery a wire retry could cause."""
         violations: List[Dict[str, Any]] = []
         per_replica = []
         for i, r in enumerate(self.replicas):
@@ -748,7 +899,10 @@ class Router:
                 violations.append(
                     {"kind": "replica_audit", "replica": i,
                      "violations": rep["violations"]})
-        live: Dict[int, List[int]] = {}
+        live: Dict[int, List[Any]] = {}
+        for router_rid, rec in self._inflight.items():
+            live.setdefault(router_rid, []).append(
+                f"inflight:{rec['src']}->{rec['dst']}")
         for i, r in enumerate(self.replicas):
             rids = {req.rid for req, _t in r.queue}
             rids |= {s.rid for s in r._slots if s.state != "free"}
@@ -761,6 +915,7 @@ class Router:
                 violations.append({"kind": "double_owned",
                                    "rid": router_rid, "replicas": where})
         return {"ok": not violations, "violations": violations,
+                "inflight": len(self._inflight),
                 "per_replica": per_replica}
 
     # ----------------------------------------------------------------- summary
@@ -894,6 +1049,15 @@ class Router:
                 # compile-once evidence for the migration tier: one
                 # program per (replica pair, wire format) ever compiled
                 "signatures": len(self._mig_fns),
+                # the fault-tolerant wire (PR-19): per-chunk re-requests
+                # healed by bounded backoff, and transfers declared dead
+                # that fell back to the re-prefill path
+                "retries": self.transport.stats["retries"],
+                "fallbacks": st["transport_fallbacks"],
+                "transport": dict(self.transport.stats,
+                                  kind=self.transport.kind),
             },
         }
+        if self.autoscaler is not None:
+            fleet["autoscale"] = self.autoscaler.summary()
         return {"replicas": replicas, "fleet": fleet}

@@ -522,6 +522,10 @@ ROUTER_EVENT_KINDS = frozenset({
     "route_decision", "request_routed", "handoff_decision",
     "rebalance_decision", "request_migrated", "blocks_migrated",
     "replica_degraded", "replica_up", "replica_down",
+    # elastic fleet (PR 19): autoscaler evaluations and the migration
+    # wire's retry/fallback records — router-tier decisions, so they
+    # ride the router lane of a fleet trace
+    "scale_decision", "migration_retry", "migration_fallback",
 })
 
 #: Chrome pid of the router decision lane in a fleet trace.

@@ -78,6 +78,14 @@ from typing import Any, Dict, List, Tuple
 #: earned while imbalance climbs means the router is feeding the kernel
 #: ever-more-skewed batches (capacity drops coming), visible before the
 #: dropped-token alarm fires.
+#: ``autoscale_actions`` / ``migration_retry_count`` /
+#: ``transport_fallback_count`` (PR 19) ride the elastic-fleet lines
+#: (``trace-replay``, ``serve-router-fleet``): a goodput hold earned
+#: with climbing scale actions means the controller is papering over a
+#: shrinking steady state (thrash coming); climbing wire retries mean
+#: the migration transport is degrading under the SAME fault plan; any
+#: nonzero fallback is a re-prefill the fleet paid for — cheap this
+#: release and expensive the next is a regression no headline catches.
 AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
             "grad_norm_final", "comm_bytes_per_dim", "shed_rate",
             "preempt_count", "prefix_hit_rate", "spec_accept_rate",
@@ -85,7 +93,9 @@ AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
             "autoplan_tok_s", "plan_modeled_step_s", "bubble_fraction",
             "plan_pp_schedule", "fleet_goodput_tok_s", "affinity_hit_rate",
             "migration_bytes", "fleet_slo_attainment", "migration_count",
-            "moe_pallas_tok_s", "expert_imbalance")
+            "moe_pallas_tok_s", "expert_imbalance",
+            "autoscale_actions", "migration_retry_count",
+            "transport_fallback_count")
 
 
 def _aux_str(key: str, val: Any) -> str:

@@ -751,6 +751,10 @@ def bench_serve_router(jax, jnp, cfg, params, tel, *, n_replicas,
         "migration_count": mig["handoffs"],
         "migration_bytes": mig["bytes"],
         "migration_shared_blocks": mig["shared_blocks"],
+        "migration_retry_count": mig.get("retries", 0),
+        "transport_fallback_count": mig.get("fallbacks", 0),
+        "autoscale_actions": (fleet["fleet"].get("autoscale") or {}
+                              ).get("actions", 0),
         "rebalances": fleet["fleet"]["rebalances"],
         "decode_signatures": 1,
         **fleet_prio_cols,

@@ -33,16 +33,30 @@ Evidence out (the point of the exercise):
   ``obs.report._validate_router`` before it is reported;
 - the **decision ledger** — every placement is checked attributable to
   a ``route_decision``/``handoff_decision``/``rebalance_decision``
-  record (``attribution.complete``), and ``--ledger`` writes the
-  router-scope records as JSONL;
+  record, and every fleet-size change to a non-hold ``scale_decision``
+  (``attribution.complete``); ``--ledger`` writes the router-scope
+  records as JSONL;
 - optional ``--report`` (the RUNREPORT convention: JSON at the path +
   a sibling ``.md``) and ``--trace`` (a fleet Perfetto trace of the
   last ``--history`` events).
 
+ISSUE-19 elastic-fleet mode: ``--spares N`` provisions N extra parked
+replicas (``provisioned_spare`` — they cost nothing until revived),
+``--autoscale`` attaches the goodput-driven
+:class:`~..serving.autoscale.Autoscaler`, and ``--chaos`` seeds
+transport faults (every ``TRANSPORT_FAULT_KINDS`` member, including
+replica death mid-migration) into the migration wire.  Arrival rate is
+computed from the CORE replicas only, so the load — and the reported
+``config_hash`` — is identical with autoscaling on or off: ``--ab``
+runs both arms back to back and reports the attainment delta at equal
+hash.  Attainment/goodput/replica-count curves are sampled every
+``--curve-every`` ticks into the report.
+
 Usage::
 
     python -m torchdistpackage_tpu.tools.trace_replay \
-        --n-requests 100000 --replicas 4 \
+        --n-requests 100000 --replicas 4 --spares 2 \
+        --autoscale --chaos \
         --report /tmp/FLEETREPORT.json --ledger /tmp/ledger.jsonl
 
 Prints one ``{"metric": "trace-replay", ...}`` JSON line (the
@@ -78,6 +92,8 @@ class LedgerCounter:
         self.route_outcomes: Dict[str, int] = {}
         self.handoff_outcomes: Dict[str, int] = {}
         self.rebalance_moved = 0
+        self.scale_actions = 0
+        self.scale_holds = 0
 
     def write(self, rec: Dict[str, Any]) -> None:
         kind = rec.get("kind")
@@ -90,6 +106,11 @@ class LedgerCounter:
             self.handoff_outcomes[o] = self.handoff_outcomes.get(o, 0) + 1
         elif kind == "rebalance_decision":
             self.rebalance_moved += int(rec.get("moved", 0))
+        elif kind == "scale_decision":
+            if rec.get("action") in ("scale_up", "scale_down"):
+                self.scale_actions += 1
+            else:
+                self.scale_holds += 1
         if self._sink is not None and kind in self._router_kinds:
             self._sink.write(rec)
 
@@ -190,12 +211,28 @@ def run_replay(
     multiturn_p: float = 0.3,
     ledger_path: Optional[str] = None,
     max_ticks: Optional[int] = None,
+    autoscale: bool = False,
+    n_spares: int = 0,
+    autoscale_kw: Optional[Dict[str, Any]] = None,
+    chaos: bool = False,
+    chaos_faults: int = 12,
+    curve_every: int = 512,
 ) -> Dict[str, Any]:
     """Drive ``n_requests`` through a stubbed fleet; return the replay
     report (validated FLEETREPORT + attribution + sim/wall costs).
     Keeps the last ``history_max`` events in memory for trace
     rendering; the full ledger streams through :class:`LedgerCounter`
-    (and to ``ledger_path`` as JSONL when given)."""
+    (and to ``ledger_path`` as JSONL when given).
+
+    ``n_spares`` extra replicas join the fleet PARKED
+    (``provisioned_spare``); arrival rate comes from the core replicas
+    only, so the workload — and the returned ``config_hash`` — is
+    byte-identical whether ``autoscale`` is on or off (the A/B
+    contract).  ``chaos=True`` seeds ``chaos_faults`` transport faults
+    (cycling every ``TRANSPORT_FAULT_KINDS`` member, death included)
+    across the migration-send sequence space."""
+    import hashlib
+
     from ..models.gpt import GPTConfig
     from ..obs.events import (
         EventLog,
@@ -203,9 +240,11 @@ def run_replay(
         set_default_event_log,
     )
     from ..obs.report import _validate_router
+    from ..serving.autoscale import Autoscaler
     from ..serving.engine import Request, ServingEngine
     from ..serving.router import Router
     from ..serving.sim import StubDeviceStep
+    from ..serving.transport import ChunkedWireTransport
 
     max_ctx = 8 * block_size + 64
     cfg = GPTConfig(vocab_size=vocab, dim=64, nheads=4, nlayers=2,
@@ -226,8 +265,25 @@ def run_replay(
     prev_log = default_event_log()
     set_default_event_log(log)
 
+    # everything that shapes the WORKLOAD and fleet hardware — but NOT
+    # the autoscale switch — goes into the hash, so an A/B pair proves
+    # "same offered load, same fleet, only the controller differs"
+    config_hash = hashlib.sha256(json.dumps({
+        "n_requests": n_requests, "n_replicas": n_replicas,
+        "n_spares": n_spares, "num_slots": num_slots,
+        "block_size": block_size, "chunk": chunk, "vocab": vocab,
+        "seed": seed, "disaggregate": disaggregate,
+        "rate_util": rate_util, "diurnal_amp": diurnal_amp,
+        "diurnal_period": diurnal_period,
+        "rebalance_every": rebalance_every,
+        "rebalance_watermark": rebalance_watermark, "groups": groups,
+        "zipf_a": zipf_a, "multiturn_p": multiturn_p,
+        "chaos": chaos, "chaos_faults": chaos_faults,
+    }, sort_keys=True).encode()).hexdigest()[:16]
+
     try:
-        stubs = [StubDeviceStep() for _ in range(n_replicas)]
+        n_total = n_replicas + max(0, n_spares)
+        stubs = [StubDeviceStep() for _ in range(n_total)]
         engines = [
             ServingEngine(None, cfg, num_slots=num_slots,
                           block_size=block_size, chunk=chunk,
@@ -237,21 +293,86 @@ def run_replay(
         roles = (["prefill"] + ["decode"] * (n_replicas - 1)
                  if disaggregate and n_replicas > 1
                  else ["both"] * n_replicas)
+        roles += ["both"] * max(0, n_spares)
+
+        monkey = None
+        transport = None
+        if chaos:
+            from ..resilience.chaos import (
+                TRANSPORT_FAULT_KINDS,
+                ChaosMonkey,
+                Fault,
+            )
+
+            # seed faults across the migration-send sequence space:
+            # cycle every kind (recoverable singles plus one repeating
+            # drop and the death) at rng-chosen, collision-free seqs
+            frng = np.random.RandomState(seed + 1)
+            horizon = max(16, n_requests // 4)
+            seqs = sorted(frng.choice(
+                np.arange(1, horizon), size=min(chaos_faults, horizon - 1),
+                replace=False).tolist())
+            plan = []
+            for k, s in enumerate(seqs):
+                kind = TRANSPORT_FAULT_KINDS[k % len(TRANSPORT_FAULT_KINDS)]
+                plan.append(Fault(
+                    kind, step=int(s),
+                    duration_s=9.0 if kind == "transport_stall" else 0.0,
+                    repeat=(kind == "chunk_drop" and k % 8 == 4)))
+            monkey = ChaosMonkey(faults=plan, seed=seed)
+            transport = ChunkedWireTransport(chaos=monkey)
+
         router = Router(engines, roles=roles,
                         rebalance_every=rebalance_every,
-                        rebalance_watermark=rebalance_watermark)
+                        rebalance_watermark=rebalance_watermark,
+                        transport=transport)
+        for i in range(n_replicas, n_total):
+            router.set_alive(i, False, reason="provisioned_spare")
+        asc = Autoscaler(router, **(autoscale_kw or {})) if autoscale \
+            else None
 
         # arrival pacing: steady-state decode width is the fleet's
         # non-prefill slots, each retiring ~1 token/tick, so capacity
         # is ~decode_slots/avg_new requests per tick; the diurnal peak
-        # runs (1 + amp) * rate_util over that on purpose
+        # runs (1 + amp) * rate_util over that on purpose.  Spares are
+        # EXCLUDED — offered load must not change when they exist
         decode_slots = num_slots * sum(
-            1 for r in roles if r != "prefill")
+            1 for r in roles[:n_replicas] if r != "prefill")
         avg_new = 8.0
         base_rate = rate_util * decode_slots / avg_new
         if max_ticks is None:
             max_ticks = int(4 * n_requests * avg_new
                             / max(decode_slots, 1)) + 10_000
+
+        def _slo_totals():
+            met = demand = good = 0
+            for e in engines:
+                for row in e._slo_by_prio.values():
+                    met += row["met"]
+                    demand += (row["completed"] + row["shed"]
+                               + row["expired"])
+                    good += row["goodput_tokens"]
+            return met, demand, good
+
+        curves: Dict[str, List[Any]] = {
+            "tick": [], "attainment": [], "goodput_tokens": [],
+            "n_alive": [], "queued": []}
+        prev_slo = _slo_totals()
+
+        def _sample(t: int) -> None:
+            nonlocal prev_slo
+            met, demand, good = _slo_totals()
+            d_met = met - prev_slo[0]
+            d_dem = demand - prev_slo[1]
+            d_good = good - prev_slo[2]
+            prev_slo = (met, demand, good)
+            curves["tick"].append(t)
+            curves["attainment"].append(
+                round(d_met / d_dem, 4) if d_dem else None)
+            curves["goodput_tokens"].append(d_good)
+            curves["n_alive"].append(sum(router.alive))
+            curves["queued"].append(
+                sum(len(e.queue) for e in engines))
 
         submitted = 0
         tick = 0
@@ -277,8 +398,11 @@ def run_replay(
                     wl.complete(rid, [int(t) for t in rec["tokens"]])
                 router.finished.clear()
             tick += 1
+            if curve_every and tick % curve_every == 0:
+                _sample(tick)
             if tick >= max_ticks:
                 break
+        _sample(tick)
         wall = time.perf_counter() - t0
 
         summary = router.summary()
@@ -296,6 +420,8 @@ def run_replay(
                 + counter.handoff_outcomes.get("bounced", 0)),
             "rebalanced": st["rebalanced_requests"],
             "ledger_rebalance_moved": counter.rebalance_moved,
+            "scale_actions": asc.actions if asc is not None else 0,
+            "ledger_scale_actions": counter.scale_actions,
         }
         attribution["complete"] = (
             attribution["submitted"]
@@ -304,7 +430,9 @@ def run_replay(
             == attribution["ledger_placements"]
             and attribution["handoffs"] == attribution["ledger_handoffs"]
             and attribution["rebalanced"]
-            == attribution["ledger_rebalance_moved"])
+            == attribution["ledger_rebalance_moved"]
+            and attribution["scale_actions"]
+            == attribution["ledger_scale_actions"])
         sim = {
             "sim_device_s": round(sum(s.sim_s for s in stubs), 6),
             "calls": {k: sum(s.calls[k] for s in stubs)
@@ -316,6 +444,12 @@ def run_replay(
             "submitted": submitted,
             "ticks": tick,
             "wall_s": round(wall, 3),
+            "config_hash": config_hash,
+            "curves": curves,
+            "autoscale": asc.summary() if asc is not None else None,
+            "chaos": ({"declared": len(monkey.faults),
+                       "fired": monkey.fired_count}
+                      if monkey is not None else None),
             "workload": dict(wl.stats,
                              multiturn_pool=len(wl.pool),
                              groups=groups, zipf_a=zipf_a,
@@ -361,6 +495,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--multiturn-p", type=float, default=0.3)
     ap.add_argument("--history", type=int, default=65_536,
                     help="events kept in memory for --trace rendering")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="attach the goodput-driven Autoscaler")
+    ap.add_argument("--spares", type=int, default=0,
+                    help="extra replicas provisioned PARKED (revived "
+                         "only by the autoscaler)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="seed transport faults (drop/corrupt/stall/"
+                         "death) into the migration wire")
+    ap.add_argument("--chaos-faults", type=int, default=12)
+    ap.add_argument("--curve-every", type=int, default=512,
+                    help="ticks between attainment/goodput/replica-"
+                         "count curve samples")
+    ap.add_argument("--ab", action="store_true",
+                    help="run the autoscaling-DISABLED arm too (same "
+                         "config hash) and report the attainment delta")
+    ap.add_argument("--eval-every", type=int, default=64,
+                    help="autoscaler control period (fleet ticks)")
+    ap.add_argument("--cooldown", type=int, default=192)
+    ap.add_argument("--queue-high", type=float, default=4.0)
     ap.add_argument("--ledger", default=None,
                     help="write router decision records as JSONL")
     ap.add_argument("--report", default=None,
@@ -375,7 +528,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if path is not None and os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
 
-    out = run_replay(
+    common = dict(
         n_requests=args.n_requests, n_replicas=args.replicas,
         num_slots=args.num_slots, block_size=args.block_size,
         chunk=args.chunk, seed=args.seed, disaggregate=not args.flat,
@@ -385,7 +538,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         rebalance_watermark=args.rebalance_watermark,
         history_max=args.history, groups=args.groups,
         zipf_a=args.zipf_a, multiturn_p=args.multiturn_p,
-        ledger_path=args.ledger)
+        n_spares=args.spares, chaos=args.chaos,
+        chaos_faults=args.chaos_faults, curve_every=args.curve_every,
+        autoscale_kw={"eval_every": args.eval_every,
+                      "cooldown": args.cooldown,
+                      "queue_high": args.queue_high})
+
+    baseline = None
+    if args.ab:
+        baseline = run_replay(autoscale=False, **common)
+        baseline.pop("events")
+
+    out = run_replay(autoscale=args.autoscale or args.ab,
+                     ledger_path=args.ledger, **common)
     log = out.pop("events")
 
     if args.trace is not None:
@@ -408,15 +573,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         "counters": {"workload": out["workload"],
                      "attribution": out["attribution"],
                      "sim": out["sim"],
+                     "curves": out["curves"],
+                     "autoscale": out["autoscale"],
+                     "chaos": out["chaos"],
                      "replay": {"schema": out["schema"],
                                 "n_requests": out["n_requests"],
                                 "submitted": out["submitted"],
+                                "config_hash": out["config_hash"],
                                 "validation_errors":
                                     out["validation_errors"]}},
     }
     if args.report is not None:
         write_runreport(report, args.report)
 
+    asc = out["autoscale"] or {}
     master_print(json.dumps({
         "metric": "trace-replay",
         "value": round(fleet["goodput_tok_s"], 1),
@@ -430,9 +600,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         "migration_bytes": fleet["migrations"]["bytes"],
         "fleet_verdict": fleet["verdict"],
         "balance_verdict": fleet["balance"]["verdict"],
+        "autoscale_actions": asc.get("actions", 0),
+        "migration_retry_count": fleet["migrations"].get("retries", 0),
+        "transport_fallback_count": fleet["migrations"].get(
+            "fallbacks", 0),
+        "config_hash": out["config_hash"],
         "report_valid": not out["validation_errors"],
         "attribution_complete": out["attribution"]["complete"],
     }), flush=True)
+    if baseline is not None:
+        att_on = fleet["attainment"]
+        att_off = baseline["summary"]["fleet"]["attainment"]
+        master_print(json.dumps({
+            "metric": "trace-replay-ab",
+            "config_hash": out["config_hash"],
+            "config_hash_match": (out["config_hash"]
+                                  == baseline["config_hash"]),
+            "attainment_autoscaled": att_on,
+            "attainment_static": att_off,
+            "attainment_delta": round(att_on - att_off, 4),
+            "baseline_valid": not baseline["validation_errors"],
+            "win": att_on > att_off,
+        }), flush=True)
     master_print(render_summary_line(report), flush=True)
     if out["validation_errors"]:
         master_print(json.dumps(
