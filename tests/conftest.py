@@ -123,3 +123,40 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs[:8]
+
+
+# ---------------------------------------------- compiled-bundle registry
+#
+# ROADMAP 5b down payment: compiles dominate the tier-1 budget, and the
+# most expensive ones are "canonical reference" bundles (a golden engine
+# run, a baseline forward) that several tests in a module — or several
+# modules — each rebuild from scratch.  The bank memoizes those bundles
+# per SESSION under an explicit key, so the second consumer pays a dict
+# lookup instead of a compile.  Rules for bank-worthy bundles:
+#
+#   - reference-only data (golden tokens, configs, frozen params) or an
+#     engine that every consumer resets before use — the bank never
+#     resets anything itself;
+#   - keys are (module-or-feature, variant) tuples so collisions are
+#     impossible by construction;
+#   - builders must not depend on tpc mesh state (the autouse _reset_tpc
+#     fixture tears meshes down between tests; a banked engine that
+#     closed over a mesh would go stale).  Build refs unsharded, or
+#     re-derive mesh-dependent state per test.
+
+
+class CompiledBundleBank:
+    def __init__(self):
+        self._bundles = {}
+        self.builds = 0  # observability: how many cache misses this session
+
+    def get(self, key, build):
+        if key not in self._bundles:
+            self._bundles[key] = build()
+            self.builds += 1
+        return self._bundles[key]
+
+
+@pytest.fixture(scope="session")
+def bundle_bank():
+    return CompiledBundleBank()

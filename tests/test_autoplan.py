@@ -276,6 +276,48 @@ def test_plan_selected_event_emitted():
     assert evs[-1]["n_candidates"] == res["n_candidates"]
 
 
+def test_plan_prefill_tier_prices_ring_and_prunes_oom():
+    """The PR-20 CP prefill planner: each ring width's modeled TTFT =
+    compute split cp ways + every ppermute hop priced through the
+    CommModel at the SAME per-hop payloads the engine's HLO ledger
+    shows; per-rank memory (pool/cp + ring working set) gates through
+    ``headroom_verdict``.  At a capacity only the split arms fit, cp1 is
+    pruned with the OOM evidence, the widest arm wins on modeled TTFT,
+    and the planner events land on the timeline."""
+    cfg = {"dim": 32, "nheads": 4, "nlayers": 1, "max_seq": 131072,
+           "vocab_size": 64, "kv_heads": 2, "dtype": "float32"}
+    log = default_event_log()
+    sel0 = len(log.of_kind("plan_selected"))
+    oom0 = len(log.of_kind("plan_rejected_oom"))
+    plan = ap.plan_prefill_tier(
+        cfg, context_len=131072, chunk=512, block_size=512,
+        cp_widths=(1, 2, 3, 4, 8), capacity_bytes=40_000_000)
+    assert plan["verdict"] == "ok"
+    assert plan["skipped_widths"] == [3]  # 512 % 3 != 0: not executable
+    assert [p["key"] for p in plan["pruned"]] == ["cp1"]
+    assert plan["chosen"]["key"] == "cp8"
+    by_cp = {r["cp"]: r for r in plan["ranked"]}
+    # compute splits down, ring volume grows, with cp — and the hop
+    # count matches the per-chunk HLO model times the chunk walk
+    assert by_cp[8]["compute_s"] < by_cp[2]["compute_s"]
+    assert by_cp[8]["ring_hops"] > by_cp[2]["ring_hops"] > 0
+    n_chunks = 131072 // 512
+    assert by_cp[2]["ring_hops"] == n_chunks * 4 * (2 - 1) * 1
+    ops = {t["name"]: t for t in plan["chosen"]["terms"]}
+    assert ops["cp-ring-fresh"]["op"] == "ppermute"
+    assert ops["cp-ring-pool"]["per_op_s"] > 0
+    assert log.of_kind("plan_selected")[-1]["key"] == "cp8"
+    assert len(log.of_kind("plan_selected")) == sel0 + 1
+    assert len(log.of_kind("plan_rejected_oom")) == oom0 + 1
+
+    # no width fits -> the clean all_oom verdict, no winner event
+    bad = ap.plan_prefill_tier(
+        cfg, context_len=131072, chunk=512, block_size=512,
+        cp_widths=(2, 4), capacity_bytes=1_000_000, emit=False)
+    assert bad["verdict"] == "all_oom" and bad["chosen"] is None
+    assert bad["n_pruned_oom"] == 2
+
+
 # ------------------------------------------------------------- MoE / EP (PR 18)
 
 MOE_TINY = GPTConfig(vocab_size=64, dim=32, nheads=4, nlayers=4, max_seq=32,

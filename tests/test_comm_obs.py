@@ -180,7 +180,9 @@ def test_classify_axes():
     assert classify_axes(("pipe",)) == "pp"
     assert classify_axes(("moe_ep",)) == "moe"
     assert classify_axes(("data", "tensor")) == "other"  # mixed
-    assert classify_axes(("context",)) == "other"
+    # the context axis classifies as cp since ring paged prefill (PR 20)
+    # ledgers its ppermute hops there (cp_ring_overlap reads this bucket)
+    assert classify_axes(("context",)) == "cp"
 
 
 # ---------------------------------------------------- ledger on real steps

@@ -73,6 +73,11 @@ OBS_EXAMPLES = {
     # the per-priority percentiles + verdict and the SIGTERM drain demo's
     # engine_drained event
     "serve_gpt.py": {"serving": "stress"},
+    # context-parallel long-context tier (PR 20): the serving section must
+    # carry the ``long_context`` block (cp width, ring hop/byte totals that
+    # reconcile with the hop model) and the cp_prefill_chunk / cp_ring_hop
+    # events — with the compile-once evidence intact despite the ring
+    "serve_long_context.py": {"serving": "long_context"},
     # multi-replica router (PR 15): the report must carry the validated
     # ``router`` section — per-replica serving sections + the fleet
     # roll-up with affinity/migration evidence — and the routing /
@@ -189,6 +194,16 @@ def test_example_runs_on_cpu_sim(script, tmp_path):
             assert 0.0 <= srv["spec_accept_rate"] <= 1.0, srv
             assert srv["spec"]["k"] >= 1, srv
             assert {"prefix_hit", "spec_draft", "spec_verify"} <= kinds, kinds
+        if probe["serving"] == "long_context":
+            lc = srv.get("long_context")
+            assert lc, (script, "no long_context block")
+            assert lc["cp"] >= 2 and lc["cp_axis"], lc
+            assert lc["prefill_chunks"] > 0, lc
+            # every ring hop the engine booked is on the timeline's model:
+            # hops = chunks * 4 * (cp-1) * nlayers, bytes follow the pool
+            assert lc["ring_hops"] > 0 and lc["ring_bytes"] > 0, lc
+            assert lc["ring_hops"] % lc["prefill_chunks"] == 0, lc
+            assert {"cp_prefill_chunk", "cp_ring_hop"} <= kinds, kinds
 
     if probe.get("router"):
         rt = report.get("router")

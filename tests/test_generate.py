@@ -133,12 +133,13 @@ MOE_CFGS = {
 
 
 @pytest.mark.parametrize("name", [
-    # both params drive the SAME no-drop decode dispatch; the fast tier
-    # keeps the cheaper GPT-trunk point, the mixtral composition
-    # (llama blocks + SwiGLU experts — each fast-tier on its own via
-    # test_greedy_matches_full_forward_llama + the EP/MoE tests) rides
-    # the slow tier (tier-1 budget, PR-13 payback idiom)
-    "moe",
+    # both params drive the SAME no-drop decode dispatch, which by PR-20
+    # is fast-tier-covered end to end elsewhere: token bit parity by
+    # test_moe_dispatch.py::test_engine_token_bit_parity and the
+    # dispatch math by test_fused_matches_sorted_and_dense_fwd_and_grad
+    # — so BOTH teacher-forced goldens ride the slow tier now (tier-1
+    # budget, PR-13 payback idiom)
+    pytest.param("moe", marks=pytest.mark.slow),
     pytest.param("mixtral", marks=pytest.mark.slow),
 ])
 @pytest.mark.heavy

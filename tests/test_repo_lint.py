@@ -587,6 +587,27 @@ def test_fastpath_event_kinds_registered_and_emitted():
     assert not missing, f"fast-path kinds never emitted from serving/: {missing}"
 
 
+def test_long_context_event_kinds_registered_and_emitted():
+    """The CP prefill kinds (PR 20) are in the registry AND each is
+    actually emitted from ``serving/`` — ``cp_prefill_chunk`` /
+    ``cp_ring_hop`` are the per-chunk ring evidence the
+    ``long_context`` summary block (and the comm-ledger cross-check in
+    tests/test_cp_prefill.py) reconciles against, and
+    ``kv_handoff_long`` is the router's record that a long prompt's
+    paged KV actually moved tiers; a kind that stopped being emitted
+    would silently empty the long-context trail."""
+    from torchdistpackage_tpu.obs.events import EVENT_KINDS
+
+    lc_kinds = {"cp_prefill_chunk", "cp_ring_hop", "kv_handoff_long"}
+    assert lc_kinds <= EVENT_KINDS
+    emitted = set()
+    for path in sorted((PKG / "serving").rglob("*.py")):
+        emitted.update(k for _, k in _emit_call_kinds(path))
+    missing = lc_kinds - emitted
+    assert not missing, (
+        f"long-context kinds never emitted from serving/: {missing}")
+
+
 # ------------------------------------------- silent exception swallowing
 
 # `except: pass` / `except Exception: pass` swallows the very faults the

@@ -322,7 +322,9 @@ def test_submit_guards(bundles):
         ServingEngine(b["params"], b["cfg"], axis="tensor")
     import dataclasses
     cp = dataclasses.replace(b["cfg"], attn_impl="ring")
-    with pytest.raises(NotImplementedError, match="context-parallel"):
+    # training-side ring/Ulysses still refuses — serving-side CP is the
+    # engine's own cp_axis= (ring paged prefill, tests/test_cp_prefill.py)
+    with pytest.raises(NotImplementedError, match="cp_axis"):
         ServingEngine(b["params"], cp)
 
 

@@ -440,6 +440,30 @@ def test_bench_trend_bubble_columns():
     assert any("REGRESSION gpt-tiny-train-throughput" in w for w in warnings)
 
 
+def test_bench_trend_long_context_columns():
+    """The PR-20 context-parallel prefill columns: the
+    ``serve-longctx-ab`` line gates on the cp1/cpN TTFT speedup
+    (``value``) with ``cp_prefill_ttft_s`` / ``long_ctx_tok_s`` rendered
+    alongside — a speedup hold earned while the CP arm's absolute TTFT
+    creeps up means both arms regressed together (the ratio hides it),
+    and a headline regression still trips the gate."""
+    from torchdistpackage_tpu.tools.bench_trend import AUX_KEYS, trend
+
+    assert {"cp_prefill_ttft_s", "long_ctx_tok_s"} <= set(AUX_KEYS)
+    line = {"metric": "serve-longctx-ab", "value": 1.6, "cp": 2,
+            "context": 131072, "cp_prefill_ttft_s": 2.1,
+            "long_ctx_tok_s": 240.0, "config": "c"}
+    report, warnings = trend(
+        [(1, [line]),
+         (2, [dict(line, value=1.1, cp_prefill_ttft_s=4.7,
+                   long_ctx_tok_s=110.0)])],
+        threshold=0.05)
+    assert any("cp_prefill_ttft_s=2.1" in ln for ln in report)
+    assert any("long_ctx_tok_s=240.0" in ln for ln in report)
+    assert any("cp_prefill_ttft_s=4.7" in ln for ln in report)
+    assert any("REGRESSION serve-longctx-ab" in w for w in warnings)
+
+
 def test_bench_trend_comm_bytes_column():
     """The PR-8 wire-bytes column: a line carrying ``comm_bytes_per_dim``
     renders its TOTAL in the aux trail, so a compressed collective

@@ -863,6 +863,169 @@ def plan(
     }
 
 
+PREFILL_PLAN_SCHEMA = "autoplan-prefill-v1"
+
+
+def plan_prefill_tier(
+    config: Any,
+    *,
+    context_len: int,
+    chunk: int,
+    block_size: int,
+    num_blocks: Optional[int] = None,
+    cp_widths: Sequence[int] = (1, 2, 4, 8),
+    batch: int = 1,
+    comm_model: Optional[CommModel] = None,
+    device_kind: Optional[str] = None,
+    capacity_bytes: Optional[int] = None,
+    effective_flops: Optional[float] = None,
+    emit: bool = True,
+) -> Dict[str, Any]:
+    """Size a CP prefill tier (docs/long_context.md "CP prefill
+    serving"): for each candidate ring width price the modeled TTFT of
+    one ``context_len``-token prompt — the chunk compute split ``cp``
+    ways plus every ring hop through the CommModel's ``ppermute`` row,
+    the same per-hop payloads the engine's HLO ledger shows
+    (``ring_hops_per_chunk`` / ``ring_chunk_bytes`` in
+    ops/ring_paged.py) — and the per-rank memory verdict: pool slice
+    (``pool/cp``) + ring working set against ``capacity_bytes``
+    (``headroom_verdict``).  Ranked by modeled ``ttft_s`` among
+    non-OOM arms; widths that don't divide ``chunk`` (each rank
+    prefills ``chunk/cp`` rows) are skipped as non-executable.
+
+    The hop and compute terms are summed SERIALLY — the honest model
+    until the on-chip overlap round lands (ROADMAP 5c); the returned
+    ``basis`` says so.  ``emit`` lands ``plan_rejected_oom`` /
+    ``plan_selected`` events like :func:`autoplan`."""
+    from ..obs.mem_ledger import headroom_verdict
+    from ..ops.ring_paged import (
+        modeled_cp_working_set_bytes,
+        ring_chunk_bytes,
+        ring_hops_per_chunk,
+    )
+
+    if context_len < 1 or chunk < 1 or block_size < 1:
+        raise ValueError(
+            f"context_len/chunk/block_size must be >= 1, got "
+            f"{context_len}/{chunk}/{block_size}")
+    d = model_dims(config)
+    kv_heads = d.kv_heads or d.nheads
+    head_dim = d.dim // d.nheads
+    model = comm_model or CommModel.from_defaults(
+        device_kind=device_kind or "unknown")
+    eff, compute_basis = _resolve_effective_flops(
+        effective_flops, device_kind)
+    # forward-only prefill: the 6N+12LSD accounting is fwd+bwd, and the
+    # backward is 2x the forward
+    fpt = flops_per_token(d) / 3.0
+    n_chunks = -(-context_len // chunk)
+    nb_base = num_blocks if num_blocks is not None \
+        else 1 + batch * -(-context_len // block_size)
+
+    ranked: List[Dict[str, Any]] = []
+    pruned: List[Dict[str, Any]] = []
+    skipped: List[int] = []
+    for cp in sorted(set(int(w) for w in cp_widths)):
+        if cp < 1 or chunk % cp:
+            skipped.append(cp)
+            continue
+        nb = -(-nb_base // cp) * cp  # the engine's rounding
+        nb_local = nb // cp
+        pool = 2 * d.nlayers * nb * kv_heads * block_size * head_dim \
+            * d.dtype_size
+        mem_bytes = pool // cp + modeled_cp_working_set_bytes(
+            kv_heads=kv_heads, head_dim=head_dim, block_size=block_size,
+            nb_local=nb_local, chunk=chunk, cp=cp, batch=batch,
+            itemsize=d.dtype_size)
+        verdict = headroom_verdict(mem_bytes, capacity_bytes)
+        compute_s = fpt * context_len / (cp * eff)
+        terms: List[Dict[str, Any]] = [{
+            "name": "prefill-compute", "op": "matmul", "axes": [],
+            "n": cp, "count": n_chunks, "total_s": compute_s,
+        }]
+        ring_s = 0.0
+        if cp > 1:
+            fresh = batch * kv_heads * (chunk // cp) * head_dim \
+                * d.dtype_size
+            pool_slice = nb_local * kv_heads * block_size * head_dim \
+                * d.dtype_size
+            for name, payload in (("cp-ring-fresh", fresh),
+                                  ("cp-ring-pool", pool_slice)):
+                per_op = model.predict(
+                    "ppermute", payload, cp, axes=("context",))
+                count = n_chunks * 2 * (cp - 1) * d.nlayers
+                terms.append({
+                    "name": name, "op": "ppermute", "axes": ["context"],
+                    "n": cp, "payload_bytes": int(payload),
+                    "count": count, "per_op_s": per_op,
+                    "total_s": per_op * count,
+                })
+                ring_s += per_op * count
+        row = {
+            "key": f"cp{cp}",
+            "cp": cp,
+            "num_blocks": nb,
+            "ttft_s": compute_s + ring_s,
+            "compute_s": compute_s,
+            "ring_s": ring_s,
+            "ring_hops": n_chunks * ring_hops_per_chunk(d.nlayers, cp),
+            "ring_bytes": n_chunks * ring_chunk_bytes(
+                nlayers=d.nlayers, cp=cp, batch=batch, kv_heads=kv_heads,
+                head_dim=head_dim, chunk=chunk, nb_local=nb_local,
+                block_size=block_size, itemsize=d.dtype_size),
+            "mem_bytes": mem_bytes,
+            "memory": verdict,
+            "terms": terms,
+        }
+        if verdict["verdict"] == "oom_risk":
+            prow = {"key": row["key"], "total_bytes": mem_bytes,
+                    "capacity_bytes": capacity_bytes,
+                    "frac": verdict["frac"]}
+            pruned.append(prow)
+            if emit:
+                from ..obs.events import emit_event
+
+                emit_event("plan_rejected_oom", **prow)
+            continue
+        ranked.append(row)
+    ranked.sort(key=lambda r: (r["ttft_s"], r["key"]))
+
+    chosen = dict(ranked[0]) if ranked else None
+    if chosen and emit:
+        from ..obs.events import emit_event
+
+        emit_event(
+            "plan_selected", key=chosen["key"],
+            modeled_step_s=chosen["ttft_s"],
+            n_candidates=len(ranked) + len(pruned),
+            n_pruned_oom=len(pruned))
+    return {
+        "schema": PREFILL_PLAN_SCHEMA,
+        "verdict": "ok" if chosen else "all_oom",
+        "n_candidates": len(ranked) + len(pruned),
+        "n_pruned_oom": len(pruned),
+        "skipped_widths": skipped,
+        "pruned": pruned,
+        "chosen": chosen,
+        "ranked": [
+            {k: v for k, v in r.items() if k != "terms"} if i else r
+            for i, r in enumerate(ranked)
+        ],
+        "params": {
+            "context_len": context_len, "chunk": chunk,
+            "block_size": block_size, "batch": batch,
+            "family": d.family,
+        },
+        "basis": {
+            "comm": model.source,
+            "compute": compute_basis,
+            "flops_per_token_fwd": fpt,
+            "effective_flops": eff,
+            "overlap": "serial (compute + ring summed; ROADMAP 5c)",
+        },
+    }
+
+
 def _jax_importable() -> bool:
     try:
         import jax  # noqa: F401

@@ -105,6 +105,7 @@ AXIS_DIM: Dict[str, str] = {
     "stage": "pp",
     "moe_ep": "moe",
     "expert": "moe",
+    "context": "cp",
 }
 
 _DTYPE_BITS = {
@@ -478,6 +479,53 @@ def tp_pp_overlap(ledger: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
+def cp_ring_overlap(ledger: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Ring-paged-prefill overlap evidence from a ledger (the PR-20
+    analogue of :func:`tp_pp_overlap`): the CP ring's hops are
+    python-unrolled ppermutes (ops/ring_paged.py), so every hop is its
+    own ``collective-permute`` over the ``context`` axis — ``cp_hops``
+    counting them from HLO is the no-while-body-undercount evidence.
+    For every hop the scheduler split into -start/-done, the report
+    records which non-cp ops (the next sub-chunk's flash accumulation,
+    projections, gathers) were issued inside its window — hops hidden
+    under chunk compute.  On backends whose scheduler never splits the
+    permute (the CPU sim), ``cp_async_hops`` is 0 and the overlap fields
+    are vacuously 0; the hop COUNT is backend-independent.
+    """
+    out = {
+        "cp_hops": 0,
+        "cp_hop_bytes": 0,
+        "cp_async_hops": 0,
+        "cp_windows_with_compute_comm": 0,
+        "ops_in_cp_windows": 0,
+        "mean_cp_sched_distance": None,
+    }
+    if not ledger or not ledger.get("collectives"):
+        return out
+    colls = ledger["collectives"]
+    distances = []
+    for c in colls:
+        if c["dim"] != "cp" or c["op"] != "collective-permute":
+            continue
+        out["cp_hops"] += 1
+        out["cp_hop_bytes"] += c["bytes"]
+        if not c["async"]:
+            continue
+        out["cp_async_hops"] += 1
+        if c["sched_distance"] is not None:
+            distances.append(c["sched_distance"])
+        inside = [colls[i] for i in (c.get("overlapped_idx") or [])
+                  if i < len(colls)]
+        other_inside = [o for o in inside if o["dim"] != "cp"]
+        if other_inside:
+            out["cp_windows_with_compute_comm"] += 1
+        out["ops_in_cp_windows"] += len(inside)
+    if distances:
+        out["mean_cp_sched_distance"] = round(
+            sum(distances) / len(distances), 2)
+    return out
+
+
 def ledger_from_compiled(compiled, mesh=None) -> Optional[Dict[str, Any]]:
     """Ledger from a compiled executable (``jit(f).lower(...).compile()``);
     None when the backend can't render HLO text."""
@@ -503,7 +551,7 @@ def render_table(ledger: Optional[Dict[str, Any]]) -> str:
         e = d.setdefault(key, {"ops": 0, "bytes": 0})
         e["ops"] += 1
         e["bytes"] += c["bytes"]
-    order = ("dp", "tp", "pp", "moe", "other")
+    order = ("dp", "tp", "pp", "cp", "moe", "other")
     for dim in sorted(by_dim, key=lambda d: order.index(d) if d in order else 99):
         stats = ledger["per_dim"][dim]
         parts = ", ".join(

@@ -328,6 +328,11 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     # and the engine-side unwind of an import whose KV never arrived
     "scale_decision", "migration_retry", "migration_fallback",
     "import_aborted",
+    # ring paged prefill (PR 20): a prefill chunk that rode the cp ring
+    # (width + per-rank sub-chunk), the modeled per-tick ring hop/byte
+    # accounting, and a long-document prefill->decode KV handoff at the
+    # router (length >= long_ctx_threshold)
+    "cp_prefill_chunk", "cp_ring_hop", "kv_handoff_long",
 })
 
 

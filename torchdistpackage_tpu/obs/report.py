@@ -471,6 +471,34 @@ def _validate_serving(srv: Any) -> List[str]:
                     "gather", "pallas", "dense", "sorted", "auto"):
                 errs.append(
                     f"serving.moe.dispatch {moe.get('dispatch')!r} unknown")
+    # ring-paged-prefill fields (PR 20) — present for cp_axis engines
+    lc = srv.get("long_context")
+    if lc is not None:
+        if not isinstance(lc, dict):
+            errs.append("serving.long_context non-dict")
+        else:
+            cp = lc.get("cp")
+            if not isinstance(cp, int) or cp < 1:
+                errs.append("serving.long_context.cp missing/< 1")
+            if not isinstance(lc.get("cp_axis"), str) or not lc["cp_axis"]:
+                errs.append("serving.long_context.cp_axis missing/empty")
+            for k in ("max_ctx", "chunk"):
+                v = lc.get(k)
+                if not isinstance(v, int) or v < 1:
+                    errs.append(f"serving.long_context.{k} missing/< 1")
+            for k in ("prefill_chunks", "ring_hops", "ring_bytes"):
+                v = lc.get(k)
+                if not isinstance(v, int) or v < 0:
+                    errs.append(
+                        f"serving.long_context.{k} missing/negative")
+            # a width-1 'ring' has no hops; width > 1 with chunks must
+            # have accumulated hop accounting
+            if (isinstance(cp, int) and cp > 1
+                    and lc.get("prefill_chunks", 0) > 0
+                    and not lc.get("ring_hops", 0)):
+                errs.append(
+                    "serving.long_context.ring_hops zero with cp > 1 and "
+                    "prefill chunks recorded")
     errs.extend(_validate_serving_slo(srv))
     return errs
 

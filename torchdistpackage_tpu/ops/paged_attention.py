@@ -326,6 +326,211 @@ def paged_decode_attention(
     return out[:, :, :R].reshape(B, H, S_in, hd)
 
 
+# ------------------------------------------------ CP ring carry entry point
+
+
+def _cp_kernel(
+    tab_ref, off_ref, q_ref, *refs,
+    S_in, bs, window, sm_scale, fetch_width, rows, nb, has_carry,
+):
+    """Ring-hop variant of :func:`_kernel` for context-parallel prefill
+    (ops/ring_paged.py): the pool operand is ONE rank's slice
+    [nb, Hkv, bs, hd] reached through a RE-BASED table (global id minus
+    the source rank's slice base), so entries outside ``[0, nb)`` mean
+    "another rank owns this block" — the index map clamps them onto a
+    valid fetch and the in-kernel ownership test masks them out of the
+    scores.  Instead of normalizing, the kernel RETURNS the raw online
+    -softmax carry (acc, m, l); the ring accumulates it across hops
+    (``has_carry`` seeds the scratch from the previous hop's output) and
+    normalizes once after the last hop."""
+    n_c = 3 if has_carry else 0
+    carry_refs = refs[:n_c]
+    kv_refs = refs[n_c:n_c + fetch_width * 2]
+    acc_o, m_o, l_o = refs[n_c + fetch_width * 2:n_c + fetch_width * 2 + 3]
+    acc_ref, m_ref, l_ref = refs[n_c + fetch_width * 2 + 3:]
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    off = off_ref[b]
+    hi = (off + S_in + bs - 1) // bs  # live KV blocks for this slot
+
+    @pl.when(j == 0)
+    def _init():
+        if has_carry:
+            acc_ref[...] = carry_refs[0][0, 0]
+            m_ref[...] = carry_refs[1][0, 0]
+            l_ref[...] = carry_refs[2][0, 0]
+        else:
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0]  # [rows, hd]
+    qpos = off + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 0) % S_in
+
+    for i in range(fetch_width):
+        blk = j * fetch_width + i
+
+        @pl.when(blk < hi)
+        def _compute(i=i, blk=blk):
+            raw = tab_ref[b, blk]  # re-based id; out of [0, nb) = remote
+            owned = (raw >= 0) & (raw < nb)
+            kblk = kv_refs[2 * i][0, 0]
+            s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32)
+            s = s * sm_scale
+            kpos = blk * bs + jax.lax.broadcasted_iota(
+                jnp.int32, (rows, bs), 1)
+            keep = (kpos <= qpos) & owned
+            if window is not None:
+                keep = keep & (kpos > qpos - window)
+            s = jnp.where(keep, s, NEG_INF)
+            m = m_ref[:, :1]
+            l = l_ref[:, :1]
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            l_ref[...] = jnp.broadcast_to(
+                l * corr + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+            vblk = kv_refs[2 * i + 1][0, 0]
+            upd = jnp.dot(p.astype(vblk.dtype), vblk,
+                          preferred_element_type=jnp.float32)
+            acc_ref[...] = acc_ref[...] * corr + upd
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == (hi - 1) // fetch_width)
+    def _write():
+        acc_o[0, 0] = acc_ref[...]
+        m_o[0, 0] = m_ref[...]
+        l_o[0, 0] = l_ref[...]
+
+
+def paged_carry_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    tables_local: jnp.ndarray,
+    offsets,
+    *,
+    carry: Optional[Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]] = None,
+    window: Optional[int] = None,
+    sm_scale: Optional[float] = None,
+    fetch_width: Optional[int] = None,
+    q_pad_to: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One ring hop of CP paged prefill: accumulate ``q`` [B, H, S_in,
+    hd] against ONE rank's pool slice ``[nb, Hkv, bs, hd]`` reached
+    through ``tables_local`` (= global tables minus that rank's slice
+    base; out-of-slice entries are masked in-kernel), returning the
+    UN-normalized online-softmax carry ``(acc [B, Hkv, rows, hd] f32,
+    m [B, Hkv, rows, 128] f32, l [B, Hkv, rows, 128] f32)``.
+
+    ``offsets`` must already include the rank's sub-chunk base (the q
+    rows sit at ``offsets[b] + arange(S_in)`` globally), so the existing
+    live-length walk (``hi``), dead-step clamping and position masking
+    carry over from :func:`paged_decode_attention` unchanged.  Pass the
+    previous hop's return as ``carry`` to continue accumulation; finish
+    with :func:`finalize_paged_carry`.  ``l`` may be zero mid-ring (no
+    owned key seen yet) — only the final carry's ``l`` must be positive,
+    guaranteed because each row's own position is pool-resident on
+    exactly one rank.  Int8 pools are not supported (the engine rejects
+    ``kv_quant`` under ``cp_axis``)."""
+    if isinstance(k_pool, tuple):
+        raise NotImplementedError(
+            "paged_carry_attention does not support int8 pools")
+    B, H, S_in, hd = q.shape
+    nb, Hkv, bs, _hd = k_pool.shape
+    groups, rem = divmod(H, Hkv)
+    if rem:
+        raise ValueError(
+            f"GQA needs q heads divisible by kv heads, got {H} vs {Hkv}")
+    mb = tables_local.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+    params = default_paged_params()
+    fw = int(fetch_width if fetch_width is not None else
+             params["fetch_width"])
+    fw = max(1, min(fw, mb))
+    pad_to = int(q_pad_to if q_pad_to is not None else params["q_pad_to"])
+
+    offs = jnp.asarray(offsets, jnp.int32)
+    if offs.ndim == 0:
+        offs = jnp.broadcast_to(offs, (B,))
+    R = groups * S_in
+    rows = -(-R // pad_to) * pad_to
+    qr = q.reshape(B, Hkv, R, hd)
+    if rows != R:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, rows - R), (0, 0)))
+
+    def qidx(b, h, j, tab, off):
+        return (b, h, 0, 0)
+
+    def kvidx(b, h, j, tab, off, i=0):
+        # same dead-step clamp as the decode kernel, plus a clamp of the
+        # re-based table entry into the slice (remote blocks fetch SOME
+        # valid block; the in-kernel ownership test masks the scores)
+        hi1 = (off[b] + S_in + bs - 1) // bs - 1
+        blk = jnp.minimum(jnp.minimum(j * fw + i, hi1), mb - 1)
+        idx = jnp.clip(tab[b, blk], 0, nb - 1)
+        return (idx, h, 0, 0)
+
+    has_carry = carry is not None
+    in_specs = [pl.BlockSpec((1, 1, rows, hd), qidx)]
+    operands = [qr]
+    if has_carry:
+        for c, lanes in zip(carry, (hd, _LANES, _LANES)):
+            in_specs.append(pl.BlockSpec((1, 1, rows, lanes), qidx))
+            operands.append(c)
+    for i in range(fw):
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bs, hd), functools.partial(kvidx, i=i)))
+        operands.append(k_pool)
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bs, hd), functools.partial(kvidx, i=i)))
+        operands.append(v_pool)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, -(-mb // fw)),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, rows, hd), qidx),
+            pl.BlockSpec((1, 1, rows, _LANES), qidx),
+            pl.BlockSpec((1, 1, rows, _LANES), qidx),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rows, hd), jnp.float32),      # acc
+            pltpu.VMEM((rows, _LANES), jnp.float32),  # m
+            pltpu.VMEM((rows, _LANES), jnp.float32),  # l
+        ],
+    )
+    kernel = functools.partial(
+        _cp_kernel, S_in=S_in, bs=bs, window=window,
+        sm_scale=float(sm_scale), fetch_width=fw, rows=rows, nb=nb,
+        has_carry=has_carry)
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _out_struct((B, Hkv, rows, hd), jnp.float32, q),
+            _out_struct((B, Hkv, rows, _LANES), jnp.float32, q),
+            _out_struct((B, Hkv, rows, _LANES), jnp.float32, q),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(tables_local.astype(jnp.int32), offs, *operands)
+    return acc, m, l
+
+
+def finalize_paged_carry(carry, B: int, H: int, S_in: int, hd: int,
+                         dtype) -> jnp.ndarray:
+    """Normalize the last ring hop's carry and restore the public
+    [B, H, S_in, hd] layout (undo group-major packing + row padding)."""
+    acc, _m, l = carry
+    Hkv = acc.shape[1]
+    R = (H // Hkv) * S_in
+    out = acc / l[..., :1]
+    return out[:, :, :R].reshape(B, H, S_in, hd).astype(dtype)
+
+
 # --------------------------------------------------- modeled HBM footprint
 
 

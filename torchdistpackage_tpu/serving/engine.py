@@ -377,6 +377,7 @@ class ServingEngine:
         axis: Optional[str] = None,
         dp_axis: Optional[str] = None,
         ep_axis: Optional[str] = None,
+        cp_axis: Optional[str] = None,
         param_specs: Optional[Any] = None,
         kv_quant: bool = False,
         telemetry: Optional[Any] = None,
@@ -399,9 +400,46 @@ class ServingEngine:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         if cfg.attn_impl in ("ring", "ulysses"):
             raise NotImplementedError(
-                "context-parallel serving is not supported: the KV pool is "
-                "not sequence-sharded (decode a CP-trained checkpoint with "
-                "attn_impl='flash', context_axis=None)")
+                "the training-side ring/Ulysses attn_impl does not apply to "
+                "serving: pass cp_axis= for sequence-sharded (ring paged) "
+                "prefill over the block pool, or decode a CP-trained "
+                "checkpoint with attn_impl='flash', context_axis=None")
+        if cp_axis is not None:
+            if mesh is None:
+                raise ValueError("cp_axis needs a mesh")
+            if dp_axis is not None:
+                raise NotImplementedError(
+                    "cp_axis cannot be combined with dp_axis: the pool's "
+                    "block dim carries exactly one mesh axis (run a CP "
+                    "prefill tier as its own replica behind the Router)")
+            if spec_k:
+                raise NotImplementedError(
+                    "cp_axis + speculative decoding is not supported (a CP "
+                    "prefill tier hands off before decode; run spec_k on "
+                    "the decode replica)")
+            if prefix_cache:
+                raise NotImplementedError(
+                    "cp_axis + prefix_cache is not supported (block hashes "
+                    "would need cross-rank content)")
+            if kv_quant:
+                raise NotImplementedError(
+                    "cp_axis + kv_quant is not supported (the ring rotates "
+                    "fp pool slices)")
+            if cfg.moe_experts:
+                raise NotImplementedError(
+                    "cp_axis + MoE serving is not supported yet")
+            cp = int(mesh.shape[cp_axis])
+            if chunk % cp:
+                raise ValueError(
+                    f"chunk ({chunk}) must be divisible by the context axis "
+                    f"size ({cp}) — each rank prefills chunk/cp rows")
+        else:
+            cp = 1
+        #: context-parallel width: >1 = ring paged prefill, the pool's
+        #: block dim sharded over ``cp_axis`` (ops/ring_paged.py,
+        #: docs/long_context.md "CP prefill serving")
+        self.cp = cp
+        self.cp_axis = cp_axis
         if num_slots < 1 or chunk < 1 or block_size < 1:
             raise ValueError(
                 f"num_slots/chunk/block_size must be >= 1, got "
@@ -472,6 +510,13 @@ class ServingEngine:
         self.slots_per_group = num_slots // self.dp
         if num_blocks is None:
             num_blocks = 1 + self.slots_per_group * self.max_blocks
+            if self.cp > 1:  # pool shards evenly over the context axis
+                num_blocks = -(-num_blocks // self.cp) * self.cp
+        elif self.cp > 1 and num_blocks % self.cp:
+            raise ValueError(
+                f"num_blocks ({num_blocks}) must be divisible by the "
+                f"context axis size ({self.cp}) — the pool's block dim is "
+                f"sharded over cp_axis")
         self.num_blocks = num_blocks  # per dp group
         self._allocs = [BlockAllocator(num_blocks) for _ in range(self.dp)]
         self._param_specs = param_specs
@@ -541,7 +586,9 @@ class ServingEngine:
         from jax.sharding import PartitionSpec as P
 
         def spec(leaf):
-            lead = (None, self.dp_axis, self.axis)
+            # the pool's block dim carries dp groups OR the cp ring slices
+            # (mutually exclusive, validated in __init__); heads carry tp
+            lead = (None, self.dp_axis or self.cp_axis, self.axis)
             return P(*lead, *([None] * (leaf.ndim - 3)))
 
         return jax.tree.map(spec, cache)
@@ -562,6 +609,8 @@ class ServingEngine:
         the same program, compiled once each."""
         cfg, axis = self.cfg, self.axis
         moe = bool(cfg.moe_experts)
+        if self.cp_axis is not None:
+            return self._build_cp_step()
         fwd = self._fwd(moe_stats=moe)
 
         def step(params, cache, tokens, tables, offsets, last_idx, samp, keys):
@@ -596,6 +645,37 @@ class ServingEngine:
 
         if self.mesh is None:
             return jax.jit(step)
+        return self._mesh_step(step)
+
+    def _build_cp_step(self) -> Callable:
+        """The ring-paged step (docs/long_context.md "CP prefill
+        serving"): the same two-signature program as :meth:`_build_step`
+        — ``cp_paged_forward`` branches on S_in at TRACE time, so the
+        S_in=chunk signature compiles the python-unrolled ring and the
+        S_in=1 signature compiles the local-slice + psum-combine decode.
+        ``decode_signatures`` stays 1."""
+        from .paged_cache import cp_paged_forward
+
+        cfg, axis, cp_axis = self.cfg, self.axis, self.cp_axis
+        attn_impl = self.attn_impl
+
+        def step(params, cache, tokens, tables, offsets, last_idx, samp, keys):
+            cache, logits = cp_paged_forward(
+                params, tokens, cfg, cache, tables, offsets,
+                cp_axis=cp_axis, axis=axis, last_idx=last_idx,
+                attn_impl=attn_impl)
+            full = _full_logits(logits, cfg, axis)
+            keys, sub = _split_keys(keys)
+            tok = _slot_sample(full, sub, samp["temperature"], samp["top_k"],
+                               samp["top_p"])
+            if axis is not None:
+                tok = jax.lax.pmax(tok, axis)
+            # every cp rank sampled the identical token (prefill logits
+            # are psum-assembled over cp, decode logits psum-combined,
+            # keys replicated); pmax re-types for the replicated out_spec
+            tok = jax.lax.pmax(tok, cp_axis)
+            return cache, tok, keys
+
         return self._mesh_step(step)
 
     def _mesh_step(self, step):
@@ -1256,6 +1336,26 @@ class ServingEngine:
         self._tick_prefill_rids = rids
         self._ev.emit("prefill_chunk", rids=rids, chunk=C,
                       n_slots=len(rids))
+        if self.cp > 1:
+            # modeled ring accounting (host math, ops/ring_paged.py): the
+            # compiled chunk issued 4*(cp-1) unrolled ppermutes per layer
+            # — the comm-ledger test prices the same count from HLO
+            from ..ops.ring_paged import ring_chunk_bytes, ring_hops_per_chunk
+
+            hops = ring_hops_per_chunk(self.cfg.nlayers, self.cp)
+            bts = ring_chunk_bytes(
+                nlayers=self.cfg.nlayers, cp=self.cp, batch=self.num_slots,
+                kv_heads=self.cfg.block.kv_head_count,
+                head_dim=self.cfg.block.head_dim, chunk=C,
+                nb_local=self.num_blocks // self.cp,
+                block_size=self.block_size,
+                itemsize=jnp.dtype(self.cfg.dtype).itemsize)
+            self.stats["cp_ring_hops"] += hops
+            self.stats["cp_ring_bytes"] += bts
+            self._ev.emit("cp_prefill_chunk", rids=rids, chunk=C,
+                          cp=self.cp, sub_chunk=C // self.cp)
+            self._ev.emit("cp_ring_hop", tick=self._tick, hops=hops,
+                          bytes=bts)
         return len(rids)
 
     def _decode_tick(self) -> int:
@@ -2232,7 +2332,8 @@ class ServingEngine:
                       "cache_evictions": 0,
                       "spec_drafted": 0, "spec_accepted": 0,
                       "migrated_in": 0, "migrated_out": 0,
-                      "imports_aborted": 0}
+                      "imports_aborted": 0,
+                      "cp_ring_hops": 0, "cp_ring_bytes": 0}
         self._decode_sigs: set = set()
         self._prefill_sigs: set = set()
         self._cow_sigs: set = set()
@@ -2463,6 +2564,18 @@ class ServingEngine:
             # (docs/serving.md "Paged attention kernel"): 'pallas' walks
             # the block table in-kernel, 'gather' is the parity oracle
             "attn_impl": self.attn_impl,
+            # ring paged prefill (cp_axis engines only): CP width, the
+            # chunks that rode the ring, and the modeled ring wire volume
+            # — obs/report.py validates the block's schema
+            **({"long_context": {
+                "cp": self.cp,
+                "cp_axis": self.cp_axis,
+                "max_ctx": self.max_ctx,
+                "chunk": self.chunk,
+                "prefill_chunks": st["prefill_chunks"],
+                "ring_hops": st["cp_ring_hops"],
+                "ring_bytes": st["cp_ring_bytes"],
+            }} if self.cp_axis is not None else {}),
             **({"moe": moe} if moe is not None else {}),
             "decode_steps": st["decode_steps"],
             "prefill_chunks": st["prefill_chunks"],

@@ -54,6 +54,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..compat import axis_size as _axis_size
 from ..models.generate import (
     _cached_attention,
     _embed_at,
@@ -293,6 +294,112 @@ def paged_forward(
     logits = gpt_head(params, _select_row(h, last_idx), axis, False,
                       eps=cfg.norm_eps)
     return {"k": ck, "v": cv}, logits[:, 0, :]
+
+
+def _cp_paged_cache_ops(tables: jnp.ndarray, cp_axis: str, attn_impl: str,
+                        prefill: bool):
+    """``cache_ops`` pair running ``cached_block_forward`` on a pool whose
+    block dim is sharded over ``cp_axis`` (ops/ring_paged.py): the write
+    ring completes the chunk's pool write BEFORE attend runs (the pair is
+    called write-then-attend), so the attend ring only ever rotates pool
+    slices.  ``prefill`` is the trace-time phase flag (S_in of the FULL
+    chunk > 1) — the ring ops cannot infer it from their operand shapes
+    because a ``chunk == cp`` sub-chunk is one row, like decode."""
+    from ..ops.ring_paged import ring_paged_attend, ring_paged_write
+
+    def write(c, val, offset):
+        return ring_paged_write(c, val, offset, tables=tables,
+                                cp_axis=cp_axis, prefill=prefill)
+
+    def attend(q, ck, cv, offset, window=None):
+        return ring_paged_attend(q, ck, cv, offset, tables=tables,
+                                 cp_axis=cp_axis, window=window,
+                                 impl=attn_impl, prefill=prefill)
+    return write, attend
+
+
+def cp_paged_forward(
+    params: Dict[str, PyTree],
+    tokens: jnp.ndarray,
+    cfg: GPTConfig,
+    cache: Dict[str, Any],
+    tables: jnp.ndarray,
+    offset: jnp.ndarray,
+    *,
+    cp_axis: str,
+    axis: Optional[str] = None,
+    last_idx=None,
+    attn_impl: str = "gather",
+) -> Tuple[Dict[str, Any], jnp.ndarray]:
+    """:func:`paged_forward` across a ``context`` mesh axis — ring paged
+    prefill (ops/ring_paged.py).  Call inside shard_map with the pool's
+    block dim sharded over ``cp_axis`` and everything else (params,
+    tokens, tables, offsets) replicated along it.
+
+    Prefill (``S_in = chunk``, ``chunk % cp == 0``): rank r embeds and
+    projects ONLY its sub-chunk rows ``[r*Csub, (r+1)*Csub)``; per layer
+    the write ring lands every row in its owner's pool slice and the
+    attend ring accumulates each rank's rows against all slices.  The
+    per-slot head row lives on exactly one rank — its logits are selected
+    by mask and ``psum`` over ``cp_axis`` makes them replicated, so
+    sampling stays identical on every rank.  Decode (``S_in = 1``): every
+    rank runs the same row, attends its local slice, and an exact
+    pmax/psum logsumexp combine replicates the output — ONE compiled
+    decode program, no extra signatures.
+
+    The layer loop is python-unrolled (vs ``lax.scan`` in
+    :func:`paged_forward`) so every ring hop is a distinct HLO
+    ``collective-permute`` — the comm ledger prices each hop instead of
+    undercounting a while body (the PR-3/PR-8 unrolled-ppermute lineage;
+    tests/test_cp_prefill.py asserts the per-hop count)."""
+    bcfg = cfg.block
+    cp = _axis_size(cp_axis)
+    S_in = tokens.shape[1]
+    offset = jnp.asarray(offset, jnp.int32)
+    decode = S_in == 1
+    if decode or cp == 1:
+        my_tokens = tokens
+        positions = offset[:, None] + jnp.arange(S_in)[None, :]
+    else:
+        if S_in % cp:
+            raise ValueError(
+                f"cp prefill needs the chunk ({S_in}) divisible by the "
+                f"context axis size ({cp})")
+        sub = S_in // cp
+        r = jax.lax.axis_index(cp_axis)
+        my_tokens = jax.lax.dynamic_slice_in_dim(
+            tokens, r * sub, sub, axis=1)
+        positions = offset[:, None] + r * sub + jnp.arange(sub)[None, :]
+    h = _embed_at(params, my_tokens, positions, axis)
+    rope = _batched_rope(bcfg, positions)
+    ops = _cp_paged_cache_ops(tables, cp_axis, attn_impl,
+                              prefill=not decode)
+
+    cks, cvs = [], []
+    for li in range(cfg.nlayers):  # unrolled: one HLO permute per hop
+        lp = jax.tree_util.tree_map(lambda a: a[li], params["blocks"])
+        h, ck, cv = cached_block_forward(
+            lp, h, bcfg, cache["k"][li], cache["v"][li], offset, axis=axis,
+            rope=rope, cache_ops=ops)
+        cks.append(ck)
+        cvs.append(cv)
+    new_cache = {"k": jnp.stack(cks), "v": jnp.stack(cvs)}
+
+    if decode or cp == 1:
+        # decode h is replicated over cp (psum-combined attends on
+        # replicated inputs); the head needs no cross-rank fixup
+        logits = gpt_head(params, _select_row(h, last_idx), axis, False,
+                          eps=cfg.norm_eps)
+        return new_cache, logits[:, 0, :]
+    sub = S_in // cp
+    r = jax.lax.axis_index(cp_axis)
+    li_idx = jnp.asarray(last_idx, jnp.int32)
+    mine = (li_idx >= r * sub) & (li_idx < (r + 1) * sub)
+    sel = _select_row(h, jnp.clip(li_idx - r * sub, 0, sub - 1))
+    logits = gpt_head(params, sel, axis, False, eps=cfg.norm_eps)
+    logits = jnp.where(mine[:, None, None], logits, 0.0)
+    logits = jax.lax.psum(logits, cp_axis)
+    return new_cache, logits[:, 0, :]
 
 
 def paged_forward_moe(

@@ -184,6 +184,7 @@ class Router:
         evacuate_on_fault: bool = False,
         transport: Optional[MigrationTransport] = None,
         telemetry: Optional[Any] = None,
+        long_ctx_threshold: int = 8192,
     ) -> None:
         if not replicas:
             raise ValueError("Router needs at least one replica")
@@ -215,6 +216,11 @@ class Router:
         self.rebalance_every = int(rebalance_every)
         self.rebalance_watermark = int(rebalance_watermark)
         self.evacuate_on_fault = bool(evacuate_on_fault)
+        #: prompt length (tokens) at/above which a prefill->decode handoff
+        #: additionally emits ``kv_handoff_long`` — the long-document
+        #: marker trace_replay's mixed-traffic scenario and FLEETREPORT
+        #: consumers key on (docs/long_context.md "CP prefill serving")
+        self.long_ctx_threshold = int(long_ctx_threshold)
         self.telemetry = telemetry
         self._ev: EventLog = (
             telemetry.events if telemetry is not None else
@@ -663,6 +669,15 @@ class Router:
             dst_replica=dst, mode="prefill_handoff",
             src_rid=rid, dst_rid=res["rid"],
             emitted_tokens=len(desc.get("emitted") or []))
+        if int(desc["length"]) >= self.long_ctx_threshold:
+            # long-document handoff: the CP-prefill -> narrow-decode
+            # shape docs/long_context.md "CP prefill serving" describes
+            self._ev.emit(
+                "kv_handoff_long", rid=router_rid, src_replica=src,
+                dst_replica=dst, length=int(desc["length"]),
+                n_blocks=n_mig,
+                bytes=int(price["wire_bytes"]) if n_mig > 0 else 0,
+                cp=int(getattr(p, "cp", 1)))
         return True
 
     def _resume_descs(self, descs: List[Dict[str, Any]], exclude: int,

@@ -86,6 +86,11 @@ from typing import Any, Dict, List, Tuple
 #: the migration transport is degrading under the SAME fault plan; any
 #: nonzero fallback is a re-prefill the fleet paid for — cheap this
 #: release and expensive the next is a regression no headline catches.
+#: ``cp_prefill_ttft_s`` / ``long_ctx_tok_s`` (PR 20) ride the
+#: ``serve-longctx-ab`` line: the CP arm's absolute TTFT at the longest
+#: context and its decode tokens/s, next to the gating cp1/cpN speedup
+#: — a speedup hold earned while absolute TTFT creeps up means both
+#: arms got slower together (a prefill regression the ratio hides).
 AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
             "grad_norm_final", "comm_bytes_per_dim", "shed_rate",
             "preempt_count", "prefix_hit_rate", "spec_accept_rate",
@@ -95,7 +100,8 @@ AUX_KEYS = ("mfu", "mfu_xla", "peak_hbm_bytes", "mem_headroom_frac",
             "migration_bytes", "fleet_slo_attainment", "migration_count",
             "moe_pallas_tok_s", "expert_imbalance",
             "autoscale_actions", "migration_retry_count",
-            "transport_fallback_count")
+            "transport_fallback_count",
+            "cp_prefill_ttft_s", "long_ctx_tok_s")
 
 
 def _aux_str(key: str, val: Any) -> str:

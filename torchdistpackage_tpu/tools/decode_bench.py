@@ -875,6 +875,127 @@ def bench_serve_paged(jax, jnp, cfg, params, tel, *, attn_impl, n_requests,
     return chosen
 
 
+def bench_serve_long_context(jax, jnp, cfg, params, tel, *, cp, contexts,
+                             block_size, chunk, seed, smoke):
+    """The context-parallel prefill A/B (docs/long_context.md "CP prefill
+    serving"): one long document per context point, prefilled to first
+    token by a single-replica chunked-prefill engine (the oracle) and by
+    a cp-way ring-paged engine on a ``context`` mesh — paired
+    ``serve-longctx-cp{1,N}`` JSON lines at equal ``config_hash``, value
+    = TTFT seconds, with token BIT-parity asserted per context point.
+    The ``serve-longctx-ab`` rollup carries the trended TTFT speedup at
+    the longest context plus the ``cp_prefill_ttft_s`` /
+    ``long_ctx_tok_s`` aux columns (bench_trend AUX_KEYS).
+
+    Both arms run f32 (the dtype the parity claim is exact at — see
+    bench_serve_paged).  On the CPU sim both arms pay interpreter and
+    host-ring overheads, so the TTFT ratio only proves the path runs and
+    the ledger prices the hops; the crossover where ring compute-split
+    beats one replica's serial chunk walk is a real-chip number
+    (ROADMAP 5c)."""
+    import dataclasses
+    import hashlib
+
+    import numpy as np
+
+    from ..dist import tpc
+    from ..serving import Request, ServingEngine
+    from ..utils.logging import master_print
+
+    if cp > 1 and len(jax.devices()) < cp:
+        master_print(
+            f"decode_bench: --long-context needs {cp} devices for the CP "
+            f"arm, have {len(jax.devices())}", file=sys.stderr)
+        return None
+    params = jax.device_put(jax.tree.map(
+        lambda x: x.astype(jnp.float32)
+        if x.dtype == jnp.bfloat16 else x, params))
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    new_tokens = 4 if smoke else 16
+    cfg_hash = hashlib.sha1(
+        f"serve-longctx|d{cfg.dim}|L{cfg.nlayers}|cp{cp}"
+        f"|ctx{','.join(str(c) for c in contexts)}"
+        f"|bs{block_size}|c{chunk}|seed{seed}".encode()
+    ).hexdigest()[:12]
+    rng = np.random.RandomState(seed + 7)
+
+    def run_arm(width, ctx, prompt):
+        if width > 1:
+            tpc.setup_process_groups(
+                [("context", width)], devices=jax.devices()[:width])
+            eng = ServingEngine(
+                params, cfg, num_slots=1, block_size=block_size,
+                chunk=chunk, max_ctx=ctx, mesh=tpc.get_view(),
+                cp_axis="context")
+        else:
+            eng = ServingEngine(params, cfg, num_slots=1,
+                                block_size=block_size, chunk=chunk,
+                                max_ctx=ctx)
+        # warm both compiled phases on a chunk-sized request so the
+        # measured TTFT is serving time, not XLA time
+        eng.submit(Request(prompt[:chunk].tolist(), 2))
+        eng.run_until_idle()
+        eng.reset_metrics()
+        t0 = time.perf_counter()
+        rid = eng.submit(Request(prompt.tolist(), new_tokens))
+        eng.run_until_idle(max_ticks=ctx)
+        wall = time.perf_counter() - t0
+        f = eng.finished[rid]
+        if width > 1:
+            tpc.reset()
+        return eng, f, wall
+
+    rows = {1: [], cp: []}
+    summary_n = None
+    for ctx in contexts:
+        prompt = rng.randint(
+            0, cfg.vocab_size, size=ctx - new_tokens).astype(np.int32)
+        toks = {}
+        for width in sorted({1, cp}):
+            eng, f, wall = run_arm(width, ctx, prompt)
+            s = eng.serving_summary()
+            toks[width] = tuple(int(x) for x in f["tokens"])
+            rows[width].append(
+                (ctx, float(f["ttft_s"]), f["new_tokens"] / wall))
+            if width == cp:
+                summary_n = s
+            master_print(json.dumps({
+                "metric": f"serve-longctx-cp{width}",
+                "value": round(float(f["ttft_s"]), 4),
+                "context": ctx, "cp": width,
+                "prefill_chunks": s["prefill_chunks"],
+                "ring_hops": s.get("long_context", {}).get("ring_hops", 0),
+                "ring_bytes": s.get("long_context", {}).get("ring_bytes", 0),
+                "decode_signatures": s["decode_signatures"],
+                "prefill_signatures": s["prefill_signatures"],
+                "config_hash": cfg_hash,
+                **_mem_cols(),
+            }), flush=True)
+        # token bit-parity: the ring splits the same fp math by rank
+        assert toks[1] == toks[cp], (
+            f"CP prefill arm diverged from the single-replica oracle "
+            f"at context {ctx}")
+    longest = max(contexts)
+    ttft1 = dict((c, t) for c, t, _ in rows[1])[longest]
+    ttftn = dict((c, t) for c, t, _ in rows[cp])[longest]
+    master_print(json.dumps({
+        "metric": "serve-longctx-ab",
+        # value = cp1/cpN TTFT speedup at the longest context (the
+        # trended series); the CP arm's absolute TTFT and decode
+        # throughput ride the aux trail
+        "value": round(ttft1 / ttftn, 3) if ttftn > 0 else 0.0,
+        "cp": cp, "context": longest,
+        "cp_prefill_ttft_s": round(ttftn, 4),
+        "long_ctx_tok_s": round(
+            sum(r[2] for r in rows[cp]) / len(rows[cp]), 2),
+        "bit_parity": True,
+        "interpret_mode": jax.default_backend() == "cpu",
+        "config_hash": cfg_hash,
+    }), flush=True)
+    tel.record_serving(summary_n)
+    return summary_n
+
+
 def bench_serve_moe(jax, jnp, cfg, tel, *, moe_dispatch, n_requests,
                     num_slots, block_size, chunk, seed, smoke):
     """The MoE expert-dispatch A/B (docs/moe.md "Fused dispatch"): the
@@ -1033,6 +1154,17 @@ def _parse_args(argv=None):
                          "token bit-parity asserted on the fp path); the "
                          "chosen value picks which arm's summary lands in "
                          "the RUNREPORT serving section")
+    ap.add_argument("--long-context", action="store_true",
+                    help="with --serve: add the context-parallel prefill "
+                         "A/B — one long document per context point "
+                         "(8k/32k/128k full, toy lengths on smoke) "
+                         "through a single-replica chunked-prefill "
+                         "engine vs a --cp-way ring-paged engine; "
+                         "paired serve-longctx-cp{1,N} TTFT lines at "
+                         "equal config_hash, token bit-parity asserted, "
+                         "and the serve-longctx-ab rollup")
+    ap.add_argument("--cp", type=int, default=2, metavar="N",
+                    help="--long-context ring width (default 2)")
     ap.add_argument("--moe-dispatch", choices=("gather", "pallas"),
                     default=None,
                     help="with --serve: add the MoE expert-dispatch A/B "
@@ -1060,7 +1192,11 @@ def _parse_args(argv=None):
 def main(argv=None):
     args = _parse_args(argv)
     if os.environ.get("TDP_CPU_SIM"):
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # full sim bootstrap, not just the platform pin: --long-context's
+        # CP arm needs the virtual device count too
+        from ..dist.overlap import cpu_sim
+
+        cpu_sim(os.environ["TDP_CPU_SIM"])
     import jax
 
     if os.environ.get("TDP_CPU_SIM"):
@@ -1152,6 +1288,12 @@ def main(argv=None):
                 n_requests=args.serve_requests or (8 if smoke else 24),
                 num_slots=args.slots, block_size=args.block_size,
                 chunk=args.chunk, seed=args.seed, smoke=smoke)
+        if args.long_context:
+            bench_serve_long_context(
+                jax, jnp, cfg, params, tel, cp=args.cp,
+                contexts=[96, 160] if smoke else [8192, 32768, 131072],
+                block_size=args.block_size, chunk=args.chunk,
+                seed=args.seed, smoke=smoke)
         if args.moe_dispatch:
             bench_serve_moe(
                 jax, jnp, cfg, tel, moe_dispatch=args.moe_dispatch,
@@ -1178,10 +1320,11 @@ def main(argv=None):
             master_print(phase_table(tel.events.as_list()),
                          file=sys.stderr)
     elif (args.overload or args.shared_prefix or args.spec
-          or args.attn_impl or args.router or args.moe_dispatch):
+          or args.attn_impl or args.router or args.moe_dispatch
+          or args.long_context):
         master_print(
             "decode_bench: --overload/--shared-prefix/--spec/--attn-impl/"
-            "--router/--moe-dispatch need --serve",
+            "--router/--moe-dispatch/--long-context need --serve",
             file=sys.stderr)
         return 2
     for B, ctx in cells:

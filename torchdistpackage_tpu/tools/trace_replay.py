@@ -209,6 +209,8 @@ def run_replay(
     groups: int = 32,
     zipf_a: float = 1.2,
     multiturn_p: float = 0.3,
+    long_docs: int = 0,
+    long_doc_len: int = 512,
     ledger_path: Optional[str] = None,
     max_ticks: Optional[int] = None,
     autoscale: bool = False,
@@ -230,7 +232,16 @@ def run_replay(
     byte-identical whether ``autoscale`` is on or off (the A/B
     contract).  ``chaos=True`` seeds ``chaos_faults`` transport faults
     (cycling every ``TRANSPORT_FAULT_KINDS`` member, death included)
-    across the migration-send sequence space."""
+    across the migration-send sequence space.
+
+    ``long_docs > 0`` carves that many submissions out of
+    ``n_requests`` and replaces them with ``long_doc_len``-token
+    documents spread evenly over the arrival schedule — the
+    mixed-traffic starvation probe (docs/long_context.md "CP prefill
+    serving"): the returned ``mixed_traffic`` block carries per-class
+    latency percentiles in TICKS, so "one long document does not starve
+    the short requests' TTFT" is an assertable, compile-free claim
+    (tests/test_fleet_obs.py)."""
     import hashlib
 
     from ..models.gpt import GPTConfig
@@ -247,6 +258,8 @@ def run_replay(
     from ..serving.transport import ChunkedWireTransport
 
     max_ctx = 8 * block_size + 64
+    if long_docs:
+        max_ctx = max(max_ctx, long_doc_len + 64)
     cfg = GPTConfig(vocab_size=vocab, dim=64, nheads=4, nlayers=2,
                     max_seq=max_ctx)
     rng = np.random.RandomState(seed)
@@ -278,6 +291,7 @@ def run_replay(
         "rebalance_every": rebalance_every,
         "rebalance_watermark": rebalance_watermark, "groups": groups,
         "zipf_a": zipf_a, "multiturn_p": multiturn_p,
+        "long_docs": long_docs, "long_doc_len": long_doc_len,
         "chaos": chaos, "chaos_faults": chaos_faults,
     }, sort_keys=True).encode()).hexdigest()[:16]
 
@@ -374,6 +388,16 @@ def run_replay(
             curves["queued"].append(
                 sum(len(e.queue) for e in engines))
 
+        # mixed traffic: the i-th long document replaces the submission
+        # at an evenly spaced mark, so offered load (and the hash'd
+        # workload shape) stays n_requests total
+        long_marks = {
+            int(round((i + 1) * n_requests / (long_docs + 1)))
+            for i in range(long_docs)} if long_docs else set()
+        long_rids: set = set()
+        sub_tick: Dict[int, int] = {}
+        waits: Dict[str, List[int]] = {"short": [], "long": []}
+
         submitted = 0
         tick = 0
         t0 = time.perf_counter()
@@ -384,11 +408,22 @@ def run_replay(
                 k = min(int(rng.poisson(max(lam, 0.0))),
                         n_requests - submitted)
                 for _ in range(k):
-                    kw = wl.next_request()
-                    turn = kw.pop("_turn")
-                    rid = router.submit(Request(**kw))
-                    if rid not in router.rejected:
-                        wl.register(rid, turn)
+                    if submitted in long_marks:
+                        rid = router.submit(Request(
+                            rng.randint(0, vocab,
+                                        size=long_doc_len - 16).tolist(),
+                            16, temperature=0.0,
+                            seed=int(rng.randint(1 << 31))))
+                        if rid not in router.rejected:
+                            long_rids.add(rid)
+                            sub_tick[rid] = tick
+                    else:
+                        kw = wl.next_request()
+                        turn = kw.pop("_turn")
+                        rid = router.submit(Request(**kw))
+                        if rid not in router.rejected:
+                            wl.register(rid, turn)
+                            sub_tick[rid] = tick
                     submitted += 1
             router.step()
             if router.finished:
@@ -396,6 +431,10 @@ def run_replay(
                 # keep the result dict from growing 10^5 entries deep
                 for rid, rec in router.finished.items():
                     wl.complete(rid, [int(t) for t in rec["tokens"]])
+                    t_sub = sub_tick.pop(rid, None)
+                    if t_sub is not None:
+                        waits["long" if rid in long_rids
+                              else "short"].append(tick - t_sub)
                 router.finished.clear()
             tick += 1
             if curve_every and tick % curve_every == 0:
@@ -438,6 +477,15 @@ def run_replay(
             "calls": {k: sum(s.calls[k] for s in stubs)
                       for k in stubs[0].calls},
         }
+        def _wait_pcts(xs: List[int]) -> Dict[str, Any]:
+            if not xs:
+                return {"n": 0, "p50_wait_ticks": None,
+                        "p99_wait_ticks": None}
+            a = np.asarray(xs)
+            return {"n": len(xs),
+                    "p50_wait_ticks": int(np.percentile(a, 50)),
+                    "p99_wait_ticks": int(np.percentile(a, 99))}
+
         return {
             "schema": REPLAY_SCHEMA,
             "n_requests": n_requests,
@@ -457,6 +505,12 @@ def run_replay(
                              diurnal_period=diurnal_period,
                              base_rate_req_per_tick=round(base_rate, 3)),
             "summary": summary,
+            "mixed_traffic": ({
+                "long_docs": long_docs,
+                "long_doc_len": long_doc_len,
+                "short": _wait_pcts(waits["short"]),
+                "long": _wait_pcts(waits["long"]),
+            } if long_docs else None),
             "validation_errors": errs,
             "attribution": attribution,
             "sim": sim,
@@ -493,6 +547,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--groups", type=int, default=32)
     ap.add_argument("--zipf-a", type=float, default=1.2)
     ap.add_argument("--multiturn-p", type=float, default=0.3)
+    ap.add_argument("--long-docs", type=int, default=0,
+                    help="long documents carved out of N_REQUESTS and "
+                         "spread evenly over the schedule (the "
+                         "mixed-traffic starvation probe)")
+    ap.add_argument("--long-doc-len", type=int, default=512,
+                    help="--long-docs document length in tokens")
     ap.add_argument("--history", type=int, default=65_536,
                     help="events kept in memory for --trace rendering")
     ap.add_argument("--autoscale", action="store_true",
@@ -538,6 +598,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         rebalance_watermark=args.rebalance_watermark,
         history_max=args.history, groups=args.groups,
         zipf_a=args.zipf_a, multiturn_p=args.multiturn_p,
+        long_docs=args.long_docs, long_doc_len=args.long_doc_len,
         n_spares=args.spares, chaos=args.chaos,
         chaos_faults=args.chaos_faults, curve_every=args.curve_every,
         autoscale_kw={"eval_every": args.eval_every,
@@ -572,6 +633,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "router": out["summary"],
         "counters": {"workload": out["workload"],
                      "attribution": out["attribution"],
+                     "mixed_traffic": out["mixed_traffic"],
                      "sim": out["sim"],
                      "curves": out["curves"],
                      "autoscale": out["autoscale"],
@@ -607,6 +669,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "config_hash": out["config_hash"],
         "report_valid": not out["validation_errors"],
         "attribution_complete": out["attribution"]["complete"],
+        **({"short_p99_wait_ticks":
+                out["mixed_traffic"]["short"]["p99_wait_ticks"],
+            "long_p50_wait_ticks":
+                out["mixed_traffic"]["long"]["p50_wait_ticks"]}
+           if out["mixed_traffic"] else {}),
     }), flush=True)
     if baseline is not None:
         att_on = fleet["attainment"]
